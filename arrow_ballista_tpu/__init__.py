@@ -1,0 +1,49 @@
+"""arrow_ballista_tpu: a TPU-native distributed SQL query engine.
+
+Ground-up rebuild of the capabilities of arrow-ballista (distributed SQL on
+Arrow/DataFusion, reference at /root/reference) re-designed for TPU:
+
+- columnar data lives as fixed-capacity JAX device arrays (HBM-resident),
+- physical operators are XLA/Pallas programs with static shapes,
+- shuffles run over the ICI mesh via all_to_all when co-located, with an
+  Arrow-IPC file/stream fallback across hosts,
+- the control plane (scheduler, execution graph, fault tolerance) keeps the
+  reference's architecture: stage DAGs split at exchange boundaries, event-
+  driven scheduling, shuffle-lineage retry.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64 is load-bearing: decimals are fixed-point int64 (exact money math on
+# TPU, which has no native f64).  Without x64, JAX silently truncates to int32.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .models.schema import (  # noqa: E402,F401
+    BOOL,
+    DATE32,
+    FLOAT32,
+    FLOAT64,
+    INT32,
+    INT64,
+    STRING,
+    DataType,
+    Field,
+    Schema,
+    decimal,
+)
+from .models.batch import ColumnBatch, concat_batches  # noqa: E402,F401
+from .utils.config import BallistaConfig  # noqa: E402,F401
+
+
+def __getattr__(name):
+    # Lazy: avoid importing the whole engine for schema-only users.
+    if name == "BallistaContext":
+        try:
+            from .client.context import BallistaContext
+        except ModuleNotFoundError as e:
+            raise AttributeError(f"BallistaContext unavailable: {e}") from e
+        return BallistaContext
+    raise AttributeError(name)

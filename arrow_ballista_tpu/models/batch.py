@@ -1,0 +1,209 @@
+"""ColumnBatch: the unit of data flowing through the engine.
+
+TPU-first design
+----------------
+The reference engine streams Arrow ``RecordBatch``es of arbitrary length
+between operators (e.g. the ShuffleWriter hot loop,
+reference ballista/core/src/execution_plans/shuffle_writer.rs:214-252).
+XLA wants **static shapes**, so a ColumnBatch is:
+
+- ``columns``: dict name -> device array of fixed *capacity* rows (padded),
+- ``mask``: bool[capacity] device array marking live rows.  Filters simply
+  clear mask bits — no data-dependent compaction inside a compiled stage.
+- ``dicts``: host-side numpy string dictionaries for dictionary-encoded
+  string columns (device holds int32 codes).
+
+A whole operator pipeline (filter → project → partial-agg → hash-partition)
+therefore compiles to ONE jitted function over ``(columns, mask)`` with a
+single static capacity, which XLA fuses into a few HBM passes.  Compaction
+happens only at materialization boundaries (shuffle write / host collect),
+where it is one argsort+gather.
+
+``ColumnBatch`` itself is a host-side handle, NOT a pytree: jitted kernels
+take/return the raw ``(columns, mask)`` pytrees and the handle re-wraps them
+with schema + dictionaries.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .schema import DataType, Field, Schema
+
+
+def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
+    n = arr.shape[0]
+    if n > capacity:
+        raise ValueError(f"array of {n} rows exceeds capacity {capacity}")
+    if n == capacity:
+        return arr
+    pad = np.zeros(capacity - n, dtype=arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+def round_capacity(n: int, multiple: int = 1024) -> int:
+    """Round a row count up to a shape-bucket so XLA recompiles rarely."""
+    if n <= 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class ColumnBatch:
+    def __init__(
+        self,
+        schema: Schema,
+        columns: Dict[str, jnp.ndarray],
+        mask: jnp.ndarray,
+        dicts: Optional[Dict[str, np.ndarray]] = None,
+        num_rows: Optional[int] = None,
+    ):
+        self.schema = schema
+        self.columns = columns
+        self.mask = mask
+        self.dicts = dicts or {}
+        self._num_rows = num_rows  # lazily computed if None
+
+    # --- construction ---------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        schema: Schema,
+        data: Dict[str, np.ndarray],
+        dicts: Optional[Dict[str, np.ndarray]] = None,
+        capacity: Optional[int] = None,
+    ) -> "ColumnBatch":
+        """Build a device batch from host numpy columns (already physical:
+        string columns passed as int32 codes + dicts)."""
+        lengths = {f.name: np.asarray(data[f.name]).shape[0] for f in schema}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"column lengths differ: {lengths}")
+        n = next(iter(lengths.values())) if lengths else 0
+        cap = capacity or round_capacity(n)
+        cols = {}
+        for f in schema:
+            arr = np.asarray(data[f.name], dtype=f.dtype.np_dtype)
+            cols[f.name] = jnp.asarray(_pad_to(arr, cap))
+        mask = np.zeros(cap, dtype=np.bool_)
+        mask[:n] = True
+        return ColumnBatch(schema, cols, jnp.asarray(mask), dicts, num_rows=n)
+
+    @staticmethod
+    def empty(schema: Schema, capacity: int = 1024) -> "ColumnBatch":
+        cols = {f.name: jnp.zeros(capacity, dtype=f.dtype.np_dtype) for f in schema}
+        return ColumnBatch(schema, cols, jnp.zeros(capacity, dtype=jnp.bool_), {}, num_rows=0)
+
+    # --- basic properties ----------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.mask.shape[0])
+
+    @property
+    def num_rows(self) -> int:
+        if self._num_rows is None:
+            self._num_rows = int(jnp.sum(self.mask))
+        return self._num_rows
+
+    def column(self, name: str) -> jnp.ndarray:
+        return self.columns[name]
+
+    def with_data(
+        self,
+        columns: Dict[str, jnp.ndarray],
+        mask: jnp.ndarray,
+        schema: Optional[Schema] = None,
+        dicts: Optional[Dict[str, np.ndarray]] = None,
+    ) -> "ColumnBatch":
+        """Re-wrap raw kernel outputs, keeping host-side metadata."""
+        return ColumnBatch(schema or self.schema, columns, mask, dicts if dicts is not None else self.dicts)
+
+    # --- host materialization ------------------------------------------
+    def compacted_numpy(self) -> Dict[str, np.ndarray]:
+        """Return host numpy columns containing only live rows, in order."""
+        mask = np.asarray(self.mask)
+        out = {}
+        for f in self.schema:
+            out[f.name] = np.asarray(self.columns[f.name])[mask]
+        return out
+
+    def to_arrow(self):
+        """Decode to a pyarrow Table (strings/dates/decimals restored)."""
+        import pyarrow as pa
+
+        data = self.compacted_numpy()
+        arrays, fields = [], []
+        for f in self.schema:
+            arr = data[f.name]
+            if f.dtype.is_string:
+                dic = self.dicts.get(f.name)
+                if dic is None:
+                    dic = np.array([], dtype=object)
+                pa_arr = pa.DictionaryArray.from_arrays(pa.array(arr, type=pa.int32()), pa.array(dic, type=pa.string()))
+                fields.append(pa.field(f.name, pa_arr.type))
+            elif f.dtype.kind == "date32":
+                pa_arr = pa.array(arr, type=pa.date32())
+                fields.append(pa.field(f.name, pa.date32()))
+            elif f.dtype.is_decimal:
+                pa_arr = pa.array(arr, type=pa.int64())
+                fields.append(pa.field(f.name, pa.int64(), metadata={b"decimal_scale": str(f.dtype.scale).encode()}))
+            else:
+                pa_arr = pa.array(arr)
+                fields.append(pa.field(f.name, pa_arr.type))
+            arrays.append(pa_arr)
+        return pa.table(arrays, schema=pa.schema(fields))
+
+    def to_pandas(self):
+        """Decode to pandas with logical values (decimals -> float)."""
+        import pandas as pd
+
+        data = self.compacted_numpy()
+        out = {}
+        for f in self.schema:
+            arr = data[f.name]
+            if f.dtype.is_string:
+                dic = np.asarray(self.dicts.get(f.name, np.array([], dtype=object)), dtype=object)
+                if len(dic) == 0:
+                    out[f.name] = np.full(len(arr), None, dtype=object)
+                else:
+                    vals = dic[np.clip(arr, 0, len(dic) - 1)]
+                    out[f.name] = np.where((arr >= 0) & (arr < len(dic)), vals, None)
+            elif f.dtype.is_decimal:
+                out[f.name] = arr.astype(np.float64) / (10.0 ** f.dtype.scale)
+            elif f.dtype.kind == "date32":
+                out[f.name] = arr.astype("datetime64[D]")
+            else:
+                out[f.name] = arr
+        return pd.DataFrame(out)
+
+    def __repr__(self):
+        return f"ColumnBatch({self.num_rows}/{self.capacity} rows, {len(self.schema)} cols)"
+
+
+def concat_batches(schema: Schema, batches: Sequence[ColumnBatch], capacity: Optional[int] = None) -> ColumnBatch:
+    """Concatenate batches host-side-free: device concat of padded arrays.
+
+    All batches must share dictionaries for string columns (true within one
+    input stream; shuffle readers unify dictionaries on ingest).
+    """
+    batches = list(batches)
+    if not batches:
+        return ColumnBatch.empty(schema, capacity or 1024)
+    if len(batches) == 1 and (capacity is None or batches[0].capacity == capacity):
+        return batches[0]
+    cols = {f.name: jnp.concatenate([b.columns[f.name] for b in batches]) for f in schema}
+    mask = jnp.concatenate([b.mask for b in batches])
+    total_cap = int(mask.shape[0])
+    if capacity is not None and capacity < total_cap:
+        raise ValueError(
+            f"requested capacity {capacity} < combined batch capacity {total_cap}; "
+            "compact batches before concatenating to a smaller shape"
+        )
+    if capacity is not None and capacity > total_cap:
+        pad = capacity - total_cap
+        cols = {k: jnp.concatenate([v, jnp.zeros(pad, dtype=v.dtype)]) for k, v in cols.items()}
+        mask = jnp.concatenate([mask, jnp.zeros(pad, dtype=jnp.bool_)])
+    dicts = {}
+    for b in batches:
+        dicts.update(b.dicts)
+    return ColumnBatch(schema, cols, mask, dicts)

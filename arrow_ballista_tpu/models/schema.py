@@ -1,0 +1,170 @@
+"""Schema and type system for the TPU-native columnar engine.
+
+Design notes (TPU-first, not a port):
+
+The reference (arrow-ballista) leans on Arrow's type system via DataFusion.  On
+TPU every column must be a fixed-shape device array of a TPU-friendly dtype, so
+the engine narrows the type lattice to exactly the kinds XLA handles well:
+
+- ``int32`` / ``int64``  — plain integers (int64 arithmetic is emulated on TPU
+  but exact; used for keys and fixed-point money).
+- ``float32`` / ``float64`` — floats (f64 only used on CPU meshes / host).
+- ``bool`` — masks and predicates.
+- ``date32`` — days since unix epoch, stored int32.
+- ``decimal(s)`` — **fixed-point int64 scaled by 10^s**.  TPC-H money is
+  DECIMAL(15,2); storing cents in int64 makes SUM/AVG bit-exact on TPU
+  without float64 (TPU has no native f64).  Multiplication adds scales,
+  so ``price * (1 - disc)`` stays exact in integer arithmetic.
+- ``string`` — dictionary-encoded: device side is an int32 code column,
+  the dictionary (numpy array of python strings) rides along host-side.
+  TPUs don't do variable-length data; all string compute (LIKE, =, IN)
+  is evaluated once over the (small) dictionary then becomes a device
+  gather/table-lookup over codes.
+
+Parity note: plays the role of arrow/DataFusion's ``Schema``/``Field`` as used
+throughout the reference (e.g. ballista/core/src/execution_plans/shuffle_writer.rs
+relies on RecordBatch schemas); re-designed to the narrowed TPU lattice.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """A column data type. ``kind`` is one of:
+    'int32','int64','float32','float64','bool','date32','decimal','string'.
+
+    For 'decimal', ``scale`` is the number of base-10 fraction digits; the
+    physical representation is int64 with value = logical * 10**scale.
+    """
+
+    kind: str
+    scale: int = 0
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown type kind {self.kind!r}")
+        if self.kind != "decimal" and self.scale != 0:
+            raise ValueError("scale only valid for decimal")
+
+    # --- physical (device) representation -------------------------------
+    @property
+    def np_dtype(self) -> np.dtype:
+        return _NP_DTYPES[self.kind]
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in ("int32", "int64", "float32", "float64", "decimal")
+
+    @property
+    def is_integer_backed(self) -> bool:
+        return self.kind in ("int32", "int64", "date32", "decimal", "string", "bool")
+
+    @property
+    def is_float(self) -> bool:
+        return self.kind in ("float32", "float64")
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind == "string"
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.kind == "decimal"
+
+    def __str__(self):
+        return f"decimal({self.scale})" if self.is_decimal else self.kind
+
+
+_KINDS = ("int32", "int64", "float32", "float64", "bool", "date32", "decimal", "string")
+_NP_DTYPES = {
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "bool": np.dtype(np.bool_),
+    "date32": np.dtype(np.int32),
+    "decimal": np.dtype(np.int64),
+    "string": np.dtype(np.int32),  # dictionary codes
+}
+
+INT32 = DataType("int32")
+INT64 = DataType("int64")
+FLOAT32 = DataType("float32")
+FLOAT64 = DataType("float64")
+BOOL = DataType("bool")
+DATE32 = DataType("date32")
+STRING = DataType("string")
+
+
+def decimal(scale: int) -> DataType:
+    return DataType("decimal", scale)
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = False
+
+    def __str__(self):
+        return f"{self.name}: {self.dtype}"
+
+
+class Schema:
+    """An ordered list of named, typed fields."""
+
+    def __init__(self, fields: Iterable[Field]):
+        self.fields: tuple[Field, ...] = tuple(fields)
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+        if len(self._index) != len(self.fields):
+            raise ValueError("duplicate field names in schema")
+
+    # --- access ---------------------------------------------------------
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        try:
+            return self.fields[self._index[name]]
+        except KeyError:
+            raise KeyError(f"no field {name!r} in schema [{', '.join(self.names())}]") from None
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def maybe_field(self, name: str) -> Optional[Field]:
+        i = self._index.get(name)
+        return None if i is None else self.fields[i]
+
+    # --- transforms -----------------------------------------------------
+    def project(self, names: Iterable[str]) -> "Schema":
+        return Schema(self.field(n) for n in names)
+
+    def rename_prefixed(self, prefix: str) -> "Schema":
+        return Schema(Field(prefix + f.name, f.dtype, f.nullable) for f in self.fields)
+
+    def merge(self, other: "Schema") -> "Schema":
+        return Schema(list(self.fields) + list(other.fields))
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __hash__(self):
+        return hash(self.fields)
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(str(f) for f in self.fields) + ")"

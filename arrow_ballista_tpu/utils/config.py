@@ -1,0 +1,136 @@
+"""Session configuration: typed, validated key-value settings.
+
+Parity with the reference's ``BallistaConfig``
+(reference ballista/core/src/config.rs:30-192): same shape (string KV with
+typed validation + defaults, propagated client -> scheduler -> tasks), with
+TPU-specific knobs added (batch capacity, static agg/join capacities, mesh
+axis sizes) since static shapes are the engine's core discipline.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+from .errors import ConfigurationError
+
+# canonical keys (reference core/config.rs:30-39 defines the first five)
+SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
+BATCH_SIZE = "ballista.batch.size"
+JOB_NAME = "ballista.job.name"
+REPARTITION_JOINS = "ballista.repartition.joins"
+REPARTITION_AGGREGATIONS = "ballista.repartition.aggregations"
+PARQUET_PRUNING = "ballista.parquet.pruning"
+# TPU-native knobs
+AGG_CAPACITY = "ballista.agg.capacity"  # static max distinct groups per batch agg
+JOIN_OUTPUT_FACTOR = "ballista.join.output_factor"  # out_cap = factor * probe_cap
+COLLECT_STATISTICS = "ballista.collect_statistics"
+MESH_SHUFFLE = "ballista.shuffle.mesh"  # use ICI all-to-all when executors co-located on a mesh
+TASK_SLOTS = "ballista.executor.task_slots"
+
+
+@dataclasses.dataclass
+class ConfigEntry:
+    key: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str = ""
+
+
+def _parse_bool(s: str) -> bool:
+    if str(s).lower() in ("true", "1", "yes"):
+        return True
+    if str(s).lower() in ("false", "0", "no"):
+        return False
+    raise ValueError(f"not a bool: {s!r}")
+
+
+_ENTRIES: Dict[str, ConfigEntry] = {
+    e.key: e
+    for e in [
+        ConfigEntry(SHUFFLE_PARTITIONS, 16, int, "number of output partitions for shuffles"),
+        ConfigEntry(BATCH_SIZE, 1 << 17, int, "static row capacity of a device ColumnBatch"),
+        ConfigEntry(JOB_NAME, "", str, "human-readable job name"),
+        ConfigEntry(REPARTITION_JOINS, True, _parse_bool, ""),
+        ConfigEntry(REPARTITION_AGGREGATIONS, True, _parse_bool, ""),
+        ConfigEntry(PARQUET_PRUNING, True, _parse_bool, "row-group pruning on parquet scans"),
+        ConfigEntry(AGG_CAPACITY, 1 << 16, int, "static max distinct groups per aggregation"),
+        ConfigEntry(JOIN_OUTPUT_FACTOR, 2, int, "join output capacity = factor * probe capacity"),
+        ConfigEntry(COLLECT_STATISTICS, True, _parse_bool, ""),
+        ConfigEntry(MESH_SHUFFLE, False, _parse_bool, "use ICI mesh all-to-all shuffle"),
+        ConfigEntry(TASK_SLOTS, 4, int, "concurrent task slots per executor"),
+    ]
+}
+
+
+class BallistaConfig:
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings: Dict[str, Any] = {}
+        for k, v in (settings or {}).items():
+            self.set(k, v)
+
+    @staticmethod
+    def builder() -> "BallistaConfigBuilder":
+        return BallistaConfigBuilder()
+
+    def set(self, key: str, value: Any) -> None:
+        entry = _ENTRIES.get(key)
+        if entry is None:
+            raise ConfigurationError(f"unknown configuration key {key!r}")
+        if isinstance(value, str) and not isinstance(entry.default, str):
+            try:
+                value = entry.parse(value)
+            except Exception as e:
+                raise ConfigurationError(f"invalid value for {key}: {e}") from e
+        expected = type(entry.default)
+        if not isinstance(value, expected) or (expected is int and isinstance(value, bool)):
+            raise ConfigurationError(
+                f"invalid value for {key}: expected {expected.__name__}, got {type(value).__name__} ({value!r})"
+            )
+        self._settings[key] = value
+
+    def get(self, key: str) -> Any:
+        entry = _ENTRIES.get(key)
+        if entry is None:
+            raise ConfigurationError(f"unknown configuration key {key!r}")
+        return self._settings.get(key, entry.default)
+
+    # typed accessors
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def batch_size(self) -> int:
+        return self.get(BATCH_SIZE)
+
+    @property
+    def agg_capacity(self) -> int:
+        return self.get(AGG_CAPACITY)
+
+    @property
+    def join_output_factor(self) -> int:
+        return self.get(JOIN_OUTPUT_FACTOR)
+
+    @property
+    def task_slots(self) -> int:
+        return self.get(TASK_SLOTS)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: e.default for k, e in _ENTRIES.items()}
+        d.update(self._settings)
+        return d
+
+    def __repr__(self):
+        return f"BallistaConfig({self._settings})"
+
+
+class BallistaConfigBuilder:
+    def __init__(self):
+        self._settings: Dict[str, Any] = {}
+
+    def set(self, key: str, value: Any) -> "BallistaConfigBuilder":
+        self._settings[key] = value
+        return self
+
+    def build(self) -> BallistaConfig:
+        return BallistaConfig(self._settings)

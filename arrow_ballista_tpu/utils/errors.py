@@ -1,0 +1,82 @@
+"""Error taxonomy.
+
+Mirrors the reference's ``BallistaError`` retry semantics
+(reference ballista/core/src/error.rs:36-58, 228-277): the *kind* of a task
+failure decides whether the scheduler retries the task, re-runs the producer
+stage, or fails the job:
+
+- ``FetchFailedError``  -> not task-retryable, but triggers producer-stage
+  re-run (shuffle lineage recovery).
+- ``IOError`` / transient -> task retryable (counts against task attempts).
+- ``ExecutionError``    -> fatal for the job (deterministic query error).
+- ``CancelledError``    -> job/task cancellation, never retried.
+"""
+from __future__ import annotations
+
+
+class BallistaError(Exception):
+    """Base class; ``retryable`` drives scheduler retry policy."""
+
+    retryable = False
+    fail_stage = False
+
+
+class ExecutionError(BallistaError):
+    """Deterministic failure while executing a plan: fails the job."""
+
+
+class PlanningError(BallistaError):
+    """SQL/logical/physical planning failure."""
+
+
+class InternalError(BallistaError):
+    pass
+
+
+class ConfigurationError(BallistaError):
+    pass
+
+
+class IOError_(BallistaError):
+    """Transient I/O failure: the task is retried (≤ task max attempts)."""
+
+    retryable = True
+
+
+class CancelledError(BallistaError):
+    pass
+
+
+class FetchFailedError(BallistaError):
+    """A shuffle fetch from ``executor_id`` failed.
+
+    Not retryable at task level: the scheduler rolls back the consuming
+    stage and re-runs the producing map stage (reference
+    ballista/scheduler/src/state/execution_graph.rs:270-657).
+
+    This error crosses process boundaries (executor -> scheduler), so it
+    must round-trip pickling: ``args`` carries the constructor fields.
+    """
+
+    fail_stage = True
+
+    def __init__(self, executor_id: str, map_stage_id: int, map_partition_id: int, message: str = ""):
+        super().__init__(executor_id, map_stage_id, map_partition_id, message)
+        self.executor_id = executor_id
+        self.map_stage_id = map_stage_id
+        self.map_partition_id = map_partition_id
+        self.message = message
+
+    def __str__(self):
+        return (
+            f"fetch failed from executor {self.executor_id} "
+            f"(map stage {self.map_stage_id} partition {self.map_partition_id}): {self.message}"
+        )
+
+
+class CapacityError(ExecutionError):
+    """Static output capacity exceeded (join fan-out / agg groups).
+
+    The fix is a config bump (e.g. ``ballista.join.output_factor``); the
+    message says which knob.
+    """

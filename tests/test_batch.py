@@ -1,0 +1,68 @@
+import numpy as np
+import pytest
+
+from arrow_ballista_tpu import (
+    BallistaConfig,
+    ColumnBatch,
+    Field,
+    INT64,
+    STRING,
+    Schema,
+    concat_batches,
+    decimal,
+)
+from arrow_ballista_tpu.utils.errors import ConfigurationError
+
+
+def make_batch():
+    schema = Schema([
+        Field("k", INT64),
+        Field("price", decimal(2)),
+        Field("flag", STRING),
+    ])
+    data = {
+        "k": np.array([1, 2, 3], dtype=np.int64),
+        "price": np.array([1050, 2099, 399], dtype=np.int64),  # $10.50, $20.99, $3.99
+        "flag": np.array([0, 1, 0], dtype=np.int32),
+    }
+    return ColumnBatch.from_numpy(schema, data, dicts={"flag": np.array(["A", "N"], dtype=object)})
+
+
+def test_batch_roundtrip_pandas():
+    b = make_batch()
+    assert b.num_rows == 3
+    assert b.capacity >= 3
+    df = b.to_pandas()
+    assert list(df["k"]) == [1, 2, 3]
+    assert list(df["flag"]) == ["A", "N", "A"]
+    np.testing.assert_allclose(df["price"], [10.50, 20.99, 3.99])
+
+
+def test_batch_to_arrow():
+    t = make_batch().to_arrow()
+    assert t.num_rows == 3
+    assert t.column("flag").to_pylist() == ["A", "N", "A"]
+
+
+def test_concat_batches():
+    b = make_batch()
+    out = concat_batches(b.schema, [b, b])
+    assert out.num_rows == 6
+    df = out.to_pandas()
+    assert list(df["k"]) == [1, 2, 3, 1, 2, 3]
+
+
+def test_int64_preserved_through_device():
+    # x64 must be on: decimals are int64 fixed-point.
+    b = make_batch()
+    assert str(b.columns["price"].dtype) == "int64"
+
+
+def test_config_validation():
+    cfg = BallistaConfig.builder().set("ballista.shuffle.partitions", "8").build()
+    assert cfg.shuffle_partitions == 8
+    assert cfg.batch_size == 1 << 17
+    with pytest.raises(ConfigurationError):
+        BallistaConfig({"ballista.bogus": 1})
+    with pytest.raises(ConfigurationError):
+        BallistaConfig({"ballista.shuffle.partitions": "abc"})
