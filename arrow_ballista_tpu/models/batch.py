@@ -25,13 +25,13 @@ with schema + dictionaries.
 """
 from __future__ import annotations
 
-from typing import Dict, Iterable, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
 import jax.numpy as jnp
 
-from .schema import DataType, Field, Schema
+from .schema import Schema
 
 
 def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
@@ -83,7 +83,14 @@ class ColumnBatch:
         cap = capacity or round_capacity(n)
         cols = {}
         for f in schema:
-            arr = np.asarray(data[f.name], dtype=f.dtype.np_dtype)
+            raw = np.asarray(data[f.name])
+            if raw.dtype.kind == "f" and f.dtype.np_dtype.kind in ("i", "u"):
+                raise TypeError(
+                    f"column {f.name!r}: float data passed for {f.dtype} "
+                    "(int-backed); convert to the physical representation first "
+                    "(e.g. scaled int64 for decimals)"
+                )
+            arr = raw.astype(f.dtype.np_dtype, copy=False)
             cols[f.name] = jnp.asarray(_pad_to(arr, cap))
         mask = np.zeros(cap, dtype=np.bool_)
         mask[:n] = True
@@ -128,8 +135,11 @@ class ColumnBatch:
         return out
 
     def to_arrow(self):
-        """Decode to a pyarrow Table (strings/dates/decimals restored)."""
+        """Decode to a pyarrow Table with logical types restored: strings from
+        dictionaries, date32, decimal128(38, scale) from fixed-point int64."""
         import pyarrow as pa
+
+        from ..utils.errors import InternalError
 
         data = self.compacted_numpy()
         arrays, fields = [], []
@@ -137,16 +147,28 @@ class ColumnBatch:
             arr = data[f.name]
             if f.dtype.is_string:
                 dic = self.dicts.get(f.name)
-                if dic is None:
+                if dic is None or len(dic) == 0:
+                    if len(arr) and arr.max(initial=-1) >= 0:
+                        raise InternalError(
+                            f"string column {f.name!r} has live codes but no dictionary"
+                        )
                     dic = np.array([], dtype=object)
-                pa_arr = pa.DictionaryArray.from_arrays(pa.array(arr, type=pa.int32()), pa.array(dic, type=pa.string()))
+                pa_arr = pa.DictionaryArray.from_arrays(
+                    pa.array(arr, type=pa.int32()), pa.array(dic, type=pa.string())
+                )
                 fields.append(pa.field(f.name, pa_arr.type))
             elif f.dtype.kind == "date32":
                 pa_arr = pa.array(arr, type=pa.date32())
                 fields.append(pa.field(f.name, pa.date32()))
             elif f.dtype.is_decimal:
-                pa_arr = pa.array(arr, type=pa.int64())
-                fields.append(pa.field(f.name, pa.int64(), metadata={b"decimal_scale": str(f.dtype.scale).encode()}))
+                import decimal as pydec
+
+                t = pa.decimal128(38, f.dtype.scale)
+                scale_exp = -f.dtype.scale
+                pa_arr = pa.array(
+                    [pydec.Decimal(int(v)).scaleb(scale_exp) for v in arr], type=t
+                )
+                fields.append(pa.field(f.name, t))
             else:
                 pa_arr = pa.array(arr)
                 fields.append(pa.field(f.name, pa_arr.type))

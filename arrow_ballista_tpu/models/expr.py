@@ -1,0 +1,339 @@
+"""Typed logical/physical expression IR.
+
+Plays the role DataFusion's ``Expr``/``PhysicalExpr`` play for the reference
+engine (which ships logical plans as protobuf,
+reference ballista/core/proto/datafusion.proto).  TPU-first difference: the
+type lattice is the narrowed one in ``schema.py`` and typing encodes the
+fixed-point decimal discipline —
+
+- ``+``/``-`` on decimals unify scales (max), ``*`` adds scales: all exact
+  int64 on device;
+- ``/`` always yields float64 and is flagged **host-finalize**: divisions in
+  TPC-H only occur in tiny post-aggregation projections, so the device path
+  stays free of f64 (which TPU lacks natively);
+- string ops (=, LIKE, IN) over dictionary-encoded columns are typed BOOL
+  here and compiled to dictionary-lookup masks by the physical layer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from ..utils.errors import PlanningError
+from .schema import BOOL, DATE32, DataType, FLOAT64, INT32, INT64, Schema, decimal
+
+# --------------------------------------------------------------------------
+# nodes
+# --------------------------------------------------------------------------
+
+
+class Expr:
+    def dtype(self, schema: Schema) -> DataType:
+        raise NotImplementedError
+
+    def children(self) -> Sequence["Expr"]:
+        return ()
+
+    def column_refs(self) -> set:
+        out = set()
+        if isinstance(self, Column):
+            out.add(self.name)
+        for c in self.children():
+            out |= c.column_refs()
+        return out
+
+
+@dataclasses.dataclass
+class Column(Expr):
+    name: str
+
+    def dtype(self, schema: Schema) -> DataType:
+        return schema.field(self.name).dtype
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass
+class Lit(Expr):
+    value: object
+    kind: str = "auto"  # 'auto' | 'date' | 'interval_day' | 'interval_month'
+
+    def dtype(self, schema: Schema) -> DataType:
+        v = self.value
+        if self.kind == "date":
+            return DATE32
+        if self.kind in ("interval_day", "interval_month"):
+            return INT32
+        if isinstance(v, bool):
+            return BOOL
+        if isinstance(v, int):
+            return INT64
+        if isinstance(v, float):
+            return FLOAT64  # coerced against decimals at compile time
+        if isinstance(v, str):
+            return DataType("string")
+        if v is None:
+            return BOOL
+        raise PlanningError(f"untypable literal {v!r}")
+
+    def __str__(self):
+        return repr(self.value)
+
+
+_NUM_RANK = {"int32": 0, "int64": 1, "decimal": 2, "float32": 3, "float64": 4}
+
+
+def unify_arith(op: str, lt: DataType, rt: DataType) -> DataType:
+    """Result type of ``lt op rt`` under the fixed-point discipline."""
+    if op == "/":
+        return FLOAT64
+    # date arithmetic
+    if lt.kind == "date32" and rt.kind == "int32":
+        return DATE32
+    if lt.kind == "date32" and rt.kind == "date32" and op == "-":
+        return INT32
+    if not (lt.is_numeric and rt.is_numeric):
+        raise PlanningError(f"cannot apply {op} to {lt} and {rt}")
+    if lt.is_float or rt.is_float:
+        return FLOAT64
+    if lt.is_decimal or rt.is_decimal:
+        ls = lt.scale if lt.is_decimal else 0
+        rs = rt.scale if rt.is_decimal else 0
+        if op == "*":
+            return decimal(ls + rs)
+        return decimal(max(ls, rs))
+    if lt.kind == "int64" or rt.kind == "int64":
+        return INT64
+    return INT32
+
+
+@dataclasses.dataclass
+class BinOp(Expr):
+    op: str  # + - * / % = <> < <= > >= and or
+    left: Expr
+    right: Expr
+
+    COMPARISONS = ("=", "<>", "<", "<=", ">", ">=")
+    BOOLEANS = ("and", "or")
+
+    def dtype(self, schema: Schema) -> DataType:
+        if self.op in self.COMPARISONS or self.op in self.BOOLEANS:
+            return BOOL
+        return unify_arith(self.op, self.left.dtype(schema), self.right.dtype(schema))
+
+    def children(self):
+        return (self.left, self.right)
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclasses.dataclass
+class Not(Expr):
+    operand: Expr
+
+    def dtype(self, schema):
+        return BOOL
+
+    def children(self):
+        return (self.operand,)
+
+    def __str__(self):
+        return f"NOT {self.operand}"
+
+
+@dataclasses.dataclass
+class Negate(Expr):
+    operand: Expr
+
+    def dtype(self, schema):
+        return self.operand.dtype(schema)
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclasses.dataclass
+class Case(Expr):
+    whens: List[Tuple[Expr, Expr]]  # (condition, value)
+    else_: Optional[Expr]
+
+    def dtype(self, schema: Schema) -> DataType:
+        ts = [v.dtype(schema) for _, v in self.whens]
+        if self.else_ is not None:
+            ts.append(self.else_.dtype(schema))
+        out = ts[0]
+        for t in ts[1:]:
+            if t == out:
+                continue
+            out = unify_arith("+", out, t)
+        return out
+
+    def children(self):
+        cs = []
+        for c, v in self.whens:
+            cs += [c, v]
+        if self.else_ is not None:
+            cs.append(self.else_)
+        return cs
+
+
+@dataclasses.dataclass
+class Cast(Expr):
+    operand: Expr
+    to: DataType
+
+    def dtype(self, schema):
+        return self.to
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclasses.dataclass
+class InList(Expr):
+    operand: Expr
+    values: List[object]  # python literals
+    negated: bool = False
+
+    def dtype(self, schema):
+        return BOOL
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclasses.dataclass
+class Like(Expr):
+    operand: Expr
+    pattern: str  # SQL LIKE pattern with % and _
+    negated: bool = False
+
+    def dtype(self, schema):
+        return BOOL
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclasses.dataclass
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+    def dtype(self, schema):
+        return BOOL
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclasses.dataclass
+class Extract(Expr):
+    field: str  # 'year' | 'month' | 'day'
+    operand: Expr
+
+    def dtype(self, schema):
+        return INT32
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclasses.dataclass
+class Substring(Expr):
+    """Substring over a dictionary-encoded string column: evaluated on the
+    dictionary host-side, producing a new dictionary-encoded column."""
+
+    operand: Expr
+    start: int  # 1-based
+    length: Optional[int]
+
+    def dtype(self, schema):
+        return DataType("string")
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclasses.dataclass
+class ScalarSubquery(Expr):
+    """Uncorrelated scalar subquery; executed before the main job and
+    substituted as a literal (plan is a LogicalPlan, typed late)."""
+
+    plan: object  # LogicalPlan (avoid circular import)
+
+    def dtype(self, schema: Schema) -> DataType:
+        sub_schema = self.plan.schema
+        if len(sub_schema) != 1:
+            raise PlanningError("scalar subquery must return one column")
+        return sub_schema.fields[0].dtype
+
+    def __str__(self):
+        return "(<scalar subquery>)"
+
+
+AGG_FUNCS = ("sum", "min", "max", "count", "avg")
+
+
+@dataclasses.dataclass
+class Agg(Expr):
+    func: str
+    operand: Optional[Expr]  # None for count(*)
+    distinct: bool = False
+
+    def dtype(self, schema: Schema) -> DataType:
+        if self.func == "count":
+            return INT64
+        if self.operand is None:
+            raise PlanningError(f"{self.func} requires an argument")
+        t = self.operand.dtype(schema)
+        if self.func in ("min", "max"):
+            return t
+        if self.func == "sum":
+            if t.is_decimal:
+                return t
+            if t.is_float:
+                return FLOAT64
+            return INT64
+        if self.func == "avg":
+            return FLOAT64
+        raise PlanningError(f"unknown aggregate {self.func}")
+
+    def children(self):
+        return () if self.operand is None else (self.operand,)
+
+    def __str__(self):
+        return f"{self.func}({'distinct ' if self.distinct else ''}{self.operand if self.operand is not None else '*'})"
+
+
+def find_aggs(e: Expr) -> List[Agg]:
+    if isinstance(e, Agg):
+        return [e]
+    out: List[Agg] = []
+    for c in e.children():
+        out.extend(find_aggs(c))
+    return out
+
+
+def contains_agg(e: Expr) -> bool:
+    return bool(find_aggs(e))
+
+
+def conjuncts(e: Optional[Expr]) -> List[Expr]:
+    if e is None:
+        return []
+    if isinstance(e, BinOp) and e.op == "and":
+        return conjuncts(e.left) + conjuncts(e.right)
+    return [e]
+
+
+def and_all(es: Sequence[Expr]) -> Optional[Expr]:
+    es = list(es)
+    if not es:
+        return None
+    out = es[0]
+    for e in es[1:]:
+        out = BinOp("and", out, e)
+    return out

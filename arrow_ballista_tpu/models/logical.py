@@ -1,0 +1,205 @@
+"""Logical plan nodes.
+
+Equivalent in role to DataFusion's LogicalPlan as serialized by the
+reference (reference ballista/core/proto/datafusion.proto, LogicalPlanNode);
+the node set is the subset this engine plans and distributes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from ..utils.errors import PlanningError
+from .expr import Agg, Expr, and_all
+from .schema import BOOL, Field, Schema
+
+JoinType = str  # 'inner' | 'left' | 'semi' | 'anti'
+
+
+class LogicalPlan:
+    schema: Schema
+
+    def children(self) -> List["LogicalPlan"]:
+        return []
+
+    def display(self, indent: int = 0) -> str:
+        s = "  " * indent + self._label()
+        for c in self.children():
+            s += "\n" + c.display(indent + 1)
+        return s
+
+    def _label(self) -> str:
+        return type(self).__name__
+
+    def __repr__(self):
+        return self.display()
+
+
+@dataclasses.dataclass(init=False)
+class TableScan(LogicalPlan):
+    table: str
+    projection: Optional[List[str]]
+    filters: List[Expr]  # pushed-down predicates over the full table schema
+
+    def __init__(self, table: str, table_schema: Schema, projection: Optional[List[str]] = None,
+                 filters: Optional[List[Expr]] = None):
+        self.table = table
+        self.table_schema = table_schema
+        self.projection = projection
+        self.filters = filters or []
+        self.schema = table_schema if projection is None else table_schema.project(projection)
+
+    def _label(self):
+        p = f" projection={self.projection}" if self.projection is not None else ""
+        f = f" filters={[str(x) for x in self.filters]}" if self.filters else ""
+        return f"TableScan: {self.table}{p}{f}"
+
+
+@dataclasses.dataclass(init=False)
+class SubqueryAlias(LogicalPlan):
+    """Renames every output field to ``alias.field`` (plain field part kept)."""
+
+    def __init__(self, input: LogicalPlan, alias: str):
+        self.input = input
+        self.alias = alias
+        self.schema = Schema(
+            Field(f"{alias}.{f.name.split('.')[-1]}", f.dtype, f.nullable) for f in input.schema
+        )
+
+    def children(self):
+        return [self.input]
+
+    def _label(self):
+        return f"SubqueryAlias: {self.alias}"
+
+
+@dataclasses.dataclass(init=False)
+class Projection(LogicalPlan):
+    def __init__(self, input: LogicalPlan, exprs: List[Tuple[Expr, str]]):
+        self.input = input
+        self.exprs = exprs
+        self.schema = Schema(Field(name, e.dtype(input.schema)) for e, name in exprs)
+
+    def children(self):
+        return [self.input]
+
+    def _label(self):
+        return "Projection: " + ", ".join(f"{e} AS {n}" for e, n in self.exprs)
+
+
+@dataclasses.dataclass(init=False)
+class Filter(LogicalPlan):
+    def __init__(self, input: LogicalPlan, predicate: Expr):
+        if predicate.dtype(input.schema) != BOOL:
+            raise PlanningError(f"filter predicate is not boolean: {predicate}")
+        self.input = input
+        self.predicate = predicate
+        self.schema = input.schema
+
+    def children(self):
+        return [self.input]
+
+    def _label(self):
+        return f"Filter: {self.predicate}"
+
+
+@dataclasses.dataclass(init=False)
+class Aggregate(LogicalPlan):
+    def __init__(self, input: LogicalPlan, group_exprs: List[Tuple[Expr, str]],
+                 agg_exprs: List[Tuple[Agg, str]]):
+        self.input = input
+        self.group_exprs = group_exprs
+        self.agg_exprs = agg_exprs
+        fields = [Field(n, e.dtype(input.schema)) for e, n in group_exprs]
+        fields += [Field(n, a.dtype(input.schema)) for a, n in agg_exprs]
+        self.schema = Schema(fields)
+
+    def children(self):
+        return [self.input]
+
+    def _label(self):
+        g = ", ".join(f"{e}" for e, _ in self.group_exprs)
+        a = ", ".join(f"{e}" for e, _ in self.agg_exprs)
+        return f"Aggregate: groupBy=[{g}] aggr=[{a}]"
+
+
+@dataclasses.dataclass(init=False)
+class Join(LogicalPlan):
+    """Equi-join with optional residual filter.
+
+    ``on``: list of (left_expr, right_expr) equality pairs.
+    ``filter``: residual predicate over the combined schema (evaluated per
+    matched pair; for semi/anti joins it constrains matching).
+    """
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 on: List[Tuple[Expr, Expr]], join_type: JoinType = "inner",
+                 filter: Optional[Expr] = None):
+        self.left = left
+        self.right = right
+        self.on = on
+        self.join_type = join_type
+        self.filter = filter
+        if join_type in ("semi", "anti"):
+            self.schema = left.schema
+        elif join_type in ("inner", "left"):
+            self.schema = left.schema.merge(right.schema)
+        else:
+            raise PlanningError(f"unsupported join type {join_type}")
+
+    def children(self):
+        return [self.left, self.right]
+
+    def _label(self):
+        on = ", ".join(f"{l} = {r}" for l, r in self.on)
+        f = f" filter={self.filter}" if self.filter is not None else ""
+        return f"Join({self.join_type}): on=[{on}]{f}"
+
+
+@dataclasses.dataclass(init=False)
+class CrossJoin(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan):
+        self.left = left
+        self.right = right
+        self.schema = left.schema.merge(right.schema)
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclasses.dataclass(init=False)
+class Sort(LogicalPlan):
+    def __init__(self, input: LogicalPlan, keys: List[Tuple[Expr, bool]]):
+        self.input = input
+        self.keys = keys
+        self.schema = input.schema
+
+    def children(self):
+        return [self.input]
+
+    def _label(self):
+        return "Sort: " + ", ".join(f"{e} {'ASC' if asc else 'DESC'}" for e, asc in self.keys)
+
+
+@dataclasses.dataclass(init=False)
+class Limit(LogicalPlan):
+    def __init__(self, input: LogicalPlan, n: int):
+        self.input = input
+        self.n = n
+        self.schema = input.schema
+
+    def children(self):
+        return [self.input]
+
+    def _label(self):
+        return f"Limit: {self.n}"
+
+
+@dataclasses.dataclass(init=False)
+class Distinct(LogicalPlan):
+    def __init__(self, input: LogicalPlan):
+        self.input = input
+        self.schema = input.schema
+
+    def children(self):
+        return [self.input]

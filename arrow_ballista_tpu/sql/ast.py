@@ -1,0 +1,212 @@
+"""SQL abstract syntax tree.
+
+The reference outsources SQL parsing/planning to DataFusion
+(reference ballista/client/src/context.rs:358-530 calls
+``SessionContext::sql``); this engine carries its own front-end since no SQL
+library is available in the TPU image.  The grammar targets the full TPC-H
+dialect plus the usual DDL the reference client handles
+(CREATE EXTERNAL TABLE, SHOW TABLES).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+# --------------------------------------------------------------------------
+# expressions
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class ColumnRef(Node):
+    name: str
+    table: Optional[str] = None
+
+    def __str__(self):
+        return f"{self.table}.{self.name}" if self.table else self.name
+
+
+@dataclasses.dataclass
+class Literal(Node):
+    value: object  # python int/float/str/bool/None
+    kind: str = "auto"  # 'auto' | 'date' | 'interval_day' | 'interval_month'
+
+    def __str__(self):
+        return repr(self.value)
+
+
+@dataclasses.dataclass
+class BinaryOp(Node):
+    op: str  # + - * / = <> < <= > >= and or
+    left: Node
+    right: Node
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclasses.dataclass
+class UnaryOp(Node):
+    op: str  # 'not' | '-' | '+'
+    operand: Node
+
+    def __str__(self):
+        return f"({self.op} {self.operand})"
+
+
+@dataclasses.dataclass
+class FunctionCall(Node):
+    name: str  # lowercased
+    args: List[Node]
+    distinct: bool = False
+    star: bool = False  # COUNT(*)
+
+    def __str__(self):
+        inner = "*" if self.star else ", ".join(str(a) for a in self.args)
+        d = "distinct " if self.distinct else ""
+        return f"{self.name}({d}{inner})"
+
+
+@dataclasses.dataclass
+class Case(Node):
+    operand: Optional[Node]
+    whens: List[Tuple[Node, Node]]
+    else_: Optional[Node]
+
+
+@dataclasses.dataclass
+class Cast(Node):
+    expr: Node
+    type_name: str  # e.g. 'int', 'decimal(12,2)', 'date'
+
+
+@dataclasses.dataclass
+class Between(Node):
+    expr: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class InList(Node):
+    expr: Node
+    items: List[Node]
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class InSubquery(Node):
+    expr: Node
+    subquery: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class Exists(Node):
+    subquery: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class ScalarSubquery(Node):
+    subquery: "Select"
+
+
+@dataclasses.dataclass
+class Like(Node):
+    expr: Node
+    pattern: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class IsNull(Node):
+    expr: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class Extract(Node):
+    field: str  # 'year' | 'month' | 'day'
+    expr: Node
+
+
+@dataclasses.dataclass
+class Substring(Node):
+    expr: Node
+    start: Node  # 1-based
+    length: Optional[Node]
+
+
+# --------------------------------------------------------------------------
+# relations
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class TableRef(Node):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SubqueryRef(Node):
+    subquery: "Select"
+    alias: str
+
+
+@dataclasses.dataclass
+class Join(Node):
+    left: Node
+    right: Node
+    kind: str  # 'inner' | 'left' | 'right' | 'full' | 'cross'
+    condition: Optional[Node]  # ON expr
+
+
+# --------------------------------------------------------------------------
+# statements
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class OrderItem(Node):
+    expr: Node
+    ascending: bool = True
+
+
+@dataclasses.dataclass
+class Select(Node):
+    items: List[SelectItem]
+    from_: List[Node]  # list of relations (comma join); each may be a Join tree
+    where: Optional[Node] = None
+    group_by: List[Node] = dataclasses.field(default_factory=list)
+    having: Optional[Node] = None
+    order_by: List[OrderItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclasses.dataclass
+class CreateExternalTable(Node):
+    name: str
+    columns: List[Tuple[str, str]]  # (name, type_name); empty => infer
+    file_format: str  # 'csv' | 'parquet'
+    location: str
+    has_header: bool = False
+    delimiter: str = ","
+
+
+@dataclasses.dataclass
+class ShowTables(Node):
+    pass
+
+
+@dataclasses.dataclass
+class ShowColumns(Node):
+    table: str

@@ -1,0 +1,108 @@
+"""SQL lexer: text -> token stream."""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from ..utils.errors import PlanningError
+
+
+@dataclasses.dataclass
+class Token:
+    kind: str  # 'ident' | 'number' | 'string' | 'op' | 'eof'
+    value: str
+    pos: int
+
+    @property
+    def upper(self) -> str:
+        return self.value.upper()
+
+
+_TWO_CHAR_OPS = ("<=", ">=", "<>", "!=", "||")
+_ONE_CHAR_OPS = "+-*/%(),.;=<>"
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if sql.startswith("--", i):  # line comment
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise PlanningError(f"unterminated block comment at {i}")
+            i = j + 2
+            continue
+        if c == "'":  # string literal, '' escapes a quote
+            j = i + 1
+            buf = []
+            while True:
+                if j >= n:
+                    raise PlanningError(f"unterminated string literal at {i}")
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            tokens.append(Token("string", "".join(buf), i))
+            i = j + 1
+            continue
+        if c == '"':  # quoted identifier
+            j = sql.find('"', i + 1)
+            if j < 0:
+                raise PlanningError(f"unterminated quoted identifier at {i}")
+            tokens.append(Token("ident", sql[i + 1 : j], i))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            seen_exp = False
+            while j < n:
+                ch = sql[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_exp:
+                    seen_dot = True
+                    j += 1
+                elif ch in "eE" and not seen_exp and j > i:
+                    nxt = sql[j + 1] if j + 1 < n else ""
+                    if nxt.isdigit() or (nxt in "+-" and j + 2 < n and sql[j + 2].isdigit()):
+                        seen_exp = True
+                        j += 2 if nxt in "+-" else 1
+                    else:
+                        break
+                else:
+                    break
+            tokens.append(Token("number", sql[i:j], i))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            tokens.append(Token("ident", sql[i:j], i))
+            i = j
+            continue
+        two = sql[i : i + 2]
+        if two in _TWO_CHAR_OPS:
+            tokens.append(Token("op", two, i))
+            i += 2
+            continue
+        if c in _ONE_CHAR_OPS:
+            tokens.append(Token("op", c, i))
+            i += 1
+            continue
+        raise PlanningError(f"unexpected character {c!r} at position {i}")
+    tokens.append(Token("eof", "", n))
+    return tokens

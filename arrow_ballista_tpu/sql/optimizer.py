@@ -1,0 +1,201 @@
+"""Logical optimizer passes.
+
+The reference gets its optimizer from DataFusion; this engine needs only the
+two passes that matter most for a TPU scan-heavy pipeline:
+
+1. **filter pushdown into scans** — Filter(SubqueryAlias(TableScan)) folds
+   into ``TableScan.filters`` (plain column names), enabling parquet
+   row-group pruning and evaluating predicates in the scan's fused device
+   program.
+2. **column pruning** — computes required columns top-down and sets
+   ``TableScan.projection``; string columns that are never touched are
+   neither loaded nor dictionary-encoded (the expensive part on TPU).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..models import expr as E
+from ..models import logical as L
+
+
+# --------------------------------------------------------------------------
+# expression column-rename helper
+# --------------------------------------------------------------------------
+
+
+def _rename_expr(e: E.Expr, mapping: Dict[str, str]) -> E.Expr:
+    if isinstance(e, E.Column):
+        return E.Column(mapping.get(e.name, e.name))
+    from .planner import _map_children
+
+    return _map_children(e, lambda c: _rename_expr(c, mapping))
+
+
+def _expr_plans(e: E.Expr) -> List[L.LogicalPlan]:
+    """Nested plans inside an expression (scalar subqueries)."""
+    out = []
+    if isinstance(e, E.ScalarSubquery):
+        out.append(e.plan)
+    for c in e.children():
+        out.extend(_expr_plans(c))
+    return out
+
+
+def _optimize_expr_subplans(e: E.Expr) -> E.Expr:
+    if isinstance(e, E.ScalarSubquery):
+        return E.ScalarSubquery(optimize(e.plan))
+    from .planner import _map_children
+
+    return _map_children(e, _optimize_expr_subplans)
+
+
+# --------------------------------------------------------------------------
+# pass 1: filter pushdown
+# --------------------------------------------------------------------------
+
+
+def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
+    if isinstance(plan, L.Filter):
+        child = push_filters(plan.input)
+        pred = _optimize_expr_subplans(plan.predicate)
+        # merge adjacent filters
+        if isinstance(child, L.Filter):
+            pred = E.and_all([pred, child.predicate])
+            child = child.input
+        if isinstance(child, L.SubqueryAlias) and isinstance(child.input, L.TableScan):
+            scan = child.input
+            alias = child.alias
+            mapping = {f"{alias}.{f.name}": f.name for f in scan.table_schema}
+            conjs = E.conjuncts(pred)
+            pushable, kept = [], []
+            for c in conjs:
+                refs = c.column_refs()
+                if refs and all(r in mapping for r in refs) and not _expr_plans(c):
+                    pushable.append(_rename_expr(c, mapping))
+                else:
+                    kept.append(c)
+            if pushable:
+                scan = L.TableScan(scan.table, scan.table_schema, scan.projection,
+                                   scan.filters + pushable)
+                child = L.SubqueryAlias(scan, alias)
+            if kept:
+                return L.Filter(child, E.and_all(kept))
+            return child
+        return L.Filter(child, pred)
+
+    return _rebuild(plan, [push_filters(c) for c in plan.children()])
+
+
+def _rebuild(plan: L.LogicalPlan, children: List[L.LogicalPlan]) -> L.LogicalPlan:
+    if isinstance(plan, L.TableScan):
+        return plan
+    if isinstance(plan, L.SubqueryAlias):
+        return L.SubqueryAlias(children[0], plan.alias)
+    if isinstance(plan, L.Projection):
+        return L.Projection(children[0], [(_optimize_expr_subplans(e), n) for e, n in plan.exprs])
+    if isinstance(plan, L.Filter):
+        return L.Filter(children[0], plan.predicate)
+    if isinstance(plan, L.Aggregate):
+        return L.Aggregate(children[0], plan.group_exprs, plan.agg_exprs)
+    if isinstance(plan, L.Join):
+        return L.Join(children[0], children[1], plan.on, plan.join_type, plan.filter)
+    if isinstance(plan, L.CrossJoin):
+        return L.CrossJoin(children[0], children[1])
+    if isinstance(plan, L.Sort):
+        return L.Sort(children[0], plan.keys)
+    if isinstance(plan, L.Limit):
+        return L.Limit(children[0], plan.n)
+    if isinstance(plan, L.Distinct):
+        return L.Distinct(children[0])
+    raise TypeError(f"unknown plan node {type(plan).__name__}")
+
+
+# --------------------------------------------------------------------------
+# pass 2: column pruning
+# --------------------------------------------------------------------------
+
+
+def prune_columns(plan: L.LogicalPlan, required: Optional[Set[str]] = None) -> L.LogicalPlan:
+    if required is None:
+        required = {f.name for f in plan.schema}
+
+    if isinstance(plan, L.TableScan):
+        needed = [f.name for f in plan.table_schema if f.name in required]
+        for f in plan.filters:
+            for r in f.column_refs():
+                if r not in needed:
+                    needed.append(r)
+        needed = [f.name for f in plan.table_schema if f.name in set(needed)]
+        return L.TableScan(plan.table, plan.table_schema, needed, plan.filters)
+
+    if isinstance(plan, L.SubqueryAlias):
+        child_required = {r.split(".", 1)[1] for r in required if r.split(".", 1)[0] == plan.alias}
+        # qualified names on the child side may themselves be qualified
+        # (subquery outputs); match by suffix against child schema
+        child_req_full = set()
+        for f in plan.input.schema:
+            plain = f.name.split(".")[-1]
+            if plain in child_required or f.name in child_required:
+                child_req_full.add(f.name)
+        return L.SubqueryAlias(prune_columns(plan.input, child_req_full), plan.alias)
+
+    if isinstance(plan, L.Projection):
+        kept = [(e, n) for e, n in plan.exprs if n in required] or plan.exprs[:1]
+        child_req = set()
+        for e, _ in kept:
+            child_req |= e.column_refs()
+        return L.Projection(prune_columns(plan.input, child_req),
+                            [(_optimize_expr_subplans(e), n) for e, n in kept])
+
+    if isinstance(plan, L.Filter):
+        child_req = set(required) | plan.predicate.column_refs()
+        return L.Filter(prune_columns(plan.input, child_req),
+                        _optimize_expr_subplans(plan.predicate))
+
+    if isinstance(plan, L.Aggregate):
+        child_req = set()
+        for e, _ in plan.group_exprs:
+            child_req |= e.column_refs()
+        for a, _ in plan.agg_exprs:
+            child_req |= a.column_refs()
+        return L.Aggregate(prune_columns(plan.input, child_req), plan.group_exprs, plan.agg_exprs)
+
+    if isinstance(plan, (L.Join, L.CrossJoin)):
+        lschema = {f.name for f in plan.left.schema}
+        rschema = {f.name for f in plan.right.schema}
+        lreq = {r for r in required if r in lschema}
+        rreq = {r for r in required if r in rschema}
+        if isinstance(plan, L.Join):
+            for le, re_ in plan.on:
+                lreq |= {r for r in le.column_refs() if r in lschema}
+                rreq |= {r for r in le.column_refs() if r in rschema}
+                lreq |= {r for r in re_.column_refs() if r in lschema}
+                rreq |= {r for r in re_.column_refs() if r in rschema}
+            if plan.filter is not None:
+                for r in plan.filter.column_refs():
+                    (lreq if r in lschema else rreq).add(r)
+            left = prune_columns(plan.left, lreq)
+            right = prune_columns(plan.right, rreq)
+            return L.Join(left, right, plan.on, plan.join_type, plan.filter)
+        return L.CrossJoin(prune_columns(plan.left, lreq), prune_columns(plan.right, rreq))
+
+    if isinstance(plan, L.Sort):
+        child_req = set(required)
+        for e, _ in plan.keys:
+            child_req |= e.column_refs()
+        return L.Sort(prune_columns(plan.input, child_req), plan.keys)
+
+    if isinstance(plan, L.Limit):
+        return L.Limit(prune_columns(plan.input, required), plan.n)
+
+    if isinstance(plan, L.Distinct):
+        return L.Distinct(prune_columns(plan.input, {f.name for f in plan.schema}))
+
+    raise TypeError(f"unknown plan node {type(plan).__name__}")
+
+
+def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
+    plan = push_filters(plan)
+    plan = prune_columns(plan)
+    return plan

@@ -1,0 +1,492 @@
+"""Recursive-descent SQL parser producing the AST in ``ast.py``.
+
+Covers the dialect TPC-H needs (the reference's benchmark surface,
+reference benchmarks/queries/q1.sql..q22.sql) plus the client-side DDL the
+reference handles itself (CREATE EXTERNAL TABLE / SHOW TABLES,
+reference ballista/client/src/context.rs:358-530).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..utils.errors import PlanningError
+from . import ast
+from .lexer import Token, tokenize
+
+_RESERVED = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
+    "AS", "ON", "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS",
+    "AND", "OR", "NOT", "IN", "EXISTS", "BETWEEN", "LIKE", "IS", "NULL",
+    "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "EXTRACT", "SUBSTRING",
+    "DISTINCT", "ASC", "DESC", "UNION", "ALL", "DATE", "INTERVAL", "TRUE", "FALSE",
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.pos = 0
+
+    # --- token helpers --------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.pos + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.pos]
+        if t.kind != "eof":
+            self.pos += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.eat_kw(kw):
+            t = self.peek()
+            raise PlanningError(f"expected {kw}, found {t.value!r} at {t.pos}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def eat_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.eat_op(op):
+            t = self.peek()
+            raise PlanningError(f"expected {op!r}, found {t.value!r} at {t.pos}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind != "ident":
+            raise PlanningError(f"expected identifier, found {t.value!r} at {t.pos}")
+        self.next()
+        return t.value
+
+    # --- entry ----------------------------------------------------------
+    def parse_statement(self) -> ast.Node:
+        if self.at_kw("SELECT"):
+            stmt = self.parse_select()
+        elif self.at_kw("CREATE"):
+            stmt = self.parse_create_external_table()
+        elif self.at_kw("SHOW"):
+            stmt = self.parse_show()
+        else:
+            t = self.peek()
+            raise PlanningError(f"unsupported statement starting with {t.value!r}")
+        self.eat_op(";")
+        t = self.peek()
+        if t.kind != "eof":
+            raise PlanningError(f"unexpected trailing input {t.value!r} at {t.pos}")
+        return stmt
+
+    # --- SELECT ---------------------------------------------------------
+    def parse_select(self) -> ast.Select:
+        self.expect_kw("SELECT")
+        distinct = self.eat_kw("DISTINCT")
+        self.eat_kw("ALL")
+        items = [self.parse_select_item()]
+        while self.eat_op(","):
+            items.append(self.parse_select_item())
+
+        from_: List[ast.Node] = []
+        if self.eat_kw("FROM"):
+            from_.append(self.parse_relation())
+            while self.eat_op(","):
+                from_.append(self.parse_relation())
+
+        where = self.parse_expr() if self.eat_kw("WHERE") else None
+
+        group_by: List[ast.Node] = []
+        if self.eat_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.parse_expr())
+            while self.eat_op(","):
+                group_by.append(self.parse_expr())
+
+        having = self.parse_expr() if self.eat_kw("HAVING") else None
+
+        order_by: List[ast.OrderItem] = []
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self.parse_order_item())
+            while self.eat_op(","):
+                order_by.append(self.parse_order_item())
+
+        limit = None
+        if self.eat_kw("LIMIT"):
+            t = self.next()
+            if t.kind != "number":
+                raise PlanningError(f"expected number after LIMIT, found {t.value!r}")
+            limit = int(t.value)
+
+        return ast.Select(items, from_, where, group_by, having, order_by, limit, distinct)
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.ColumnRef("*"))
+        # qualified star: t.*
+        if (
+            self.peek().kind == "ident"
+            and self.peek().upper not in _RESERVED
+            and self.peek(1).kind == "op"
+            and self.peek(1).value == "."
+            and self.peek(2).kind == "op"
+            and self.peek(2).value == "*"
+        ):
+            table = self.ident()
+            self.next()
+            self.next()
+            return ast.SelectItem(ast.ColumnRef("*", table))
+        expr = self.parse_expr()
+        alias = None
+        if self.eat_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind == "ident" and self.peek().upper not in _RESERVED:
+            alias = self.ident()
+        return ast.SelectItem(expr, alias)
+
+    def parse_order_item(self) -> ast.OrderItem:
+        expr = self.parse_expr()
+        asc = True
+        if self.eat_kw("DESC"):
+            asc = False
+        else:
+            self.eat_kw("ASC")
+        return ast.OrderItem(expr, asc)
+
+    # --- relations ------------------------------------------------------
+    def parse_relation(self) -> ast.Node:
+        rel = self.parse_primary_relation()
+        while True:
+            kind = None
+            if self.eat_kw("CROSS"):
+                self.expect_kw("JOIN")
+                kind = "cross"
+            elif self.eat_kw("INNER"):
+                self.expect_kw("JOIN")
+                kind = "inner"
+            elif self.at_kw("LEFT", "RIGHT", "FULL"):
+                kind = self.next().value.lower()
+                self.eat_kw("OUTER")
+                self.expect_kw("JOIN")
+            elif self.eat_kw("JOIN"):
+                kind = "inner"
+            else:
+                break
+            right = self.parse_primary_relation()
+            condition = None
+            if kind != "cross":
+                self.expect_kw("ON")
+                condition = self.parse_expr()
+            rel = ast.Join(rel, right, kind, condition)
+        return rel
+
+    def parse_primary_relation(self) -> ast.Node:
+        if self.at_op("("):
+            self.next()
+            sub = self.parse_select()
+            self.expect_op(")")
+            self.eat_kw("AS")
+            alias = self.ident()
+            return ast.SubqueryRef(sub, alias)
+        name = self.ident()
+        alias = None
+        if self.eat_kw("AS"):
+            alias = self.ident()
+        elif self.peek().kind == "ident" and self.peek().upper not in _RESERVED:
+            alias = self.ident()
+        return ast.TableRef(name, alias)
+
+    # --- expressions (precedence climbing) ------------------------------
+    def parse_expr(self) -> ast.Node:
+        return self.parse_or()
+
+    def parse_or(self) -> ast.Node:
+        left = self.parse_and()
+        while self.eat_kw("OR"):
+            left = ast.BinaryOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> ast.Node:
+        left = self.parse_not()
+        while self.eat_kw("AND"):
+            left = ast.BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> ast.Node:
+        if self.eat_kw("NOT"):
+            return ast.UnaryOp("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> ast.Node:
+        left = self.parse_additive()
+        while True:
+            negated = False
+            if self.at_kw("NOT") and self.peek(1).kind == "ident" and self.peek(1).upper in ("IN", "BETWEEN", "LIKE"):
+                self.next()
+                negated = True
+            if self.eat_kw("BETWEEN"):
+                low = self.parse_additive()
+                self.expect_kw("AND")
+                high = self.parse_additive()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if self.eat_kw("IN"):
+                self.expect_op("(")
+                if self.at_kw("SELECT"):
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, sub, negated)
+                else:
+                    items = [self.parse_expr()]
+                    while self.eat_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, items, negated)
+                continue
+            if self.eat_kw("LIKE"):
+                left = ast.Like(left, self.parse_additive(), negated)
+                continue
+            if negated:
+                raise PlanningError("dangling NOT in predicate")
+            if self.eat_kw("IS"):
+                neg = self.eat_kw("NOT")
+                self.expect_kw("NULL")
+                left = ast.IsNull(left, neg)
+                continue
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.next().value
+                if op == "!=":
+                    op = "<>"
+                right = self.parse_additive()
+                left = ast.BinaryOp(op, left, right)
+                continue
+            return left
+
+    def parse_additive(self) -> ast.Node:
+        left = self.parse_multiplicative()
+        while self.at_op("+", "-"):
+            op = self.next().value
+            left = ast.BinaryOp(op, left, self.parse_multiplicative())
+        return left
+
+    def parse_multiplicative(self) -> ast.Node:
+        left = self.parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            left = ast.BinaryOp(op, left, self.parse_unary())
+        return left
+
+    def parse_unary(self) -> ast.Node:
+        if self.at_op("-", "+"):
+            op = self.next().value
+            return ast.UnaryOp(op, self.parse_unary())
+        return self.parse_primary()
+
+    def parse_primary(self) -> ast.Node:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            text = t.value
+            if "." in text or "e" in text.lower():
+                return ast.Literal(float(text))
+            return ast.Literal(int(text))
+        if t.kind == "string":
+            self.next()
+            return ast.Literal(t.value)
+        if self.at_op("("):
+            self.next()
+            if self.at_kw("SELECT"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.ScalarSubquery(sub)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind != "ident":
+            raise PlanningError(f"unexpected token {t.value!r} at {t.pos}")
+
+        kw = t.upper
+        if kw == "DATE":
+            self.next()
+            lit = self.next()
+            if lit.kind != "string":
+                raise PlanningError("expected string after DATE")
+            return ast.Literal(lit.value, kind="date")
+        if kw == "INTERVAL":
+            self.next()
+            lit = self.next()
+            if lit.kind != "string":
+                raise PlanningError("expected string after INTERVAL")
+            unit = self.ident().lower()
+            qty = int(lit.value)
+            if unit in ("day", "days"):
+                return ast.Literal(qty, kind="interval_day")
+            if unit in ("month", "months"):
+                return ast.Literal(qty, kind="interval_month")
+            if unit in ("year", "years"):
+                return ast.Literal(qty * 12, kind="interval_month")
+            raise PlanningError(f"unsupported interval unit {unit!r}")
+        if kw in ("TRUE", "FALSE"):
+            self.next()
+            return ast.Literal(kw == "TRUE")
+        if kw == "NULL":
+            self.next()
+            return ast.Literal(None)
+        if kw == "CASE":
+            return self.parse_case()
+        if kw == "CAST":
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("AS")
+            type_name = self.parse_type_name()
+            self.expect_op(")")
+            return ast.Cast(e, type_name)
+        if kw == "EXTRACT":
+            self.next()
+            self.expect_op("(")
+            field = self.ident().lower()
+            self.expect_kw("FROM")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ast.Extract(field, e)
+        if kw == "SUBSTRING":
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            if self.eat_kw("FROM"):
+                start = self.parse_expr()
+                length = self.parse_expr() if self.eat_kw("FOR") else None
+            else:
+                self.expect_op(",")
+                start = self.parse_expr()
+                length = self.parse_expr() if self.eat_op(",") else None
+            self.expect_op(")")
+            return ast.Substring(e, start, length)
+        if kw == "EXISTS":
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return ast.Exists(sub)
+        if kw == "NOT" and self.peek(1).kind == "ident" and self.peek(1).upper == "EXISTS":
+            self.next()
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return ast.Exists(sub, negated=True)
+
+        # function call or column reference
+        if kw in _RESERVED:
+            raise PlanningError(f"unexpected keyword {t.value!r} at {t.pos}")
+        name = self.ident()
+        if self.at_op("(") :
+            self.next()
+            distinct = self.eat_kw("DISTINCT")
+            if self.at_op("*"):
+                self.next()
+                self.expect_op(")")
+                return ast.FunctionCall(name.lower(), [], star=True)
+            args: List[ast.Node] = []
+            if not self.at_op(")"):
+                args.append(self.parse_expr())
+                while self.eat_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FunctionCall(name.lower(), args, distinct=distinct)
+        if self.eat_op("."):
+            col = self.ident()
+            return ast.ColumnRef(col, table=name)
+        return ast.ColumnRef(name)
+
+    def parse_case(self) -> ast.Node:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expr()
+        whens = []
+        while self.eat_kw("WHEN"):
+            cond = self.parse_expr()
+            self.expect_kw("THEN")
+            whens.append((cond, self.parse_expr()))
+        else_ = self.parse_expr() if self.eat_kw("ELSE") else None
+        self.expect_kw("END")
+        if not whens:
+            raise PlanningError("CASE requires at least one WHEN")
+        return ast.Case(operand, whens, else_)
+
+    def parse_type_name(self) -> str:
+        name = self.ident().lower()
+        if self.at_op("("):
+            self.next()
+            parts = [self.next().value]
+            while self.eat_op(","):
+                parts.append(self.next().value)
+            self.expect_op(")")
+            return f"{name}({','.join(parts)})"
+        return name
+
+    # --- DDL ------------------------------------------------------------
+    def parse_create_external_table(self) -> ast.CreateExternalTable:
+        self.expect_kw("CREATE")
+        self.expect_kw("EXTERNAL")
+        self.expect_kw("TABLE")
+        name = self.ident()
+        columns = []
+        if self.at_op("("):
+            self.next()
+            while not self.at_op(")"):
+                col = self.ident()
+                type_name = self.parse_type_name()
+                columns.append((col, type_name))
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+        self.expect_kw("STORED")
+        self.expect_kw("AS")
+        file_format = self.ident().lower()
+        has_header = False
+        delimiter = ","
+        while True:
+            if self.eat_kw("WITH"):
+                self.expect_kw("HEADER")
+                self.expect_kw("ROW")
+                has_header = True
+            elif self.eat_kw("DELIMITER"):
+                t = self.next()
+                delimiter = t.value
+            else:
+                break
+        self.expect_kw("LOCATION")
+        loc = self.next()
+        if loc.kind != "string":
+            raise PlanningError("expected string path after LOCATION")
+        return ast.CreateExternalTable(name, columns, file_format, loc.value, has_header, delimiter)
+
+    def parse_show(self) -> ast.Node:
+        self.expect_kw("SHOW")
+        if self.eat_kw("TABLES"):
+            return ast.ShowTables()
+        if self.eat_kw("COLUMNS"):
+            self.expect_kw("FROM")
+            return ast.ShowColumns(self.ident())
+        raise PlanningError("expected SHOW TABLES or SHOW COLUMNS")
+
+
+def parse_sql(sql: str) -> ast.Node:
+    return Parser(sql).parse_statement()

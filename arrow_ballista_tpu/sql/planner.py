@@ -1,0 +1,852 @@
+"""SQL AST -> LogicalPlan.
+
+The reference delegates this to DataFusion's SqlToRel; this is our own,
+covering the TPC-H dialect: comma-join FROM lists with WHERE-derived join
+graphs, explicit JOIN..ON, grouped aggregation with HAVING, subqueries
+(IN/EXISTS -> semi/anti joins, uncorrelated scalars, correlated scalar
+aggregates decorrelated into group-by + join).
+
+Internal naming discipline: every relation gets an alias; every column is
+internally ``alias.column``.  Unqualified references resolve by unique
+suffix match.  Output projection restores user-facing names.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..models import expr as E
+from ..models import logical as L
+from ..models.schema import DataType, Schema, decimal as decimal_t
+from ..utils.errors import PlanningError
+from . import ast
+
+
+class Catalog:
+    """Anything that can resolve table names to schemas."""
+
+    def table_schema(self, name: str) -> Schema:
+        raise NotImplementedError
+
+    def table_names(self) -> List[str]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class OuterColumn(E.Expr):
+    """A reference to a column of the enclosing query (correlation marker);
+    must be rewritten away (into join keys) before physical planning."""
+
+    name: str
+
+    def dtype(self, schema):
+        raise PlanningError(f"unresolved correlated reference {self.name}")
+
+    def __str__(self):
+        return f"outer({self.name})"
+
+
+def _is_outer_free(e: E.Expr) -> bool:
+    if isinstance(e, OuterColumn):
+        return False
+    return all(_is_outer_free(c) for c in e.children())
+
+
+def _outer_refs(e: E.Expr) -> List[str]:
+    out = []
+    if isinstance(e, OuterColumn):
+        out.append(e.name)
+    for c in e.children():
+        out.extend(_outer_refs(c))
+    return out
+
+
+def _strip_outer(e: E.Expr) -> E.Expr:
+    """Replace OuterColumn markers with plain Columns (used once the outer
+    plan's schema is merged into scope, e.g. inside a join residual filter)."""
+    if isinstance(e, OuterColumn):
+        return E.Column(e.name)
+    return _map_children(e, _strip_outer)
+
+
+def _map_children(e: E.Expr, f) -> E.Expr:
+    if isinstance(e, E.BinOp):
+        return E.BinOp(e.op, f(e.left), f(e.right))
+    if isinstance(e, E.Not):
+        return E.Not(f(e.operand))
+    if isinstance(e, E.Negate):
+        return E.Negate(f(e.operand))
+    if isinstance(e, E.Case):
+        return E.Case([(f(c), f(v)) for c, v in e.whens], None if e.else_ is None else f(e.else_))
+    if isinstance(e, E.Cast):
+        return E.Cast(f(e.operand), e.to)
+    if isinstance(e, E.InList):
+        return E.InList(f(e.operand), e.values, e.negated)
+    if isinstance(e, E.Like):
+        return E.Like(f(e.operand), e.pattern, e.negated)
+    if isinstance(e, E.IsNull):
+        return E.IsNull(f(e.operand), e.negated)
+    if isinstance(e, E.Extract):
+        return E.Extract(e.field, f(e.operand))
+    if isinstance(e, E.Substring):
+        return E.Substring(f(e.operand), e.start, e.length)
+    if isinstance(e, E.Agg):
+        return E.Agg(e.func, None if e.operand is None else f(e.operand), e.distinct)
+    return e
+
+
+def substitute(e: E.Expr, mapping: Dict) -> E.Expr:
+    """Structurally replace subtrees: mapping is {expr_repr: replacement}."""
+    key = _expr_key(e)
+    if key in mapping:
+        return mapping[key]
+    return _map_children(e, lambda c: substitute(c, mapping))
+
+
+def _expr_key(e: E.Expr) -> str:
+    return f"{type(e).__name__}:{e}"
+
+
+# --------------------------------------------------------------------------
+# scopes
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class Relation:
+    alias: str
+    plan: L.LogicalPlan  # schema fields are 'alias.col'
+
+    @property
+    def plain_cols(self) -> List[str]:
+        return [f.name.split(".", 1)[1] for f in self.plan.schema]
+
+
+class Scope:
+    def __init__(self, relations: Sequence[Relation], outer: Optional["Scope"] = None):
+        self.relations = list(relations)
+        self.outer = outer
+
+    def resolve(self, name: str, table: Optional[str]) -> E.Expr:
+        hits = []
+        for rel in self.relations:
+            if table is not None and rel.alias != table:
+                continue
+            if name in rel.plain_cols:
+                hits.append(f"{rel.alias}.{name}")
+        if len(hits) == 1:
+            return E.Column(hits[0])
+        if len(hits) > 1:
+            raise PlanningError(f"ambiguous column reference {table + '.' if table else ''}{name}: {hits}")
+        if self.outer is not None:
+            resolved = self.outer.resolve(name, table)
+            if isinstance(resolved, OuterColumn):
+                return resolved
+            if isinstance(resolved, E.Column):
+                return OuterColumn(resolved.name)
+            raise PlanningError(f"cannot correlate through expression for {name}")
+        raise PlanningError(f"column not found: {table + '.' if table else ''}{name}")
+
+    def relation_of(self, qualified: str) -> Optional[str]:
+        alias = qualified.split(".", 1)[0]
+        for rel in self.relations:
+            if rel.alias == alias:
+                return alias
+        return None
+
+
+# --------------------------------------------------------------------------
+# planner
+# --------------------------------------------------------------------------
+_TYPE_NAMES = {
+    "int": DataType("int32"), "integer": DataType("int32"),
+    "bigint": DataType("int64"), "smallint": DataType("int32"),
+    "float": DataType("float32"), "real": DataType("float32"),
+    "double": DataType("float64"),
+    "boolean": DataType("bool"), "bool": DataType("bool"),
+    "date": DataType("date32"),
+    "varchar": DataType("string"), "char": DataType("string"),
+    "text": DataType("string"), "string": DataType("string"),
+}
+
+
+def parse_type_name(name: str) -> DataType:
+    base = name.split("(")[0].strip().lower()
+    if base in ("decimal", "numeric"):
+        if "(" in name:
+            args = name[name.index("(") + 1 : name.rindex(")")].split(",")
+            scale = int(args[1]) if len(args) > 1 else 0
+        else:
+            scale = 2
+        return decimal_t(scale)
+    if base in ("varchar", "char"):
+        return DataType("string")
+    t = _TYPE_NAMES.get(base)
+    if t is None:
+        raise PlanningError(f"unsupported type name {name!r}")
+    return t
+
+
+class SqlToRel:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self._gen = 0
+
+    def _fresh(self, prefix: str) -> str:
+        self._gen += 1
+        return f"__{prefix}{self._gen}"
+
+    # --- entry ----------------------------------------------------------
+    def plan(self, stmt: ast.Node) -> L.LogicalPlan:
+        if isinstance(stmt, ast.Select):
+            return self.plan_select(stmt, None)
+        raise PlanningError(f"cannot plan {type(stmt).__name__}")
+
+    # --- SELECT core ----------------------------------------------------
+    def plan_select(self, sel: ast.Select, outer: Optional[Scope]) -> L.LogicalPlan:
+        plan, scope = self._plan_from_where(sel, outer)
+
+        # aggregate detection
+        select_exprs: List[Tuple[E.Expr, str]] = []
+        used_names: Dict[str, int] = {}
+        for i, item in enumerate(sel.items):
+            if isinstance(item.expr, ast.ColumnRef) and item.expr.name == "*":
+                rels = [r for r in scope.relations if item.expr.table in (None, r.alias)]
+                if not rels:
+                    raise PlanningError(f"unknown relation {item.expr.table} in {item.expr.table}.*")
+                for rel in rels:
+                    for f in rel.plan.schema:
+                        select_exprs.append((E.Column(f.name), f.name.split(".", 1)[1]))
+                continue
+            e = self.resolve_expr(item.expr, scope)
+            name = item.alias or self._display_name(item.expr, i)
+            if name in used_names:
+                used_names[name] += 1
+                name = f"{name}_{used_names[name]}"
+            else:
+                used_names[name] = 0
+            select_exprs.append((e, name))
+
+        having_expr = self.resolve_expr(sel.having, scope) if sel.having is not None else None
+        group_exprs = [self._resolve_group_expr(g, scope, sel, select_exprs) for g in sel.group_by]
+
+        order_keys: List[Tuple[E.Expr, bool]] = []  # resolved later against output
+        has_aggs = (
+            any(E.contains_agg(e) for e, _ in select_exprs)
+            or (having_expr is not None and E.contains_agg(having_expr))
+            or bool(group_exprs)
+        )
+
+        if has_aggs:
+            plan, select_exprs, having_expr = self._plan_aggregate(
+                plan, select_exprs, group_exprs, having_expr
+            )
+
+        if having_expr is not None:
+            plan = L.Filter(plan, having_expr)
+
+        # final projection to user-facing names
+        plan = L.Projection(plan, select_exprs)
+
+        if sel.distinct:
+            plan = L.Distinct(plan)
+
+        # ORDER BY: resolve against output schema (aliases/positions), falling
+        # back to input expressions resolved in the pre-projection scope.
+        if sel.order_by:
+            out_schema = plan.schema
+            for oi in sel.order_by:
+                if isinstance(oi.expr, ast.Literal) and isinstance(oi.expr.value, int):
+                    idx = oi.expr.value - 1
+                    if not (0 <= idx < len(out_schema)):
+                        raise PlanningError(f"ORDER BY position {oi.expr.value} out of range")
+                    order_keys.append((E.Column(out_schema.fields[idx].name), oi.ascending))
+                    continue
+                if isinstance(oi.expr, ast.ColumnRef) and oi.expr.table is None and oi.expr.name in out_schema:
+                    order_keys.append((E.Column(oi.expr.name), oi.ascending))
+                    continue
+                # expression over output columns (e.g. ORDER BY qualified name
+                # that the projection renamed): try matching a projected expr
+                e = self.resolve_expr(oi.expr, scope)
+                matched = None
+                for pe, name in select_exprs:
+                    if _expr_key(pe) == _expr_key(e):
+                        matched = E.Column(name)
+                        break
+                if matched is None:
+                    raise PlanningError(f"ORDER BY expression {oi.expr} is not in the select list")
+                order_keys.append((matched, oi.ascending))
+            plan = L.Sort(plan, order_keys)
+
+        if sel.limit is not None:
+            plan = L.Limit(plan, sel.limit)
+        return plan
+
+    # --- FROM/WHERE -> join tree ---------------------------------------
+    def _plan_from_where(self, sel: ast.Select, outer: Optional[Scope]) -> Tuple[L.LogicalPlan, Scope]:
+        relations: List[Relation] = []
+        for rel_ast in sel.from_:
+            relations.extend(self._plan_relation(rel_ast, outer))
+        if not relations:
+            raise PlanningError("SELECT without FROM is not supported")
+        scope = Scope(self._flat(relations), outer)
+
+        plan, handled = self._build_join_tree(sel, relations, scope)
+        return plan, scope
+
+    def _plan_relation(self, rel: ast.Node, outer: Optional[Scope]) -> List[Relation]:
+        """Returns the relation list; Join nodes are planned into a single
+        pre-joined Relation (wrapped), comma relations stay separate."""
+        if isinstance(rel, ast.TableRef):
+            schema = self.catalog.table_schema(rel.name)
+            alias = rel.alias or rel.name
+            plan = L.SubqueryAlias(L.TableScan(rel.name, schema), alias)
+            return [Relation(alias, plan)]
+        if isinstance(rel, ast.SubqueryRef):
+            sub = self.plan_select(rel.subquery, None)
+            plan = L.SubqueryAlias(sub, rel.alias)
+            return [Relation(rel.alias, plan)]
+        if isinstance(rel, ast.Join):
+            left = self._plan_relation(rel.left, outer)
+            right = self._plan_relation(rel.right, outer)
+            scope = Scope(left + right, outer)
+            lplan = self._combine_cross(left)
+            rplan = self._combine_cross(right)
+            if rel.kind == "cross":
+                joined = L.CrossJoin(lplan, rplan)
+            else:
+                on_pairs, residual = [], []
+                for c in E.conjuncts(self.resolve_expr(rel.condition, scope)):
+                    pair = self._as_equi_pair(c, lplan.schema, rplan.schema)
+                    if pair is not None:
+                        on_pairs.append(pair)
+                    else:
+                        residual.append(c)
+                jt = rel.kind if rel.kind in ("inner", "left") else None
+                if jt is None:
+                    raise PlanningError(f"unsupported join type {rel.kind}")
+                if not on_pairs:
+                    raise PlanningError(f"non-equi {rel.kind} join not supported: {rel.condition}")
+                joined = L.Join(lplan, rplan, on_pairs, jt, E.and_all(residual))
+            alias = self._fresh("join")
+            merged = Relation(alias, joined)
+            # the joined relation keeps original qualified names; expose the
+            # member aliases for resolution by returning a composite Relation
+            return [_CompositeRelation([*left, *right], joined)]
+        raise PlanningError(f"unsupported relation {type(rel).__name__}")
+
+    @staticmethod
+    def _combine_cross(rels: List[Relation]) -> L.LogicalPlan:
+        plan = rels[0].plan
+        for r in rels[1:]:
+            plan = L.CrossJoin(plan, r.plan)
+        return plan
+
+    def _build_join_tree(self, sel: ast.Select, relations: List[Relation], scope: Scope):
+        """Comma-join FROM list + WHERE -> filters, equi-join graph, and
+        subquery predicates; greedy left-deep join in FROM order (the
+        reference gets this from DataFusion's planner; TPC-H queries list
+        relations in a joinable order)."""
+        where = self.resolve_expr(sel.where, scope) if sel.where is not None else None
+        conjs = E.conjuncts(where)
+
+        single_rel_filters: Dict[str, List[E.Expr]] = {}
+        join_edges: List[Tuple[str, str, E.Expr, E.Expr]] = []  # (relA, relB, exprA, exprB)
+        post_filters: List[E.Expr] = []
+        subquery_preds: List[E.Expr] = []
+
+        for c in conjs:
+            if self._contains_subquery(c):
+                subquery_preds.append(c)
+                continue
+            refs = c.column_refs()
+            outer_free = _is_outer_free(c)
+            rels = {r.split(".", 1)[0] for r in refs}
+            rels = {a for a in rels if any(rel.alias == a for rel in self._flat(relations))}
+            if not outer_free:
+                # correlated conjunct at this level only occurs inside
+                # EXISTS-style subplans, handled by the caller
+                post_filters.append(c)
+                continue
+            if len(rels) == 1:
+                single_rel_filters.setdefault(next(iter(rels)), []).append(c)
+            elif len(rels) == 2:
+                pair = self._as_equi_pair_by_alias(c)
+                if pair is not None:
+                    join_edges.append(pair)
+                else:
+                    post_filters.append(c)
+            else:
+                post_filters.append(c)
+
+        # apply single-relation filters
+        plans: Dict[str, L.LogicalPlan] = {}
+        flat = self._flat(relations)
+        group_of: Dict[str, int] = {}
+        groups: List[List[str]] = []
+        for rel in relations:
+            members = rel.members if isinstance(rel, _CompositeRelation) else [rel]
+            gi = len(groups)
+            groups.append([m.alias for m in members])
+            base = rel.plan
+            member_filters: List[E.Expr] = []
+            for m in members:
+                group_of[m.alias] = gi
+                member_filters.extend(single_rel_filters.pop(m.alias, []))
+            if member_filters:
+                base = L.Filter(base, E.and_all(member_filters))
+            plans[f"g{gi}"] = base
+
+        # greedy left-deep join over groups
+        joined_groups = [0]
+        plan = plans["g0"]
+        remaining = list(range(1, len(groups)))
+        edges = list(join_edges)
+        while remaining:
+            progressed = False
+            for gi in list(remaining):
+                pairs, rest_edges = [], []
+                for (a, b, ea, eb) in edges:
+                    ga, gb = group_of[a], group_of[b]
+                    if ga in joined_groups and gb == gi:
+                        pairs.append((ea, eb))
+                    elif gb in joined_groups and ga == gi:
+                        pairs.append((eb, ea))
+                    else:
+                        rest_edges.append((a, b, ea, eb))
+                if pairs:
+                    plan = L.Join(plan, plans[f"g{gi}"], pairs, "inner")
+                    edges = rest_edges
+                    joined_groups.append(gi)
+                    remaining.remove(gi)
+                    progressed = True
+                    break
+            if not progressed:
+                gi = remaining.pop(0)
+                plan = L.CrossJoin(plan, plans[f"g{gi}"])
+                joined_groups.append(gi)
+        if edges:
+            # edges that became intra-plan after later joins -> filters
+            for (a, b, ea, eb) in edges:
+                post_filters.append(E.BinOp("=", ea, eb))
+
+        for pred in subquery_preds:
+            plan = self._apply_subquery_pred(plan, pred, scope)
+
+        if post_filters:
+            plan = L.Filter(plan, E.and_all(post_filters))
+        return plan, True
+
+    @staticmethod
+    def _flat(relations: List[Relation]) -> List[Relation]:
+        out = []
+        for r in relations:
+            out.extend(r.members if isinstance(r, _CompositeRelation) else [r])
+        return out
+
+    def _as_equi_pair_by_alias(self, c: E.Expr):
+        if isinstance(c, E.BinOp) and c.op == "=":
+            lrefs, rrefs = c.left.column_refs(), c.right.column_refs()
+            lrels = {r.split(".", 1)[0] for r in lrefs}
+            rrels = {r.split(".", 1)[0] for r in rrefs}
+            if len(lrels) == 1 and len(rrels) == 1 and lrels != rrels:
+                return (next(iter(lrels)), next(iter(rrels)), c.left, c.right)
+        return None
+
+    @staticmethod
+    def _as_equi_pair(c: E.Expr, lschema: Schema, rschema: Schema):
+        if isinstance(c, E.BinOp) and c.op == "=":
+            lrefs, rrefs = c.left.column_refs(), c.right.column_refs()
+            if lrefs and rrefs:
+                if all(r in lschema for r in lrefs) and all(r in rschema for r in rrefs):
+                    return (c.left, c.right)
+                if all(r in rschema for r in lrefs) and all(r in lschema for r in rrefs):
+                    return (c.right, c.left)
+        return None
+
+    # --- subquery predicates -------------------------------------------
+    @staticmethod
+    def _contains_subquery(e: E.Expr) -> bool:
+        if isinstance(e, (_InSubqueryPred, _ExistsPred, _ScalarCmpPred)):
+            return True
+        if isinstance(e, E.ScalarSubquery):
+            return False  # uncorrelated scalar: stays as an expression
+        return any(SqlToRel._contains_subquery(c) for c in e.children())
+
+    def _apply_subquery_pred(self, plan: L.LogicalPlan, pred: E.Expr, scope: Scope) -> L.LogicalPlan:
+        if isinstance(pred, _InSubqueryPred):
+            sub = pred.subplan
+            if len(sub.schema) != 1:
+                raise PlanningError("IN subquery must return one column")
+            sub_col = E.Column(sub.schema.fields[0].name)
+            jt = "anti" if pred.negated else "semi"
+            return L.Join(plan, sub, [(pred.operand, sub_col)], jt)
+        if isinstance(pred, _ExistsPred):
+            jt = "anti" if pred.negated else "semi"
+            return L.Join(plan, pred.subplan, pred.on_pairs, jt, pred.residual)
+        if isinstance(pred, _ScalarCmpPred):
+            # correlated scalar aggregate: join decorrelated agg subplan, then
+            # plain comparison against the agg output column.
+            joined = L.Join(plan, pred.subplan, pred.on_pairs, "inner")
+            cmp = E.BinOp(pred.op, pred.operand, E.Column(pred.agg_col)) if pred.operand_is_left else \
+                E.BinOp(pred.op, E.Column(pred.agg_col), pred.operand)
+            return L.Filter(joined, cmp)
+        raise PlanningError(f"unsupported subquery predicate {pred}")
+
+    # --- aggregation ----------------------------------------------------
+    def _resolve_group_expr(self, g: ast.Node, scope: Scope, sel: ast.Select,
+                            select_exprs: List[Tuple[E.Expr, str]]) -> E.Expr:
+        if isinstance(g, ast.Literal) and isinstance(g.value, int):
+            idx = g.value - 1
+            if not (0 <= idx < len(select_exprs)):
+                raise PlanningError(f"GROUP BY position {g.value} out of range")
+            return select_exprs[idx][0]
+        if isinstance(g, ast.ColumnRef) and g.table is None:
+            for e, name in select_exprs:
+                if name == g.name and not E.contains_agg(e):
+                    return e
+        return self.resolve_expr(g, scope)
+
+    def _plan_aggregate(self, plan: L.LogicalPlan, select_exprs, group_exprs, having_expr):
+        # rewrite avg -> sum/count
+        def rewrite_avg(e: E.Expr) -> E.Expr:
+            if isinstance(e, E.Agg) and e.func == "avg":
+                return E.BinOp("/", E.Agg("sum", e.operand), E.Agg("count", e.operand))
+            return _map_children(e, rewrite_avg)
+
+        select_exprs = [(rewrite_avg(e), n) for e, n in select_exprs]
+        if having_expr is not None:
+            having_expr = rewrite_avg(having_expr)
+
+        # collect distinct agg expressions
+        aggs: List[E.Agg] = []
+        keys_seen = set()
+        for e, _ in select_exprs:
+            for a in E.find_aggs(e):
+                k = _expr_key(a)
+                if k not in keys_seen:
+                    keys_seen.add(k)
+                    aggs.append(a)
+        if having_expr is not None:
+            for a in E.find_aggs(having_expr):
+                k = _expr_key(a)
+                if k not in keys_seen:
+                    keys_seen.add(k)
+                    aggs.append(a)
+
+        group_named = [(g, f"__g{i}") for i, g in enumerate(group_exprs)]
+        agg_named = [(a, f"__a{i}") for i, a in enumerate(aggs)]
+        agg_plan = L.Aggregate(plan, group_named, agg_named)
+
+        mapping: Dict[str, E.Expr] = {}
+        for g, name in group_named:
+            mapping[_expr_key(g)] = E.Column(name)
+        for a, name in agg_named:
+            mapping[_expr_key(a)] = E.Column(name)
+
+        new_select = [(substitute(e, mapping), n) for e, n in select_exprs]
+        new_having = substitute(having_expr, mapping) if having_expr is not None else None
+
+        # sanity: no leftover raw aggregates/columns outside mapping
+        for e, n in new_select:
+            if E.contains_agg(e):
+                raise PlanningError(f"aggregate substitution failed for {n}")
+        return agg_plan, new_select, new_having
+
+    # --- expression resolution ------------------------------------------
+    def resolve_expr(self, node: ast.Node, scope: Scope) -> E.Expr:
+        if node is None:
+            return None
+        if isinstance(node, ast.ColumnRef):
+            return scope.resolve(node.name, node.table)
+        if isinstance(node, ast.Literal):
+            if node.kind == "date":
+                return E.Lit(node.value, kind="date")
+            if node.kind in ("interval_day", "interval_month"):
+                return E.Lit(node.value, kind=node.kind)
+            return E.Lit(node.value)
+        if isinstance(node, ast.BinaryOp):
+            left = self.resolve_expr(node.left, scope)
+            # comparison against a subquery?
+            if node.op in ("=", "<>", "<", "<=", ">", ">=") and isinstance(node.right, ast.ScalarSubquery):
+                return self._plan_scalar_cmp(node.op, left, node.right.subquery, scope, operand_is_left=True)
+            if node.op in ("=", "<>", "<", "<=", ">", ">=") and isinstance(node.left, ast.ScalarSubquery):
+                right = self.resolve_expr(node.right, scope)
+                return self._plan_scalar_cmp(node.op, right, node.left.subquery, scope, operand_is_left=False)
+            right = self.resolve_expr(node.right, scope)
+            return E.BinOp(node.op, left, right)
+        if isinstance(node, ast.UnaryOp):
+            if node.op == "not":
+                inner = self.resolve_expr(node.operand, scope)
+                if isinstance(inner, _ExistsPred):
+                    return dataclasses.replace(inner, negated=not inner.negated)
+                if isinstance(inner, _InSubqueryPred):
+                    return dataclasses.replace(inner, negated=not inner.negated)
+                return E.Not(inner)
+            e = self.resolve_expr(node.operand, scope)
+            return E.Negate(e) if node.op == "-" else e
+        if isinstance(node, ast.FunctionCall):
+            if node.name in E.AGG_FUNCS:
+                if node.star:
+                    return E.Agg("count", None)
+                if len(node.args) != 1:
+                    raise PlanningError(f"{node.name} takes one argument")
+                return E.Agg(node.name, self.resolve_expr(node.args[0], scope), node.distinct)
+            raise PlanningError(f"unsupported function {node.name}")
+        if isinstance(node, ast.Case):
+            whens = []
+            for c, v in node.whens:
+                if node.operand is not None:
+                    cond = ast.BinaryOp("=", node.operand, c)
+                else:
+                    cond = c
+                whens.append((self.resolve_expr(cond, scope), self.resolve_expr(v, scope)))
+            else_ = self.resolve_expr(node.else_, scope) if node.else_ is not None else None
+            return E.Case(whens, else_)
+        if isinstance(node, ast.Cast):
+            return E.Cast(self.resolve_expr(node.expr, scope), parse_type_name(node.type_name))
+        if isinstance(node, ast.Between):
+            e = self.resolve_expr(node.expr, scope)
+            low = self.resolve_expr(node.low, scope)
+            high = self.resolve_expr(node.high, scope)
+            rng = E.BinOp("and", E.BinOp(">=", e, low), E.BinOp("<=", e, high))
+            return E.Not(rng) if node.negated else rng
+        if isinstance(node, ast.InList):
+            e = self.resolve_expr(node.expr, scope)
+            values = []
+            for item in node.items:
+                lit = self.resolve_expr(item, scope)
+                if not isinstance(lit, E.Lit):
+                    raise PlanningError("IN list must contain literals")
+                values.append(lit.value)
+            return E.InList(e, values, node.negated)
+        if isinstance(node, ast.InSubquery):
+            e = self.resolve_expr(node.expr, scope)
+            sub = self.plan_select(node.subquery, scope)
+            return _InSubqueryPred(e, sub, node.negated)
+        if isinstance(node, ast.Exists):
+            return self._plan_exists(node, scope)
+        if isinstance(node, ast.ScalarSubquery):
+            sub = self.plan_select(node.subquery, None)  # uncorrelated only here
+            return E.ScalarSubquery(sub)
+        if isinstance(node, ast.Like):
+            e = self.resolve_expr(node.expr, scope)
+            pat = self.resolve_expr(node.pattern, scope)
+            if not isinstance(pat, E.Lit) or not isinstance(pat.value, str):
+                raise PlanningError("LIKE pattern must be a string literal")
+            return E.Like(e, pat.value, node.negated)
+        if isinstance(node, ast.IsNull):
+            return E.IsNull(self.resolve_expr(node.expr, scope), node.negated)
+        if isinstance(node, ast.Extract):
+            return E.Extract(node.field, self.resolve_expr(node.expr, scope))
+        if isinstance(node, ast.Substring):
+            e = self.resolve_expr(node.expr, scope)
+            start = self.resolve_expr(node.start, scope)
+            length = self.resolve_expr(node.length, scope) if node.length is not None else None
+            if not isinstance(start, E.Lit) or (length is not None and not isinstance(length, E.Lit)):
+                raise PlanningError("SUBSTRING bounds must be literals")
+            return E.Substring(e, int(start.value), None if length is None else int(length.value))
+        raise PlanningError(f"unsupported expression {type(node).__name__}")
+
+    def _display_name(self, node: ast.Node, i: int) -> str:
+        if isinstance(node, ast.ColumnRef):
+            return node.name
+        if isinstance(node, ast.FunctionCall):
+            return str(node)
+        return f"col_{i}"
+
+    # --- EXISTS / correlated scalar -------------------------------------
+    def _plan_exists(self, node: ast.Exists, scope: Scope) -> "_ExistsPred":
+        sub = node.subquery
+        relations: List[Relation] = []
+        for rel_ast in sub.from_:
+            relations.extend(self._plan_relation(rel_ast, scope))
+        inner_scope = Scope(self._flat(relations), scope)
+        conjs = E.conjuncts(self.resolve_expr(sub.where, inner_scope)) if sub.where is not None else []
+
+        inner_conjs, on_pairs, residual = [], [], []
+        for c in conjs:
+            if _is_outer_free(c):
+                inner_conjs.append(c)
+                continue
+            pair = self._correlated_equi_pair(c)
+            if pair is not None:
+                on_pairs.append(pair)
+            else:
+                residual.append(_strip_outer(c))
+
+        inner_plan = self._combine_cross_with_edges(relations, inner_conjs)
+        if not on_pairs:
+            raise PlanningError("EXISTS subquery must have at least one correlated equality")
+        return _ExistsPred(inner_plan, on_pairs, E.and_all(residual), node.negated)
+
+    def _correlated_equi_pair(self, c: E.Expr):
+        """outer_expr = inner_expr -> (outer, inner) join pair."""
+        if isinstance(c, E.BinOp) and c.op == "=":
+            l_out, r_out = _outer_refs(c.left), _outer_refs(c.right)
+            if l_out and not r_out and _is_outer_free(c.right):
+                return (_strip_outer(c.left), c.right)
+            if r_out and not l_out and _is_outer_free(c.left):
+                return (_strip_outer(c.right), c.left)
+        return None
+
+    def _combine_cross_with_edges(self, relations: List[Relation], conjs: List[E.Expr]) -> L.LogicalPlan:
+        """Build a join tree for subquery FROM lists (same greedy algorithm)."""
+        fake_sel = ast.Select(items=[], from_=[])
+        # reuse _build_join_tree mechanics manually
+        single: Dict[str, List[E.Expr]] = {}
+        edges: List[Tuple[str, str, E.Expr, E.Expr]] = []
+        post: List[E.Expr] = []
+        flat = self._flat(relations)
+        aliases = {r.alias for r in flat}
+        for c in conjs:
+            rels = {r.split(".", 1)[0] for r in c.column_refs() if r.split(".", 1)[0] in aliases}
+            if len(rels) == 1:
+                single.setdefault(next(iter(rels)), []).append(c)
+            elif len(rels) == 2:
+                pair = self._as_equi_pair_by_alias(c)
+                if pair is not None:
+                    edges.append(pair)
+                else:
+                    post.append(c)
+            else:
+                post.append(c)
+
+        plans: List[L.LogicalPlan] = []
+        group_of: Dict[str, int] = {}
+        for gi, rel in enumerate(relations):
+            members = rel.members if isinstance(rel, _CompositeRelation) else [rel]
+            base = rel.plan
+            fs = []
+            for m in members:
+                group_of[m.alias] = gi
+                fs.extend(single.pop(m.alias, []))
+            if fs:
+                base = L.Filter(base, E.and_all(fs))
+            plans.append(base)
+
+        plan = plans[0]
+        joined = {0}
+        remaining = list(range(1, len(plans)))
+        while remaining:
+            progressed = False
+            for gi in list(remaining):
+                pairs, rest = [], []
+                for (a, b, ea, eb) in edges:
+                    ga, gb = group_of[a], group_of[b]
+                    if ga in joined and gb == gi:
+                        pairs.append((ea, eb))
+                    elif gb in joined and ga == gi:
+                        pairs.append((eb, ea))
+                    else:
+                        rest.append((a, b, ea, eb))
+                if pairs:
+                    plan = L.Join(plan, plans[gi], pairs, "inner")
+                    edges = rest
+                    joined.add(gi)
+                    remaining.remove(gi)
+                    progressed = True
+                    break
+            if not progressed:
+                gi = remaining.pop(0)
+                plan = L.CrossJoin(plan, plans[gi])
+                joined.add(gi)
+        for (a, b, ea, eb) in edges:
+            post.append(E.BinOp("=", ea, eb))
+        if post:
+            plan = L.Filter(plan, E.and_all(post))
+        return plan
+
+    def _plan_scalar_cmp(self, op: str, operand: E.Expr, sub: ast.Select, scope: Scope,
+                         operand_is_left: bool) -> E.Expr:
+        """Comparison against a scalar subquery.  Uncorrelated -> keep as a
+        ScalarSubquery expression.  Correlated single-aggregate -> decorrelate
+        into a grouped subplan + join (covers TPC-H q2/q17/q20)."""
+        # detect correlation: try planning uncorrelated first
+        try:
+            plan = self.plan_select(sub, None)
+            return E.BinOp(op, operand, E.ScalarSubquery(plan)) if operand_is_left else \
+                E.BinOp(op, E.ScalarSubquery(plan), operand)
+        except PlanningError:
+            pass
+
+        # correlated: must be a single aggregate select over a FROM/WHERE
+        if len(sub.items) != 1 or sub.group_by or sub.having or sub.order_by:
+            raise PlanningError("unsupported correlated scalar subquery shape")
+        relations: List[Relation] = []
+        for rel_ast in sub.from_:
+            relations.extend(self._plan_relation(rel_ast, scope))
+        inner_scope = Scope(self._flat(relations), scope)
+        item = self.resolve_expr(sub.items[0].expr, inner_scope)
+        aggs = E.find_aggs(item)
+        if len(aggs) != 1 or _outer_refs(item):
+            raise PlanningError("correlated scalar subquery must be a single aggregate")
+
+        conjs = E.conjuncts(self.resolve_expr(sub.where, inner_scope)) if sub.where is not None else []
+        inner_conjs, corr_pairs = [], []
+        for c in conjs:
+            if _is_outer_free(c):
+                inner_conjs.append(c)
+                continue
+            pair = self._correlated_equi_pair(c)
+            if pair is None:
+                raise PlanningError(f"unsupported correlated predicate {c}")
+            corr_pairs.append(pair)
+        if not corr_pairs:
+            raise PlanningError("correlated scalar subquery needs equality correlation")
+
+        inner_plan = self._combine_cross_with_edges(relations, inner_conjs)
+        # group the subplan by the inner correlation keys, compute the agg
+        group_named = [(inner_e, self._fresh("ck")) for _, inner_e in corr_pairs]
+        agg = aggs[0]
+        agg_name = self._fresh("sq")
+        agg_plan = L.Aggregate(inner_plan, group_named, [(agg, agg_name)])
+        if _expr_key(item) != _expr_key(agg):
+            raise PlanningError("correlated scalar subquery must be exactly one aggregate call")
+        on_pairs = [(outer_e, E.Column(name)) for (outer_e, _), (_, name) in zip(corr_pairs, group_named)]
+        return _ScalarCmpPred(op, operand, agg_plan, on_pairs, agg_name, operand_is_left)
+
+
+class _CompositeRelation(Relation):
+    """A pre-joined (explicit JOIN..ON) group of relations."""
+
+    def __init__(self, members: List[Relation], plan: L.LogicalPlan):
+        self.members = members
+        self.alias = members[0].alias
+        self.plan = plan
+
+
+# internal predicate carriers (consumed by _apply_subquery_pred)
+@dataclasses.dataclass
+class _InSubqueryPred(E.Expr):
+    operand: E.Expr
+    subplan: L.LogicalPlan
+    negated: bool
+
+    def dtype(self, schema):
+        from ..models.schema import BOOL
+        return BOOL
+
+
+@dataclasses.dataclass
+class _ExistsPred(E.Expr):
+    subplan: L.LogicalPlan
+    on_pairs: List[Tuple[E.Expr, E.Expr]]
+    residual: Optional[E.Expr]
+    negated: bool
+
+    def dtype(self, schema):
+        from ..models.schema import BOOL
+        return BOOL
+
+
+@dataclasses.dataclass
+class _ScalarCmpPred(E.Expr):
+    op: str
+    operand: E.Expr
+    subplan: L.LogicalPlan
+    on_pairs: List[Tuple[E.Expr, E.Expr]]
+    agg_col: str
+    operand_is_left: bool
+
+    def dtype(self, schema):
+        from ..models.schema import BOOL
+        return BOOL

@@ -1,0 +1,105 @@
+"""TPC-H table schemas in the engine's type lattice.
+
+Standard TPC-H spec schemas (same tables the reference benchmarks against,
+reference benchmarks/src/bin/tpch.rs); decimals are fixed-point int64
+(scale 2), dates int32 days, strings dictionary-encoded.
+"""
+from arrow_ballista_tpu import DATE32, Field, INT32, INT64, STRING, Schema, decimal
+
+D2 = decimal(2)
+
+LINEITEM = Schema([
+    Field("l_orderkey", INT64),
+    Field("l_partkey", INT64),
+    Field("l_suppkey", INT64),
+    Field("l_linenumber", INT32),
+    Field("l_quantity", D2),
+    Field("l_extendedprice", D2),
+    Field("l_discount", D2),
+    Field("l_tax", D2),
+    Field("l_returnflag", STRING),
+    Field("l_linestatus", STRING),
+    Field("l_shipdate", DATE32),
+    Field("l_commitdate", DATE32),
+    Field("l_receiptdate", DATE32),
+    Field("l_shipinstruct", STRING),
+    Field("l_shipmode", STRING),
+    Field("l_comment", STRING),
+])
+
+ORDERS = Schema([
+    Field("o_orderkey", INT64),
+    Field("o_custkey", INT64),
+    Field("o_orderstatus", STRING),
+    Field("o_totalprice", D2),
+    Field("o_orderdate", DATE32),
+    Field("o_orderpriority", STRING),
+    Field("o_clerk", STRING),
+    Field("o_shippriority", INT32),
+    Field("o_comment", STRING),
+])
+
+CUSTOMER = Schema([
+    Field("c_custkey", INT64),
+    Field("c_name", STRING),
+    Field("c_address", STRING),
+    Field("c_nationkey", INT64),
+    Field("c_phone", STRING),
+    Field("c_acctbal", D2),
+    Field("c_mktsegment", STRING),
+    Field("c_comment", STRING),
+])
+
+PART = Schema([
+    Field("p_partkey", INT64),
+    Field("p_name", STRING),
+    Field("p_mfgr", STRING),
+    Field("p_brand", STRING),
+    Field("p_type", STRING),
+    Field("p_size", INT32),
+    Field("p_container", STRING),
+    Field("p_retailprice", D2),
+    Field("p_comment", STRING),
+])
+
+PARTSUPP = Schema([
+    Field("ps_partkey", INT64),
+    Field("ps_suppkey", INT64),
+    Field("ps_availqty", INT32),
+    Field("ps_supplycost", D2),
+    Field("ps_comment", STRING),
+])
+
+SUPPLIER = Schema([
+    Field("s_suppkey", INT64),
+    Field("s_name", STRING),
+    Field("s_address", STRING),
+    Field("s_nationkey", INT64),
+    Field("s_phone", STRING),
+    Field("s_acctbal", D2),
+    Field("s_comment", STRING),
+])
+
+NATION = Schema([
+    Field("n_nationkey", INT64),
+    Field("n_name", STRING),
+    Field("n_regionkey", INT64),
+    Field("n_comment", STRING),
+])
+
+REGION = Schema([
+    Field("r_regionkey", INT64),
+    Field("r_name", STRING),
+    Field("r_comment", STRING),
+])
+
+TABLES = {
+    "lineitem": LINEITEM,
+    "orders": ORDERS,
+    "customer": CUSTOMER,
+    "part": PART,
+    "partsupp": PARTSUPP,
+    "supplier": SUPPLIER,
+    "nation": NATION,
+    "region": REGION,
+}

@@ -1,0 +1,149 @@
+"""SQL front-end tests: parse + logical plan + optimize over TPC-H schemas.
+
+Mirrors the reference's planner snapshot tests
+(reference ballista/scheduler/src/planner.rs:330-646) at the logical level.
+"""
+import pytest
+
+from arrow_ballista_tpu.models import logical as L
+from arrow_ballista_tpu.sql.optimizer import optimize
+from arrow_ballista_tpu.sql.parser import parse_sql
+from arrow_ballista_tpu.sql.planner import Catalog, SqlToRel
+from arrow_ballista_tpu.utils.errors import PlanningError
+from benchmarks.schema import TABLES
+
+
+class TpchCatalog(Catalog):
+    def table_schema(self, name):
+        if name not in TABLES:
+            raise PlanningError(f"table not found: {name}")
+        return TABLES[name]
+
+    def table_names(self):
+        return list(TABLES)
+
+
+def plan(sql, opt=True):
+    p = SqlToRel(TpchCatalog()).plan(parse_sql(sql))
+    return optimize(p) if opt else p
+
+
+def collect(plan_node, kind):
+    out = []
+    def walk(p):
+        if isinstance(p, kind):
+            out.append(p)
+        for c in p.children():
+            walk(c)
+    walk(plan_node)
+    return out
+
+
+def test_q1_plan_shape():
+    p = plan("""select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+        avg(l_discount) as avg_disc, count(*) as count_order
+        from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
+        group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus""")
+    scans = collect(p, L.TableScan)
+    assert len(scans) == 1
+    # filter pushed into scan, projection pruned to needed columns
+    assert scans[0].filters, "shipdate filter should be pushed into the scan"
+    assert set(scans[0].projection) == {
+        "l_returnflag", "l_linestatus", "l_quantity", "l_discount", "l_shipdate"}
+    aggs = collect(p, L.Aggregate)
+    assert len(aggs) == 1
+    assert len(aggs[0].group_exprs) == 2
+    sorts = collect(p, L.Sort)
+    assert len(sorts) == 1
+    assert p.schema.names() == [
+        "l_returnflag", "l_linestatus", "sum_qty", "avg_disc", "count_order"]
+
+
+def test_q3_join_graph():
+    p = plan("""select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+        o_orderdate, o_shippriority
+        from customer, orders, lineitem
+        where c_mktsegment = 'BUILDING' and c_custkey = o_custkey and l_orderkey = o_orderkey
+          and o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15'
+        group by l_orderkey, o_orderdate, o_shippriority
+        order by revenue desc, o_orderdate limit 10""")
+    joins = collect(p, L.Join)
+    assert len(joins) == 2
+    assert all(j.join_type == "inner" for j in joins)
+    assert not collect(p, L.CrossJoin), "join graph should avoid cross joins"
+    limits = collect(p, L.Limit)
+    assert limits and limits[0].n == 10
+    # selective filters pushed to each scan
+    scans = {s.table: s for s in collect(p, L.TableScan)}
+    assert scans["customer"].filters
+    assert scans["orders"].filters
+    assert scans["lineitem"].filters
+
+
+def test_q18_in_subquery_becomes_semi_join():
+    p = plan("""select c_name, sum(l_quantity) from customer, orders, lineitem
+        where o_orderkey in (select l_orderkey from lineitem group by l_orderkey
+                             having sum(l_quantity) > 300)
+          and c_custkey = o_custkey and o_orderkey = l_orderkey
+        group by c_name""")
+    joins = collect(p, L.Join)
+    assert any(j.join_type == "semi" for j in joins)
+
+
+def test_q21_exists_and_not_exists():
+    p = plan("""select s_name, count(*) as numwait from supplier, lineitem l1, orders, nation
+        where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey and o_orderstatus = 'F'
+          and l1.l_receiptdate > l1.l_commitdate
+          and exists (select * from lineitem l2 where l2.l_orderkey = l1.l_orderkey
+                      and l2.l_suppkey <> l1.l_suppkey)
+          and not exists (select * from lineitem l3 where l3.l_orderkey = l1.l_orderkey
+                      and l3.l_suppkey <> l1.l_suppkey and l3.l_receiptdate > l3.l_commitdate)
+          and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA'
+        group by s_name order by numwait desc, s_name limit 100""")
+    kinds = [j.join_type for j in collect(p, L.Join)]
+    assert "semi" in kinds and "anti" in kinds
+    semi = [j for j in collect(p, L.Join) if j.join_type == "semi"][0]
+    assert semi.filter is not None, "non-equi correlation must become a residual filter"
+
+
+def test_q2_correlated_scalar_decorrelates():
+    p = plan("""select s_acctbal, s_name, p_partkey from part, supplier, partsupp, nation, region
+        where p_partkey = ps_partkey and s_suppkey = ps_suppkey and p_size = 15
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey and r_name = 'EUROPE'
+          and ps_supplycost = (select min(ps_supplycost) from partsupp, supplier, nation, region
+             where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+               and s_nationkey = n_nationkey and n_regionkey = r_regionkey and r_name = 'EUROPE')
+        order by s_acctbal desc limit 100""")
+    aggs = collect(p, L.Aggregate)
+    assert len(aggs) == 1, "correlated min() should become a grouped subplan"
+    assert len(aggs[0].group_exprs) == 1
+
+
+def test_ambiguous_column_rejected():
+    with pytest.raises(PlanningError, match="ambiguous"):
+        plan("select l_orderkey from lineitem l1, lineitem l2 where l1.l_orderkey = l2.l_orderkey")
+
+
+def test_unknown_column_rejected():
+    with pytest.raises(PlanningError, match="not found"):
+        plan("select bogus_col from lineitem")
+
+
+def test_decimal_scale_propagation():
+    p = plan("select sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as s from lineitem")
+    f = p.schema.field("s")
+    assert f.dtype.kind == "decimal" and f.dtype.scale == 6
+
+
+def test_explicit_join_on():
+    p = plan("""select n_name, count(*) from customer
+        join nation on c_nationkey = n_nationkey group by n_name""")
+    joins = collect(p, L.Join)
+    assert len(joins) == 1 and joins[0].on
+
+
+def test_derived_table():
+    p = plan("""select cntrycode, count(*) from (
+        select substring(c_phone from 1 for 2) as cntrycode from customer) as t
+        group by cntrycode""")
+    assert collect(p, L.Aggregate)
