@@ -1,0 +1,171 @@
+"""Table providers: named datasets resolvable to schemas and scans.
+
+Parity: the reference registers tables client-side and ships them inside the
+logical plan (reference ballista/client/src/context.rs:214-352
+``register_csv/parquet/avro`` + CREATE EXTERNAL TABLE handling); providers
+here serve both the SQL planner (schemas) and the physical planner (scans,
+row-count estimates for broadcast decisions).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from .models import expr as E
+from .models.schema import DataType, Field, Schema, decimal
+from .sql.planner import Catalog
+from .utils.errors import PlanningError
+
+
+def arrow_schema_to_engine(pa_schema) -> Schema:
+    import pyarrow as pa
+
+    fields = []
+    for f in pa_schema:
+        t = f.type
+        if pa.types.is_dictionary(t):
+            t = t.value_type
+        if pa.types.is_string(t) or pa.types.is_large_string(t):
+            dt = DataType("string")
+        elif pa.types.is_date32(t):
+            dt = DataType("date32")
+        elif pa.types.is_decimal(t):
+            dt = decimal(t.scale)
+        elif pa.types.is_int64(t) or pa.types.is_uint64(t):
+            dt = DataType("int64")
+        elif pa.types.is_integer(t):
+            dt = DataType("int32")
+        elif pa.types.is_float64(t):
+            dt = DataType("float64")
+        elif pa.types.is_float32(t):
+            dt = DataType("float32")
+        elif pa.types.is_boolean(t):
+            dt = DataType("bool")
+        elif pa.types.is_timestamp(t) or pa.types.is_date64(t):
+            dt = DataType("date32")
+        else:
+            raise PlanningError(f"unsupported arrow type {t} for column {f.name}")
+        fields.append(Field(f.name, dt, f.nullable))
+    return Schema(fields)
+
+
+class TableProvider:
+    name: str
+    schema: Schema
+
+    def scan(self, projection: Optional[List[str]], filters: Sequence[E.Expr],
+             target_partitions: int):
+        raise NotImplementedError
+
+    def row_count(self) -> Optional[int]:
+        return None
+
+
+class MemoryTable(TableProvider):
+    def __init__(self, name: str, table, schema: Optional[Schema] = None):
+        import pyarrow as pa
+
+        if not isinstance(table, pa.Table):
+            table = pa.Table.from_pandas(table)
+        self.name = name
+        self.table = table
+        self.schema = schema or arrow_schema_to_engine(table.schema)
+
+    def scan(self, projection, filters, target_partitions):
+        from .ops.physical import MemoryScanExec
+
+        schema = self.schema if projection is None else self.schema.project(projection)
+        return MemoryScanExec(schema, self.table, target_partitions, filters)
+
+    def row_count(self):
+        return self.table.num_rows
+
+
+class ParquetTable(TableProvider):
+    def __init__(self, name: str, paths, schema: Optional[Schema] = None):
+        import glob
+        import os
+
+        import pyarrow.parquet as pq
+
+        self.name = name
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        if schema is None:
+            first = self.paths[0]
+            if os.path.isdir(first):
+                files = sorted(glob.glob(os.path.join(first, "*.parquet")))
+                if not files:
+                    raise PlanningError(f"no parquet files in {first}")
+                first = files[0]
+            schema = arrow_schema_to_engine(pq.ParquetFile(first).schema_arrow)
+        self.schema = schema
+        self._rows: Optional[int] = None
+
+    def scan(self, projection, filters, target_partitions):
+        from .ops.physical import ParquetScanExec
+
+        schema = self.schema if projection is None else self.schema.project(projection)
+        return ParquetScanExec(schema, self.paths, target_partitions, filters,
+                               table_schema=self.schema)
+
+    def row_count(self):
+        if self._rows is None:
+            from .ops.physical import ParquetScanExec
+
+            self._rows = ParquetScanExec(self.schema, self.paths, 1,
+                                         table_schema=self.schema).row_count_estimate()
+        return self._rows
+
+
+class CsvTable(TableProvider):
+    def __init__(self, name: str, paths, schema: Optional[Schema] = None,
+                 delimiter: str = ",", has_header: bool = True):
+        self.name = name
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        self.delimiter = delimiter
+        self.has_header = has_header
+        if schema is None:
+            import pyarrow.csv as pacsv
+
+            table = pacsv.read_csv(
+                self.paths[0],
+                parse_options=pacsv.ParseOptions(delimiter=delimiter),
+            )
+            schema = arrow_schema_to_engine(table.schema)
+        self.schema = schema
+
+    def scan(self, projection, filters, target_partitions):
+        from .ops.physical import CsvScanExec
+
+        schema = self.schema if projection is None else self.schema.project(projection)
+        return CsvScanExec(schema, self.paths, target_partitions, filters,
+                           table_schema=self.schema, delimiter=self.delimiter,
+                           has_header=self.has_header)
+
+
+class SchemaCatalog(Catalog):
+    """Mutable in-memory catalog of providers (per session)."""
+
+    def __init__(self):
+        self.tables: Dict[str, TableProvider] = {}
+
+    def register(self, provider: TableProvider):
+        self.tables[provider.name] = provider
+
+    def deregister(self, name: str):
+        self.tables.pop(name, None)
+
+    def table_schema(self, name: str) -> Schema:
+        p = self.tables.get(name)
+        if p is None:
+            raise PlanningError(f"table not found: {name}")
+        return p.schema
+
+    def table_names(self):
+        return sorted(self.tables)
+
+    def provider(self, name: str) -> TableProvider:
+        p = self.tables.get(name)
+        if p is None:
+            raise PlanningError(f"table not found: {name}")
+        return p

@@ -1,0 +1,197 @@
+"""BallistaContext: the user entry point.
+
+Parity with the reference client (reference ballista/client/src/context.rs):
+``standalone()`` runs scheduler+executor machinery in-process
+(context.rs:142-212), ``sql()`` handles DDL client-side and plans SELECTs
+(context.rs:358-530), ``register_parquet/csv/table`` mirror register_*
+(context.rs:214-352).  ``remote()`` connects to a scheduler over gRPC.
+
+Execution engines:
+- ``local``: single-process operator tree walk (RepartitionExec materializes
+  exchanges in memory) — the fast path for one host / one TPU chip.
+- ``standalone``: in-process scheduler + executor objects exercising the full
+  stage DAG, shuffle files, and fault-tolerance machinery.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..catalog import CsvTable, MemoryTable, ParquetTable, SchemaCatalog, TableProvider
+from ..models import logical as L
+from ..models.batch import ColumnBatch
+from ..models.schema import Field, Schema
+from ..ops.physical import ExecutionPlan, TaskContext
+from ..scheduler.physical_planner import PhysicalPlanner, PlannedQuery
+from ..sql import ast
+from ..sql.optimizer import optimize
+from ..sql.parser import parse_sql
+from ..sql.planner import SqlToRel, parse_type_name
+from ..utils.config import BallistaConfig
+from ..utils.errors import PlanningError
+
+
+class BallistaDataFrame:
+    """A planned query, lazily executed (parity: DataFusion DataFrame as
+    returned by BallistaContext::sql)."""
+
+    def __init__(self, ctx: "BallistaContext", logical: L.LogicalPlan):
+        self.ctx = ctx
+        self.logical = logical
+
+    @property
+    def schema(self) -> Schema:
+        return self.logical.schema
+
+    def explain(self) -> str:
+        return optimize(self.logical).display()
+
+    def collect(self) -> List[ColumnBatch]:
+        return self.ctx._execute_logical(self.logical)
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        batches = self.collect()
+        tables = [b.to_arrow() for b in batches if b.num_rows > 0]
+        if not tables:
+            return batches[0].to_arrow() if batches else pa.table({})
+        return pa.concat_tables(tables)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        batches = self.collect()
+        frames = [b.to_pandas() for b in batches]
+        out = pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
+        return out
+
+
+class BallistaContext:
+    def __init__(self, config: Optional[BallistaConfig] = None, engine: str = "local",
+                 work_dir: Optional[str] = None):
+        self.config = config or BallistaConfig()
+        self.engine = engine
+        self.catalog = SchemaCatalog()
+        self.work_dir = work_dir or os.path.join(tempfile.gettempdir(), "ballista_tpu")
+        self._standalone = None
+
+    # --- constructors (parity: context.rs:80-212) -----------------------
+    @staticmethod
+    def local(config: Optional[BallistaConfig] = None) -> "BallistaContext":
+        return BallistaContext(config, engine="local")
+
+    @staticmethod
+    def standalone(config: Optional[BallistaConfig] = None,
+                   concurrent_tasks: int = 4) -> "BallistaContext":
+        ctx = BallistaContext(config, engine="standalone")
+        from ..scheduler.standalone import StandaloneCluster
+
+        ctx._standalone = StandaloneCluster(ctx.config, concurrent_tasks)
+        return ctx
+
+    @staticmethod
+    def remote(host: str, port: int, config: Optional[BallistaConfig] = None) -> "BallistaContext":
+        ctx = BallistaContext(config, engine="remote")
+        from .remote import RemoteCluster
+
+        ctx._standalone = RemoteCluster(host, port, ctx.config)
+        return ctx
+
+    # --- registration ---------------------------------------------------
+    def register_table(self, name: str, table) -> None:
+        self.catalog.register(MemoryTable(name, table))
+
+    def register_parquet(self, name: str, path, schema: Optional[Schema] = None) -> None:
+        self.catalog.register(ParquetTable(name, path, schema))
+
+    def register_csv(self, name: str, path, schema: Optional[Schema] = None,
+                     delimiter: str = ",", has_header: bool = True) -> None:
+        self.catalog.register(CsvTable(name, path, schema, delimiter, has_header))
+
+    def deregister_table(self, name: str) -> None:
+        self.catalog.deregister(name)
+
+    # --- SQL ------------------------------------------------------------
+    def sql(self, sql: str) -> BallistaDataFrame:
+        stmt = parse_sql(sql)
+        if isinstance(stmt, ast.CreateExternalTable):
+            return self._create_external_table(stmt)
+        if isinstance(stmt, ast.ShowTables):
+            import pyarrow as pa
+
+            t = pa.table({"table_name": self.catalog.table_names()})
+            name = f"__show_{uuid.uuid4().hex[:6]}"
+            self.register_table(name, t)
+            return self.sql(f"select table_name from {name}")
+        if isinstance(stmt, ast.ShowColumns):
+            import pyarrow as pa
+
+            schema = self.catalog.table_schema(stmt.table)
+            t = pa.table({
+                "column_name": [f.name for f in schema],
+                "data_type": [str(f.dtype) for f in schema],
+            })
+            name = f"__cols_{uuid.uuid4().hex[:6]}"
+            self.register_table(name, t)
+            return self.sql(f"select column_name, data_type from {name}")
+        logical = SqlToRel(self.catalog).plan(stmt)
+        return BallistaDataFrame(self, logical)
+
+    def _create_external_table(self, stmt: ast.CreateExternalTable) -> BallistaDataFrame:
+        schema = None
+        if stmt.columns:
+            schema = Schema(Field(n, parse_type_name(t)) for n, t in stmt.columns)
+        if stmt.file_format == "parquet":
+            self.register_parquet(stmt.name, stmt.location, schema)
+        elif stmt.file_format == "csv":
+            self.register_csv(stmt.name, stmt.location, schema,
+                              delimiter=stmt.delimiter, has_header=stmt.has_header)
+        else:
+            raise PlanningError(f"unsupported format {stmt.file_format}")
+        import pyarrow as pa
+
+        df = BallistaDataFrame(self, None)
+        df.collect = lambda: []  # DDL: nothing to collect
+        df.to_pandas = lambda: __import__("pandas").DataFrame()
+        return df
+
+    # --- execution ------------------------------------------------------
+    def _execute_logical(self, logical: L.LogicalPlan) -> List[ColumnBatch]:
+        optimized = optimize(logical)
+        planner = PhysicalPlanner(self.catalog, self.config)
+        planned = planner.plan_query(optimized)
+        if self.engine == "local":
+            return self._execute_local(planned)
+        return self._standalone.execute(planned)
+
+    def _execute_local(self, planned: PlannedQuery) -> List[ColumnBatch]:
+        ctx = TaskContext(config=self.config, work_dir=self.work_dir,
+                          job_id=uuid.uuid4().hex[:7])
+        for sid, splan in planned.scalars:
+            ctx.scalars[sid] = extract_scalar(splan, ctx)
+        out: List[ColumnBatch] = []
+        for p in range(planned.plan.output_partition_count()):
+            out.extend(planned.plan.execute(p, ctx))
+        return out
+
+
+def extract_scalar(plan: ExecutionPlan, ctx: TaskContext):
+    """Run a scalar-subquery plan to a single python value (raw physical
+    repr: decimals stay scaled ints; _substitute_scalars rescales)."""
+    vals = []
+    for p in range(plan.output_partition_count()):
+        for b in plan.execute(p, ctx):
+            if b.num_rows:
+                mask = np.asarray(b.mask)
+                col = np.asarray(b.columns[b.schema.fields[0].name])
+                vals.extend(col[mask].tolist())
+    if len(vals) > 1:
+        raise PlanningError("scalar subquery returned more than one row")
+    if not vals:
+        return 0
+    return vals[0]

@@ -44,11 +44,16 @@ def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
     return np.concatenate([arr, pad])
 
 
-def round_capacity(n: int, multiple: int = 1024) -> int:
-    """Round a row count up to a shape-bucket so XLA recompiles rarely."""
-    if n <= 0:
-        return multiple
-    return ((n + multiple - 1) // multiple) * multiple
+def round_capacity(n: int, minimum: int = 1024) -> int:
+    """Round a row count up to the next power of two (>= minimum).
+
+    Shape-bucketing discipline: every distinct capacity is one XLA
+    compilation, so capacities snap to powers of two to keep the set of
+    compiled programs tiny."""
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
 
 
 class ColumnBatch:
@@ -124,6 +129,20 @@ class ColumnBatch:
     ) -> "ColumnBatch":
         """Re-wrap raw kernel outputs, keeping host-side metadata."""
         return ColumnBatch(schema or self.schema, columns, mask, dicts if dicts is not None else self.dicts)
+
+    def shrink(self) -> "ColumnBatch":
+        """Compact live rows to the front and drop to the smallest
+        power-of-two capacity.  A host decision (syncs on num_rows), used at
+        blocking boundaries (agg/join/sort/shuffle inputs) so downstream
+        programs compile for small static shapes after selective filters."""
+        n = self.num_rows
+        target = round_capacity(n)
+        if target >= self.capacity:
+            return self
+        order = jnp.argsort(~self.mask, stable=True)[:target]
+        cols = {k: v[order] for k, v in self.columns.items()}
+        mask = self.mask[order]
+        return ColumnBatch(self.schema, cols, mask, self.dicts, num_rows=n)
 
     # --- host materialization ------------------------------------------
     def compacted_numpy(self) -> Dict[str, np.ndarray]:
@@ -202,17 +221,49 @@ class ColumnBatch:
         return f"ColumnBatch({self.num_rows}/{self.capacity} rows, {len(self.schema)} cols)"
 
 
-def concat_batches(schema: Schema, batches: Sequence[ColumnBatch], capacity: Optional[int] = None) -> ColumnBatch:
-    """Concatenate batches host-side-free: device concat of padded arrays.
+def _unify_string_dicts(schema: Schema, batches: "list[ColumnBatch]") -> "list[ColumnBatch]":
+    """Re-encode string columns against one union dictionary when batches
+    disagree (e.g. local-mode repartition mixing scan partitions).  Shuffle
+    readers already unify on ingest, so the fast path is an identity check."""
+    string_fields = [f.name for f in schema if f.dtype.is_string]
+    if not string_fields:
+        return batches
+    out = list(batches)
+    for name in string_fields:
+        dicts = [b.dicts.get(name) for b in out]
+        first = dicts[0]
+        if all(d is first or (d is not None and first is not None and np.array_equal(d, first))
+               for d in dicts):
+            continue
+        union = np.asarray(
+            sorted(set().union(*[set(d.tolist()) for d in dicts if d is not None])),
+            dtype=object,
+        )
+        for i, b in enumerate(out):
+            d = b.dicts.get(name)
+            if d is None or len(d) == 0:
+                lut = np.zeros(1, dtype=np.int32)
+            else:
+                lut = np.searchsorted(union, d).astype(np.int32)
+            codes = b.columns[name]
+            new_codes = jnp.where(codes >= 0, jnp.asarray(lut)[jnp.clip(codes, 0, None)], -1)
+            new_cols = dict(b.columns)
+            new_cols[name] = new_codes.astype(jnp.int32)
+            new_dicts = dict(b.dicts)
+            new_dicts[name] = union
+            out[i] = ColumnBatch(b.schema, new_cols, b.mask, new_dicts)
+    return out
 
-    All batches must share dictionaries for string columns (true within one
-    input stream; shuffle readers unify dictionaries on ingest).
-    """
+
+def concat_batches(schema: Schema, batches: Sequence[ColumnBatch], capacity: Optional[int] = None) -> ColumnBatch:
+    """Concatenate batches: device concat of padded arrays, unifying string
+    dictionaries across inputs when they differ."""
     batches = list(batches)
     if not batches:
         return ColumnBatch.empty(schema, capacity or 1024)
     if len(batches) == 1 and (capacity is None or batches[0].capacity == capacity):
         return batches[0]
+    batches = _unify_string_dicts(schema, batches)
     cols = {f.name: jnp.concatenate([b.columns[f.name] for b in batches]) for f in schema}
     mask = jnp.concatenate([b.mask for b in batches])
     total_cap = int(mask.shape[0])

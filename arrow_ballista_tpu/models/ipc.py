@@ -1,0 +1,134 @@
+"""Arrow IPC shuffle serialization (physical representation).
+
+Role parity: the reference's shuffle files are Arrow IPC written by
+``IPCWriter`` (reference ballista/core/src/execution_plans/shuffle_writer.rs:
+214-252) and read back by file readers / Flight streams
+(shuffle_reader.rs:355-411).  Here batches are serialized in **physical**
+form — decimals stay scaled int64 (field metadata carries the scale), dates
+int32, strings as dictionary arrays — so the device round-trip is a straight
+memcpy, with dictionary unification happening once on the read side.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.errors import InternalError
+from .batch import ColumnBatch, round_capacity
+from .schema import DataType, Field, Schema
+
+
+def _physical_arrow_schema(schema: Schema):
+    import pyarrow as pa
+
+    fields = []
+    for f in schema:
+        meta = {b"kind": f.dtype.kind.encode()}
+        if f.dtype.is_decimal:
+            meta[b"scale"] = str(f.dtype.scale).encode()
+        if f.dtype.is_string:
+            t = pa.dictionary(pa.int32(), pa.string())
+        else:
+            t = {
+                "int32": pa.int32(), "int64": pa.int64(), "float32": pa.float32(),
+                "float64": pa.float64(), "bool": pa.bool_(),
+                "date32": pa.int32(), "decimal": pa.int64(),
+            }[f.dtype.kind]
+        fields.append(pa.field(f.name, t, metadata=meta))
+    return pa.schema(fields)
+
+
+def batch_to_physical_table(batch: ColumnBatch):
+    """Live rows only, physical representation (no decimal/date decoding)."""
+    import pyarrow as pa
+
+    data = batch.compacted_numpy()
+    pa_schema = _physical_arrow_schema(batch.schema)
+    arrays = []
+    for f in batch.schema:
+        arr = data[f.name]
+        if f.dtype.is_string:
+            dic = batch.dicts.get(f.name)
+            if dic is None:
+                if len(arr) and arr.max(initial=-1) >= 0:
+                    raise InternalError(f"string column {f.name!r} missing dictionary")
+                dic = np.array([], dtype=object)
+            idx = pa.array(arr, type=pa.int32())
+            arrays.append(pa.DictionaryArray.from_arrays(idx, pa.array(dic, type=pa.string())))
+        else:
+            arrays.append(pa.array(arr, type=pa_schema.field(f.name).type))
+    return pa.table(arrays, schema=pa_schema)
+
+
+def write_ipc_file(batch: ColumnBatch, path: str) -> tuple:
+    """Returns (num_rows, num_bytes)."""
+    import pyarrow as pa
+    import pyarrow.ipc as ipc
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    table = batch_to_physical_table(batch)
+    tmp = path + ".tmp"
+    with pa.OSFile(tmp, "wb") as sink:
+        with ipc.new_file(sink, table.schema) as writer:
+            writer.write_table(table)
+    os.replace(tmp, path)
+    return table.num_rows, os.path.getsize(path)
+
+
+def read_ipc_files(paths: Sequence[str], schema: Schema, capacity: Optional[int] = None) -> List[ColumnBatch]:
+    """Read shuffle files back into device batches with one unified, sorted
+    dictionary per string column across all inputs."""
+    import pyarrow as pa
+    import pyarrow.ipc as ipc
+
+    tables = []
+    for p in paths:
+        with pa.memory_map(p, "r") as source:
+            tables.append(ipc.open_file(source).read_all())
+    if not tables:
+        return [ColumnBatch.empty(schema, capacity or 1024)]
+    table = pa.concat_tables(tables, promote_options="permissive") if len(tables) > 1 else tables[0]
+    return physical_table_to_batches(table, schema, capacity)
+
+
+def physical_table_to_batches(table, schema: Schema, capacity: Optional[int] = None) -> List[ColumnBatch]:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    n = table.num_rows
+    cols: Dict[str, np.ndarray] = {}
+    dicts: Dict[str, np.ndarray] = {}
+    for f in schema:
+        arr = table.column(f.name)
+        if f.dtype.is_string:
+            combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+            if isinstance(combined, pa.ChunkedArray):  # zero-chunk edge
+                combined = pa.array([], type=pa.dictionary(pa.int32(), pa.string()))
+            if not pa.types.is_dictionary(combined.type):
+                combined = pc.dictionary_encode(combined)
+            indices = pc.fill_null(combined.indices, -1)
+            codes = indices.to_numpy(zero_copy_only=False).astype(np.int32)
+            dic = np.asarray(combined.dictionary.to_pylist(), dtype=object)
+            if len(dic):
+                order = np.argsort(dic)
+                rank = np.empty(len(order), dtype=np.int32)
+                rank[order] = np.arange(len(order), dtype=np.int32)
+                codes = np.where(codes >= 0, rank[np.clip(codes, 0, None)], -1).astype(np.int32)
+                dic = dic[order]
+            cols[f.name] = codes
+            dicts[f.name] = dic
+        else:
+            cols[f.name] = arr.to_numpy(zero_copy_only=False).astype(f.dtype.np_dtype)
+
+    if n == 0:
+        return [ColumnBatch.empty(schema, capacity or 1024)]
+    cap = capacity or round_capacity(n)
+    out = []
+    for start in range(0, n, cap):
+        end = min(start + cap, n)
+        chunk = {k: v[start:end] for k, v in cols.items()}
+        c = cap if end - start == cap else round_capacity(end - start)
+        out.append(ColumnBatch.from_numpy(schema, chunk, dicts=dicts, capacity=c))
+    return out

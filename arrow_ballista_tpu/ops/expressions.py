@@ -1,0 +1,565 @@
+"""Expression compilation: typed logical Expr -> executable column functions.
+
+Two modes:
+
+- **device**: emits a pure function over ``(cols: dict[str, jnp.ndarray],
+  aux: dict[str, jnp.ndarray])`` suitable for fusing into a stage's single
+  jitted program.  String predicates (=, LIKE, IN over dictionary-encoded
+  columns) are evaluated once per batch over the (small) host dictionary,
+  producing boolean lookup tables shipped in ``aux`` — the device does a
+  gather, never touches bytes.
+- **host**: same semantics with numpy float64 — used for tiny
+  post-aggregation projections containing division (TPU has no native f64;
+  divisions in TPC-H only occur after aggregation).
+
+Constant folding happens first (date/interval arithmetic, literal math), so
+the device never sees calendar logic except EXTRACT over columns, which uses
+the integer civil-from-days kernel.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import re
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..models import expr as E
+from ..models.schema import BOOL, DataType, DATE32, FLOAT64, INT32, INT64, Schema
+from ..utils.errors import InternalError, PlanningError
+from . import kernels as K
+
+
+# --------------------------------------------------------------------------
+# constant folding
+# --------------------------------------------------------------------------
+
+
+def _parse_date(s: str) -> int:
+    d = datetime.date.fromisoformat(s)
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+def _add_months(days: int, months: int) -> int:
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
+    y, m = divmod((d.year * 12 + d.month - 1) + months, 12)
+    leap = y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)
+    month_len = [31, 29 if leap else 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31][m]
+    clamped = datetime.date(y, m + 1, min(d.day, month_len))
+    return (clamped - datetime.date(1970, 1, 1)).days
+
+
+def fold_constants(e: E.Expr) -> E.Expr:
+    """Evaluate literal-only subtrees on the host (incl. date/interval math)."""
+    if isinstance(e, E.Lit):
+        if e.kind == "date" and isinstance(e.value, str):
+            return E.Lit(_parse_date(e.value), kind="date")
+        return e
+    from ..sql.planner import _map_children
+
+    e = _map_children(e, fold_constants)
+
+    if isinstance(e, E.BinOp) and isinstance(e.left, E.Lit) and isinstance(e.right, E.Lit):
+        lv, rv = e.left.value, e.right.value
+        lk, rk = e.left.kind, e.right.kind
+        if e.op in ("+", "-") and lk == "date":
+            sign = 1 if e.op == "+" else -1
+            if rk == "interval_day":
+                return E.Lit(lv + sign * rv, kind="date")
+            if rk == "interval_month":
+                return E.Lit(_add_months(lv, sign * rv), kind="date")
+        if lk == "auto" and rk == "auto" and e.op in ("+", "-", "*", "/"):
+            try:
+                v = {"+": lv + rv, "-": lv - rv, "*": lv * rv,
+                     "/": lv / rv if isinstance(lv, float) or isinstance(rv, float) or lv % rv else lv // rv}[e.op]
+            except Exception:
+                return e
+            return E.Lit(v)
+    if isinstance(e, E.Negate) and isinstance(e.operand, E.Lit) and e.operand.kind == "auto":
+        return E.Lit(-e.operand.value)
+    return e
+
+
+# --------------------------------------------------------------------------
+# LIKE -> regex over dictionary
+# --------------------------------------------------------------------------
+
+
+def _fnv1a64(s) -> int:
+    """Deterministic 64-bit string hash (stable across processes/hosts —
+    python's builtin hash() is salted and unusable for shuffles)."""
+    h = 0xCBF29CE484222325
+    for b in str(s).encode("utf-8"):
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def like_to_regex(pattern: str) -> "re.Pattern":
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+# --------------------------------------------------------------------------
+# compiled expression
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Compiled:
+    fn: Callable  # (cols, aux) -> array
+    dtype: DataType
+    # for string-valued results: dictionary derivation from input dicts
+    dict_fn: Optional[Callable] = None  # (dicts) -> np.ndarray of str
+    # for literal sources: the python value, so coercions (e.g. float literal
+    # against a decimal column) happen at compile time, never on device
+    lit_value: Optional[object] = None
+
+
+class ExprCompiler:
+    """Compiles expressions against a fixed input schema.
+
+    ``aux_builders`` maps aux-slot names to host functions
+    ``(dicts: {col: np.ndarray}) -> np.ndarray`` evaluated per batch (cached
+    by the operator on dictionary identity).
+    """
+
+    def __init__(self, schema: Schema, mode: str = "device"):
+        assert mode in ("device", "host")
+        self.schema = schema
+        self.mode = mode
+        self.xp = jnp if mode == "device" else np
+        self.aux_builders: Dict[str, Callable] = {}
+        self._aux_cache: Dict = {}
+        self._n = 0
+
+    # --- public ---------------------------------------------------------
+    def compile(self, expr: E.Expr) -> Compiled:
+        return self._c(fold_constants(expr))
+
+    # sentinel for NULL string keys: joins must treat NULL <> NULL, so this
+    # value is excluded from matching by JoinExec (group-by, which wants
+    # NULLs grouped together, sees them all map to this one value)
+    NULL_KEY_SENTINEL = np.uint64(0x9E3779B97F4A7C15)
+
+    def compile_key(self, expr: E.Expr) -> Compiled:
+        """Compile an expression for use as a shuffle/join key: the result is
+        comparable **across batches and processes**.  Numeric keys pass
+        through (joins on them are exact); string keys become stable 64-bit
+        value hashes (FNV-1a over UTF-8 evaluated on the dictionary), since
+        dictionary codes are only meaningful within one batch's encoding.
+        String-key equality is therefore hash-based (collision odds ~2^-64
+        per joined pair); the compiled dtype reports is_string so consumers
+        can apply NULL-exclusion via NULL_KEY_SENTINEL."""
+        c = self.compile(expr)
+        if not c.dtype.is_string:
+            return c
+        xp = self.xp
+
+        def hash_lut(d, df=c.dict_fn):
+            dic = df(d)
+            if len(dic) == 0:
+                return np.zeros(1, dtype=np.uint64)
+            return np.array([_fnv1a64(s) for s in dic], dtype=np.uint64)
+
+        slot = self._slot(hash_lut)
+        sent = self.NULL_KEY_SENTINEL
+        return Compiled(
+            lambda cols, a, s=slot: xp.where(
+                c.fn(cols, a) >= 0,
+                a[s][xp.clip(c.fn(cols, a), 0, None)],
+                xp.asarray(sent),
+            ),
+            DataType("string"),  # marks hash-keyed string; physical is uint64
+        )
+
+    def build_aux(self, dicts: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return {name: b(dicts) for name, b in self.aux_builders.items()}
+
+    def aux_arrays(self, dicts: Dict[str, np.ndarray]) -> Dict[str, object]:
+        """build_aux + device upload, memoized on dictionary identity (scans
+        share one dictionary across all their batches, so LIKE/regex LUTs are
+        computed and uploaded once per operator, not per batch)."""
+        key = tuple(sorted((k, id(v)) for k, v in dicts.items()))
+        hit = self._aux_cache.get(key)
+        if hit is None:
+            raw = self.build_aux(dicts)
+            if self.mode == "device":
+                hit = {k: jnp.asarray(v) for k, v in raw.items()}
+            else:
+                hit = raw
+            if len(self._aux_cache) > 64:
+                self._aux_cache.clear()
+            self._aux_cache[key] = hit
+        return hit
+
+    # --- helpers --------------------------------------------------------
+    def _slot(self, builder: Callable) -> str:
+        name = f"aux{self._n}"
+        self._n += 1
+        self.aux_builders[name] = builder
+        return name
+
+    def _coerce(self, fn, src: DataType, dst: DataType):
+        xp = self.xp
+        if src == dst:
+            return fn
+        if dst.is_decimal:
+            if src.is_decimal:
+                if dst.scale < src.scale:
+                    raise InternalError(f"cannot narrow decimal {src} -> {dst}")
+                mul = 10 ** (dst.scale - src.scale)
+                return lambda c, a: fn(c, a) * mul
+            if src.kind in ("int32", "int64"):
+                mul = 10 ** dst.scale
+                return lambda c, a: fn(c, a).astype("int64") * mul
+            if src.is_float and self.mode == "host":
+                mul = 10 ** dst.scale
+                return lambda c, a: np.round(fn(c, a) * mul).astype("int64")
+        if dst.kind == "float64":
+            if self.mode == "device":
+                raise PlanningError(
+                    "float64 expression reached the device compiler; the planner "
+                    "must mark this projection host-finalize"
+                )
+            if src.is_decimal:
+                div = 10.0 ** src.scale
+                return lambda c, a: fn(c, a).astype(np.float64) / div
+            return lambda c, a: fn(c, a).astype(np.float64)
+        if dst.kind == "int64" and src.kind in ("int32", "date32", "bool"):
+            return lambda c, a: fn(c, a).astype("int64")
+        if dst.kind == "int32" and src.kind in ("bool",):
+            return lambda c, a: fn(c, a).astype("int32")
+        if dst.kind == "float32":
+            return lambda c, a: fn(c, a).astype("float32")
+        raise PlanningError(f"unsupported coercion {src} -> {dst} ({self.mode} mode)")
+
+    def _lit_physical(self, lit: E.Lit, target: DataType):
+        v = lit.value
+        if target.is_decimal:
+            return int(round(float(v) * 10 ** target.scale))
+        if target.kind == "date32":
+            return int(v)
+        if target.kind in ("int32", "int64"):
+            return int(v)
+        if target.is_float:
+            return float(v)
+        if target.kind == "bool":
+            return bool(v)
+        raise PlanningError(f"cannot make literal {v!r} of type {target}")
+
+    # --- core recursive compile ----------------------------------------
+    def _c(self, e: E.Expr) -> Compiled:
+        xp = self.xp
+        sch = self.schema
+
+        if isinstance(e, E.Column):
+            name = e.name
+            dt = sch.field(name).dtype
+            if dt.is_string:
+                return Compiled(lambda c, a, n=name: c[n], dt,
+                                dict_fn=lambda d, n=name: d.get(n, np.array([], dtype=object)))
+            return Compiled(lambda c, a, n=name: c[n], dt)
+
+        if isinstance(e, E.Lit):
+            dt = e.dtype(sch)
+            if dt.is_string:
+                raise PlanningError("bare string literal outside a comparison")
+            v = self._lit_physical(e, dt) if not dt.is_float else float(e.value)
+            npdt = dt.np_dtype
+            return Compiled(lambda c, a, v=v, t=npdt: xp.asarray(v, dtype=t), dt, lit_value=e.value)
+
+        if isinstance(e, E.BinOp):
+            if e.op in E.BinOp.BOOLEANS:
+                lc, rc = self._c(e.left), self._c(e.right)
+                op = e.op
+                return Compiled(
+                    lambda c, a: (lc.fn(c, a) & rc.fn(c, a)) if op == "and" else (lc.fn(c, a) | rc.fn(c, a)),
+                    BOOL,
+                )
+            if e.op in E.BinOp.COMPARISONS:
+                return self._compile_comparison(e)
+            return self._compile_arith(e)
+
+        if isinstance(e, E.Not):
+            oc = self._c(e.operand)
+            return Compiled(lambda c, a: ~oc.fn(c, a), BOOL)
+
+        if isinstance(e, E.Negate):
+            oc = self._c(e.operand)
+            return Compiled(lambda c, a: -oc.fn(c, a), oc.dtype)
+
+        if isinstance(e, E.Case):
+            out_t = e.dtype(sch)
+            whens = [(self._c(cond), self._coerce_compiled(self._c(val), out_t)) for cond, val in e.whens]
+            else_c = (
+                self._coerce_compiled(self._c(e.else_), out_t)
+                if e.else_ is not None
+                else None
+            )
+            zero = 0.0 if out_t.is_float else 0
+
+            def case_fn(c, a):
+                result = else_c.fn(c, a) if else_c is not None else xp.asarray(zero, dtype=out_t.np_dtype)
+                for cond, val in reversed(whens):
+                    result = xp.where(cond.fn(c, a), val.fn(c, a), result)
+                return result
+
+            return Compiled(case_fn, out_t)
+
+        if isinstance(e, E.Cast):
+            oc = self._c(e.operand)
+            return self._coerce_compiled(oc, e.to)
+
+        if isinstance(e, E.InList):
+            oc = self._c(e.operand)
+            if oc.dtype.is_string:
+                values = sorted(set(e.values))
+                neg = e.negated
+
+                def in_lut(d, df=oc.dict_fn):
+                    dic = df(d)
+                    if len(dic) == 0:
+                        return np.zeros(1, dtype=bool)
+                    return np.isin(np.asarray(dic, dtype=object), values, invert=neg)
+
+                slot = self._slot(in_lut)
+                return Compiled(
+                    lambda c, a, s=slot: a[s][xp.clip(oc.fn(c, a), 0, None)] & (oc.fn(c, a) >= 0),
+                    BOOL,
+                )
+            vals = [self._lit_physical(E.Lit(v), oc.dtype) for v in e.values]
+
+            def inlist_fn(c, a):
+                x = oc.fn(c, a)
+                m = xp.zeros(x.shape, dtype=bool)
+                for v in vals:
+                    m = m | (x == v)
+                return ~m if e.negated else m
+
+            return Compiled(inlist_fn, BOOL)
+
+        if isinstance(e, E.Like):
+            oc = self._c(e.operand)
+            if not oc.dtype.is_string:
+                raise PlanningError("LIKE requires a string operand")
+            rx = like_to_regex(e.pattern)
+            neg = e.negated
+            slot = self._slot(
+                lambda d, df=oc.dict_fn: np.array(
+                    [(rx.match(s) is None) == neg if s is not None else neg for s in df(d)],
+                    dtype=bool,
+                )
+                if len(df(d))
+                else np.zeros(1, dtype=bool)
+            )
+            return Compiled(
+                lambda c, a, s=slot: a[s][xp.clip(oc.fn(c, a), 0, None)] & (oc.fn(c, a) >= 0),
+                BOOL,
+            )
+
+        if isinstance(e, E.IsNull):
+            oc = self._c(e.operand)
+            if oc.dtype.is_string:
+                if e.negated:
+                    return Compiled(lambda c, a: oc.fn(c, a) >= 0, BOOL)
+                return Compiled(lambda c, a: oc.fn(c, a) < 0, BOOL)
+            val = e.negated
+            return Compiled(lambda c, a: xp.full(oc.fn(c, a).shape, val, dtype=bool), BOOL)
+
+        if isinstance(e, E.Extract):
+            oc = self._c(e.operand)
+            if oc.dtype.kind != "date32":
+                raise PlanningError("EXTRACT requires a date operand")
+            field = e.field
+            return Compiled(lambda c, a: K.extract_field(oc.fn(c, a), field, xp), INT32)
+
+        if isinstance(e, E.Substring):
+            oc = self._c(e.operand)
+            if not oc.dtype.is_string:
+                raise PlanningError("SUBSTRING requires a string operand")
+            start, length = e.start, e.length
+
+            def remap_builder(d, df=oc.dict_fn):
+                src = df(d)
+                subs = [None if s is None else s[start - 1 : (None if length is None else start - 1 + length)] for s in src]
+                uniq = sorted({s for s in subs if s is not None})
+                index = {s: i for i, s in enumerate(uniq)}
+                return np.array([(-1 if s is None else index[s]) for s in subs], dtype=np.int32)
+
+            def out_dict_fn(d, df=oc.dict_fn):
+                src = df(d)
+                subs = {None if s is None else s[start - 1 : (None if length is None else start - 1 + length)] for s in src}
+                return np.array(sorted(s for s in subs if s is not None), dtype=object)
+
+            slot = self._slot(remap_builder)
+            return Compiled(
+                lambda c, a, s=slot: xp.where(
+                    oc.fn(c, a) >= 0, a[s][xp.clip(oc.fn(c, a), 0, None)], -1
+                ),
+                DataType("string"),
+                dict_fn=out_dict_fn,
+            )
+
+        if isinstance(e, E.ScalarSubquery):
+            raise InternalError(
+                "scalar subquery must be substituted with its value before compilation"
+            )
+        if isinstance(e, E.Agg):
+            raise InternalError("aggregate reached the expression compiler")
+        raise PlanningError(f"cannot compile {type(e).__name__}")
+
+    def _coerce_compiled(self, c: Compiled, to: DataType) -> Compiled:
+        if c.dtype == to:
+            return c
+        if c.lit_value is not None:
+            # re-materialize the literal directly in the target representation
+            xp = self.xp
+            v = self._lit_physical(E.Lit(c.lit_value), to)
+            npdt = to.np_dtype
+            return Compiled(lambda cc, a, v=v, t=npdt: xp.asarray(v, dtype=t), to, lit_value=c.lit_value)
+        return Compiled(self._coerce(c.fn, c.dtype, to), to, c.dict_fn if to.is_string else None)
+
+    # --- comparisons ----------------------------------------------------
+    def _compile_comparison(self, e: E.BinOp) -> Compiled:
+        xp = self.xp
+        sch = self.schema
+        lt = e.left.dtype(sch)
+        rt = e.right.dtype(sch)
+
+        # string comparisons via dictionary lookup tables
+        if lt.is_string or rt.is_string:
+            if lt.is_string and isinstance(e.right, E.Lit) and isinstance(e.right.value, str):
+                return self._string_cmp(self._c(e.left), e.op, e.right.value)
+            if rt.is_string and isinstance(e.left, E.Lit) and isinstance(e.left.value, str):
+                flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "<>": "<>"}[e.op]
+                return self._string_cmp(self._c(e.right), flipped, e.left.value)
+            raise PlanningError(f"unsupported string comparison {e}")
+
+        # numeric/date: unify to a common physical representation
+        target = self._cmp_target(lt, rt)
+        lc = self._coerce_compiled(self._c(e.left), target)
+        rc = self._coerce_compiled(self._c(e.right), target)
+        op = e.op
+
+        def cmp_fn(c, a):
+            l, r = lc.fn(c, a), rc.fn(c, a)
+            if op == "=":
+                return l == r
+            if op == "<>":
+                return l != r
+            if op == "<":
+                return l < r
+            if op == "<=":
+                return l <= r
+            if op == ">":
+                return l > r
+            return l >= r
+
+        return Compiled(cmp_fn, BOOL)
+
+    def _cmp_target(self, lt: DataType, rt: DataType) -> DataType:
+        if lt == rt:
+            return lt
+        if lt.kind == "date32" or rt.kind == "date32":
+            return DATE32
+        if lt.is_float or rt.is_float:
+            if self.mode == "device":
+                # comparing a decimal/int column against a float literal:
+                # scale into the decimal domain instead of floating point
+                if lt.is_decimal or rt.is_decimal:
+                    return lt if lt.is_decimal else rt
+                return FLOAT64  # ints vs float in device mode -> error in _coerce
+            return FLOAT64
+        if lt.is_decimal or rt.is_decimal:
+            ls = lt.scale if lt.is_decimal else 0
+            rs = rt.scale if rt.is_decimal else 0
+            from ..models.schema import decimal
+
+            return decimal(max(ls, rs))
+        if lt.kind == "int64" or rt.kind == "int64":
+            return INT64
+        return INT32
+
+    def _string_cmp(self, oc: Compiled, op: str, value: str) -> Compiled:
+        xp = self.xp
+
+        def lut_builder(d, df=oc.dict_fn):
+            dic = df(d)
+            if len(dic) == 0:
+                return np.zeros(1, dtype=bool)
+            arr = np.array([s if s is not None else "" for s in dic], dtype=object)
+            if op == "=":
+                out = arr == value
+            elif op == "<>":
+                out = arr != value
+            elif op == "<":
+                out = arr < value
+            elif op == "<=":
+                out = arr <= value
+            elif op == ">":
+                out = arr > value
+            else:
+                out = arr >= value
+            return out.astype(bool)
+
+        slot = self._slot(lut_builder)
+        return Compiled(
+            lambda c, a, s=slot: a[s][xp.clip(oc.fn(c, a), 0, None)] & (oc.fn(c, a) >= 0),
+            BOOL,
+        )
+
+    # --- arithmetic -----------------------------------------------------
+    def _compile_arith(self, e: E.BinOp) -> Compiled:
+        sch = self.schema
+        lt, rt = e.left.dtype(sch), e.right.dtype(sch)
+        out_t = E.unify_arith(e.op, lt, rt)
+        xp = self.xp
+        op = e.op
+
+        # date +/- interval days
+        if lt.kind == "date32" and rt.kind == "int32":
+            lc, rc = self._c(e.left), self._c(e.right)
+            if isinstance(e.right, E.Lit) and e.right.kind == "interval_month":
+                raise PlanningError("month interval arithmetic on a column is unsupported")
+            sign = 1 if op == "+" else -1
+            return Compiled(lambda c, a: (lc.fn(c, a) + sign * rc.fn(c, a)).astype("int32"), DATE32)
+
+        if op == "/":
+            if self.mode == "device":
+                raise PlanningError(
+                    "division reached the device compiler; divisions must be in "
+                    "host-finalize projections"
+                )
+            lc = self._coerce_compiled(self._c(e.left), FLOAT64)
+            rc = self._coerce_compiled(self._c(e.right), FLOAT64)
+            return Compiled(lambda c, a: lc.fn(c, a) / rc.fn(c, a), FLOAT64)
+
+        if op == "%":
+            lc = self._coerce_compiled(self._c(e.left), out_t)
+            rc = self._coerce_compiled(self._c(e.right), out_t)
+            return Compiled(lambda c, a: lc.fn(c, a) % rc.fn(c, a), out_t)
+
+        if out_t.is_decimal and op == "*":
+            # scales add: compute in raw int64 without rescaling operands
+            lc, rc = self._c(e.left), self._c(e.right)
+            lfn = lc.fn if lc.dtype.is_decimal else self._coerce(lc.fn, lc.dtype, DataType("decimal", 0))
+            rfn = rc.fn if rc.dtype.is_decimal else self._coerce(rc.fn, rc.dtype, DataType("decimal", 0))
+            return Compiled(lambda c, a: (lfn(c, a).astype("int64") * rfn(c, a).astype("int64")), out_t)
+
+        lc = self._coerce_compiled(self._c(e.left), out_t)
+        rc = self._coerce_compiled(self._c(e.right), out_t)
+        if op == "+":
+            return Compiled(lambda c, a: lc.fn(c, a) + rc.fn(c, a), out_t)
+        if op == "-":
+            return Compiled(lambda c, a: lc.fn(c, a) - rc.fn(c, a), out_t)
+        raise PlanningError(f"unsupported arithmetic {op}")

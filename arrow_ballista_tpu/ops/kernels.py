@@ -1,0 +1,279 @@
+"""Core device kernels: pure jit-compatible functions over padded arrays.
+
+These are the TPU replacements for the DataFusion operator internals the
+reference leans on (hash aggregate / hash join / sort inside the
+ShuffleWriter hot loop, reference
+ballista/core/src/execution_plans/shuffle_writer.rs:214-252).  Every kernel
+keeps **static shapes**: data-dependent cardinalities (group counts, join
+fan-out) go to fixed capacities with liveness masks, which is what lets XLA
+compile one fused program per stage.
+
+Key techniques:
+- grouping is sort-based (lexsort -> boundary flags -> segment reductions),
+  exact for any key combination, no hash tables in HBM required;
+- joins sort the build side by a 64-bit mixed key, probe via searchsorted,
+  expand variable fan-out through a cumulative-offset inversion, then verify
+  *real* key equality so hash collisions never corrupt results;
+- calendar decomposition (EXTRACT) uses the civil-from-days algorithm in
+  pure integer arithmetic.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+I64_MAX = jnp.int64(2**63 - 1)
+
+
+# --------------------------------------------------------------------------
+# hashing
+# --------------------------------------------------------------------------
+
+
+def hash64(arrays: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Combine columns into a 64-bit mixed hash (splitmix64-style)."""
+    h = jnp.zeros(arrays[0].shape, dtype=jnp.uint64)
+    for a in arrays:
+        x = a.astype(jnp.uint64)
+        x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> 31)
+        h = h * jnp.uint64(0x9E3779B97F4A7C15) + x
+        h = h ^ (h >> 29)
+    return h
+
+
+def bucket_of(key_arrays: Sequence[jnp.ndarray], num_buckets: int) -> jnp.ndarray:
+    """Shuffle partition id per row (same role as the reference's
+    BatchPartitioner hash path, shuffle_writer.rs:201-252)."""
+    return (hash64(key_arrays) % jnp.uint64(num_buckets)).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# compaction
+# --------------------------------------------------------------------------
+
+
+def compaction_order(mask: jnp.ndarray) -> jnp.ndarray:
+    """Stable permutation moving live rows to the front."""
+    return jnp.argsort(~mask, stable=True)
+
+
+def compact_columns(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
+    order = compaction_order(mask)
+    return {k: v[order] for k, v in cols.items()}, mask[order]
+
+
+# --------------------------------------------------------------------------
+# sorting
+# --------------------------------------------------------------------------
+
+
+def sort_order(keys: Sequence[Tuple[jnp.ndarray, bool]], mask: jnp.ndarray) -> jnp.ndarray:
+    """Permutation sorting live rows by (k1, k2, ...) with per-key
+    ascending flags; dead rows sort to the end."""
+    seq = []
+    for arr, asc in reversed(list(keys)):
+        a = arr
+        if not asc:
+            if a.dtype == jnp.bool_:
+                a = ~a
+            else:
+                a = -a.astype(jnp.int64) if a.dtype.kind == "i" else -a
+        seq.append(a)
+    seq.append(~mask)  # primary: live rows first
+    return jnp.lexsort(seq)
+
+
+# --------------------------------------------------------------------------
+# grouped aggregation (sort-based, static output capacity)
+# --------------------------------------------------------------------------
+
+AGG_SUM = "sum"
+AGG_COUNT = "count"
+AGG_MIN = "min"
+AGG_MAX = "max"
+
+
+def grouped_aggregate(
+    key_cols: List[jnp.ndarray],
+    val_cols: List[Tuple[jnp.ndarray, str]],
+    mask: jnp.ndarray,
+    out_capacity: int,
+):
+    """Group by ``key_cols`` and reduce ``val_cols`` (list of (array, how)).
+
+    Returns (out_keys: list, out_vals: list, out_mask, overflow: bool scalar).
+    Exact for arbitrary keys (sort-based).  ``out_capacity`` bounds distinct
+    groups; ``overflow`` flags truncation (host raises CapacityError).
+    """
+    n = mask.shape[0]
+    if key_cols:
+        order = sort_order([(k, True) for k in key_cols], mask)
+    else:
+        order = compaction_order(mask)
+    mask_s = mask[order]
+    keys_s = [k[order] for k in key_cols]
+
+    if key_cols:
+        first = jnp.zeros(n, dtype=bool).at[0].set(True)
+        diff = jnp.zeros(n, dtype=bool)
+        for k in keys_s:
+            diff = diff | (k != jnp.roll(k, 1))
+        boundary = mask_s & (first | diff)
+    else:
+        # global aggregate: one group iff any live row
+        boundary = (jnp.arange(n) == 0) & (jnp.sum(mask) > 0)
+
+    seg = jnp.cumsum(boundary) - 1  # group index per sorted row (-1 before first)
+    num_groups = jnp.sum(boundary)
+    # dead or out-of-capacity rows -> dump segment
+    seg_ok = mask_s & (seg >= 0) & (seg < out_capacity)
+    seg_ids = jnp.where(seg_ok, seg, out_capacity)
+
+    out_vals = []
+    for arr, how in val_cols:
+        a = arr[order]
+        if how == AGG_COUNT:
+            v = jax.ops.segment_sum(jnp.where(seg_ok, 1, 0).astype(jnp.int64), seg_ids,
+                                    num_segments=out_capacity + 1)[:out_capacity]
+        elif how == AGG_SUM:
+            v = jax.ops.segment_sum(jnp.where(seg_ok, a, jnp.zeros((), a.dtype)), seg_ids,
+                                    num_segments=out_capacity + 1)[:out_capacity]
+        elif how == AGG_MIN:
+            ident = _max_ident(a.dtype)
+            v = jax.ops.segment_min(jnp.where(seg_ok, a, ident), seg_ids,
+                                    num_segments=out_capacity + 1)[:out_capacity]
+        elif how == AGG_MAX:
+            ident = _min_ident(a.dtype)
+            v = jax.ops.segment_max(jnp.where(seg_ok, a, ident), seg_ids,
+                                    num_segments=out_capacity + 1)[:out_capacity]
+        else:
+            raise ValueError(f"unknown agg {how}")
+        out_vals.append(v)
+
+    out_keys = []
+    for k in keys_s:
+        # scatter each group's first (boundary) row into its slot; non-boundary
+        # rows aim at the dump index and are dropped
+        ok = jnp.zeros(out_capacity, dtype=k.dtype).at[
+            jnp.where(boundary & seg_ok, seg, out_capacity)
+        ].set(k, mode="drop")
+        out_keys.append(ok)
+
+    out_mask = jnp.arange(out_capacity) < jnp.minimum(num_groups, out_capacity)
+    overflow = num_groups > out_capacity
+    return out_keys, out_vals, out_mask, overflow
+
+
+def _max_ident(dtype):
+    if dtype.kind == "f":
+        return jnp.array(jnp.inf, dtype=dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype=dtype)
+
+
+def _min_ident(dtype):
+    if dtype.kind == "f":
+        return jnp.array(-jnp.inf, dtype=dtype)
+    return jnp.array(jnp.iinfo(dtype).min, dtype=dtype)
+
+
+# --------------------------------------------------------------------------
+# join (sorted build + searchsorted probe + offset-inversion expansion)
+# --------------------------------------------------------------------------
+
+
+def build_side_sort(build_keys: List[jnp.ndarray], build_mask: jnp.ndarray):
+    """Sort the build side by mixed 64-bit key; dead rows get I64_MAX-as-uint.
+
+    Returns (hash_sorted: uint64, order: int32 permutation, n_build).
+    """
+    h = hash64(build_keys)
+    h = jnp.where(build_mask, h, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    order = jnp.argsort(h)
+    return h[order], order, jnp.sum(build_mask)
+
+
+def probe_join(
+    probe_hash: jnp.ndarray,
+    probe_mask: jnp.ndarray,
+    build_hash_sorted: jnp.ndarray,
+    out_capacity: int,
+):
+    """Match probe rows against the sorted build hashes.
+
+    Returns (probe_idx, build_pos, pair_valid, total_pairs):
+    - ``probe_idx[j]``: which probe row pair j belongs to,
+    - ``build_pos[j]``: position in the *sorted* build array,
+    - ``pair_valid[j]``: pair j is within the real match set,
+    - ``total_pairs``: dynamic count (<= out_capacity or overflow).
+    Callers MUST verify real key equality afterwards (hash collisions).
+    """
+    lo = jnp.searchsorted(build_hash_sorted, probe_hash, side="left")
+    hi = jnp.searchsorted(build_hash_sorted, probe_hash, side="right")
+    counts = jnp.where(probe_mask, hi - lo, 0)
+    offsets = jnp.cumsum(counts)  # inclusive
+    total = offsets[-1]
+    starts = offsets - counts
+
+    j = jnp.arange(out_capacity)
+    # probe row for output slot j: first i with offsets[i] > j
+    probe_idx = jnp.searchsorted(offsets, j, side="right")
+    probe_idx = jnp.clip(probe_idx, 0, probe_hash.shape[0] - 1)
+    k = j - starts[probe_idx]
+    build_pos = lo[probe_idx] + k
+    pair_valid = (j < total) & (k >= 0) & (k < counts[probe_idx])
+    build_pos = jnp.clip(build_pos, 0, build_hash_sorted.shape[0] - 1)
+    return probe_idx, build_pos, pair_valid, total
+
+
+def segment_any(values: jnp.ndarray, seg_ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    """Per-segment logical OR (used for semi/anti reduction)."""
+    return jax.ops.segment_max(values.astype(jnp.int32), seg_ids, num_segments=num_segments) > 0
+
+
+# --------------------------------------------------------------------------
+# calendar (EXTRACT) — civil-from-days, pure integer ops
+# --------------------------------------------------------------------------
+
+
+def civil_from_days(days, xp=jnp):
+    """Epoch days -> (year, month, day), vectorized (Howard Hinnant's algo).
+
+    ``xp`` is jnp (device) or numpy (host-finalize expression mode).
+    """
+    z = days.astype("int64") + 719468
+    era = xp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + xp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype("int32"), m.astype("int32"), d.astype("int32")
+
+
+def extract_field(days, field: str, xp=jnp):
+    y, m, d = civil_from_days(days, xp)
+    if field == "year":
+        return y
+    if field == "month":
+        return m
+    if field == "day":
+        return d
+    raise ValueError(f"unsupported EXTRACT field {field}")
+
+
+# --------------------------------------------------------------------------
+# top-k (sort + limit fusion)
+# --------------------------------------------------------------------------
+
+
+def topk_order(keys, mask, k: int) -> jnp.ndarray:
+    """First k positions of the sort order (full sort; XLA's sort is fast)."""
+    return sort_order(keys, mask)[:k]

@@ -1,0 +1,581 @@
+"""Physical operators: projection, filter, aggregate, join, sort, limit.
+
+These replace the DataFusion single-node operator set the reference depends
+on (FilterExec/AggregateExec/HashJoinExec/SortExec — external to the
+reference repo, wired in via ballista/executor's DataFusion runtime).  Each
+is an XLA program over fixed-capacity batches; data-dependent cardinalities
+(groups, join fan-out) use static capacities + masks (see ops/kernels.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models import expr as E
+from ..models.batch import ColumnBatch, concat_batches
+from ..models.schema import BOOL, DataType, Field, INT64, Schema
+from ..utils.config import AGG_CAPACITY, JOIN_OUTPUT_FACTOR
+from ..utils.errors import CapacityError, ExecutionError, InternalError
+from .expressions import Compiled, ExprCompiler
+from . import kernels as K
+from .physical import ExecutionPlan, Partitioning, TaskContext
+
+
+def _substitute_scalars(e: E.Expr, scalars: Dict[str, object]) -> E.Expr:
+    """Replace ScalarSubquery placeholders with literal values computed
+    before stage launch (ctx.scalars keyed by id of the subquery plan)."""
+    if isinstance(e, E.ScalarSubquery):
+        key = getattr(e, "scalar_id", None) or id(e.plan)
+        if key not in scalars:
+            raise InternalError("scalar subquery value missing at execution time")
+        v = scalars[key]
+        dt = e.plan.schema.fields[0].dtype
+        if dt.is_decimal:
+            # value arrives as raw scaled int -> keep exact by re-scaling to float
+            return E.Lit(v / (10 ** dt.scale) if isinstance(v, int) else v)
+        return E.Lit(v)
+    from ..sql.planner import _map_children
+
+    return _map_children(e, lambda c: _substitute_scalars(c, scalars))
+
+
+class ProjectionExec(ExecutionPlan):
+    """Computes output columns; ``host_mode`` runs in numpy float64 (used for
+    tiny post-aggregation projections containing division)."""
+
+    def __init__(self, input: ExecutionPlan, exprs: List[Tuple[E.Expr, str]],
+                 host_mode: bool = False):
+        self.input = input
+        self.exprs = exprs
+        self.host_mode = host_mode
+        in_schema = input.schema
+        self._schema = Schema(Field(n, e.dtype(in_schema)) for e, n in exprs)
+        self._compiled = None
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return self.input.output_partition_count()
+
+    def output_partitioning(self):
+        return self.input.output_partitioning()
+
+    def _compile(self, scalars):
+        comp = ExprCompiler(self.input.schema, "host" if self.host_mode else "device")
+        compiled = [(comp.compile(_substitute_scalars(e, scalars)), n) for e, n in self.exprs]
+        if not self.host_mode:
+            fns = [(c.fn, n) for c, n in compiled]
+
+            def proj_fn(cols, mask, aux):
+                return {n: f(cols, aux) for f, n in fns}, mask
+
+            jfn = jax.jit(proj_fn)
+        else:
+            jfn = None
+        return comp, compiled, jfn
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        if self._compiled is None:
+            self._compiled = self._compile(ctx.scalars)
+        comp, compiled, jfn = self._compiled
+        out = []
+        for b in self.input.execute(partition, ctx):
+            with self.metrics().timer("compute_time"):
+                dicts = {}
+                for c, n in compiled:
+                    if c.dict_fn is not None:
+                        dicts[n] = c.dict_fn(b.dicts)
+                if self.host_mode:
+                    cols_np = {k: np.asarray(v) for k, v in b.columns.items()}
+                    aux = comp.aux_arrays(b.dicts)
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        new_cols = {n: np.broadcast_to(np.asarray(c.fn(cols_np, aux)), (b.capacity,))
+                                    for c, n in compiled}
+                    out.append(ColumnBatch(
+                        self._schema,
+                        {k: jnp.asarray(np.ascontiguousarray(v)) for k, v in new_cols.items()},
+                        b.mask, dicts))
+                else:
+                    aux = comp.aux_arrays(b.dicts)
+                    new_cols, mask = jfn(b.columns, b.mask, aux)
+                    # broadcast scalar literals to full columns
+                    new_cols = {
+                        k: (jnp.broadcast_to(v, (b.capacity,)) if v.ndim == 0 else v)
+                        for k, v in new_cols.items()
+                    }
+                    out.append(ColumnBatch(self._schema, new_cols, mask, dicts))
+        return out
+
+    def _label(self):
+        mode = " (host)" if self.host_mode else ""
+        return "ProjectionExec" + mode + ": " + ", ".join(n for _, n in self.exprs)
+
+
+class RenameExec(ExecutionPlan):
+    """Zero-cost column rename (alias qualification): rewraps batches with a
+    new schema; no device work."""
+
+    def __init__(self, input: ExecutionPlan, schema: Schema):
+        if len(schema) != len(input.schema):
+            raise InternalError("rename schema arity mismatch")
+        self.input = input
+        self._schema = schema
+        self._mapping = list(zip(input.schema.names(), schema.names()))
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return self.input.output_partition_count()
+
+    def output_partitioning(self):
+        return self.input.output_partitioning()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        out = []
+        for b in self.input.execute(partition, ctx):
+            cols = {new: b.columns[old] for old, new in self._mapping}
+            dicts = {new: b.dicts[old] for old, new in self._mapping if old in b.dicts}
+            out.append(ColumnBatch(self._schema, cols, b.mask, dicts))
+        return out
+
+    def _label(self):
+        return "RenameExec: " + ", ".join(n for n in self._schema.names())
+
+
+class FilterExec(ExecutionPlan):
+    def __init__(self, input: ExecutionPlan, predicate: E.Expr):
+        self.input = input
+        self.predicate = predicate
+        self._schema = input.schema
+        self._compiled = None
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return self.input.output_partition_count()
+
+    def output_partitioning(self):
+        return self.input.output_partitioning()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        if self._compiled is None:
+            comp = ExprCompiler(self.input.schema, "device")
+            pred = comp.compile(_substitute_scalars(self.predicate, ctx.scalars))
+            if pred.dtype != BOOL:
+                raise InternalError("filter predicate must be boolean")
+            jfn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
+            self._compiled = (comp, jfn)
+        comp, jfn = self._compiled
+        out = []
+        for b in self.input.execute(partition, ctx):
+            with self.metrics().timer("compute_time"):
+                aux = comp.aux_arrays(b.dicts)
+                out.append(ColumnBatch(b.schema, b.columns, jfn(b.columns, b.mask, aux), b.dicts))
+        return out
+
+    def _label(self):
+        return f"FilterExec: {self.predicate}"
+
+
+# --------------------------------------------------------------------------
+# aggregation
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AggSpec:
+    func: str  # sum | count | min | max
+    operand: Optional[E.Expr]  # None for count(*)
+    name: str
+
+
+class HashAggregateExec(ExecutionPlan):
+    """Sort-based grouped aggregation with static group capacity.
+
+    ``mode``:
+    - 'partial': per input partition, emits group states (runs before the
+      shuffle, like DataFusion's partial AggregateExec in reference stage
+      plans, planner.rs:80-165);
+    - 'final': merges states after a hash repartition on group keys;
+    - 'single': both in one (single-partition plans).
+    """
+
+    MERGE = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
+    def __init__(self, input: ExecutionPlan, group_exprs: List[Tuple[E.Expr, str]],
+                 aggs: List[AggSpec], mode: str):
+        assert mode in ("partial", "final", "single")
+        self.input = input
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+        self.mode = mode
+        in_schema = input.schema
+        fields = [Field(n, e.dtype(in_schema)) for e, n in group_exprs]
+        for a in self.aggs:
+            fields.append(Field(a.name, self._agg_dtype(a, in_schema)))
+        self._schema = Schema(fields)
+        self._compiled = None
+
+    def _agg_dtype(self, a: AggSpec, in_schema: Schema) -> DataType:
+        if self.mode == "final":
+            # input columns are already agg states named a.name
+            return in_schema.field(a.name).dtype
+        if a.func == "count":
+            return INT64
+        t = a.operand.dtype(in_schema)
+        if a.func == "sum" and t.kind == "int32":
+            return INT64
+        return t
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return self.input.output_partition_count() if self.mode != "single" else 1
+
+    def output_partitioning(self):
+        if self.mode == "final":
+            return self.input.output_partitioning()
+        return Partitioning.unknown(self.output_partition_count())
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        cfg_cap = ctx.config.get(AGG_CAPACITY)
+        batches = self.input.execute(partition, ctx)
+        in_schema = self.input.schema
+        big = concat_batches(in_schema, batches).shrink()
+
+        if self._compiled is None:
+            comp = ExprCompiler(in_schema, "device")
+            group_c = [(comp.compile(_substitute_scalars(e, ctx.scalars)), n)
+                       for e, n in self.group_exprs]
+            agg_c = []
+            for a in self.aggs:
+                if self.mode == "final":
+                    operand = E.Column(a.name)
+                    how = self.MERGE[a.func]
+                else:
+                    operand = a.operand if a.operand is not None else None
+                    how = a.func
+                cc = comp.compile(_substitute_scalars(operand, ctx.scalars)) if operand is not None else None
+                agg_c.append((cc, how, a.name))
+
+            def agg_fn(cols, mask, aux, out_cap):
+                keys = [c.fn(cols, aux) for c, _ in group_c]
+                vals = []
+                for cc, how, _ in agg_c:
+                    if cc is None:  # count(*)
+                        vals.append((jnp.zeros(mask.shape, jnp.int64), K.AGG_COUNT))
+                    else:
+                        vals.append((cc.fn(cols, aux), how))
+                return K.grouped_aggregate(keys, vals, mask, out_cap)
+
+            self._compiled = (comp, group_c, agg_c, jax.jit(agg_fn, static_argnums=(3,)))
+
+        comp, group_c, agg_c, jfn = self._compiled
+        out_cap = min(cfg_cap, big.capacity)
+        with self.metrics().timer("agg_time"):
+            aux = comp.aux_arrays(big.dicts)
+            out_keys, out_vals, out_mask, overflow = jfn(big.columns, big.mask, aux, out_cap)
+        if bool(overflow):
+            raise CapacityError(
+                f"aggregation exceeded {out_cap} groups; raise {AGG_CAPACITY}"
+            )
+
+        cols: Dict[str, jnp.ndarray] = {}
+        dicts: Dict[str, np.ndarray] = {}
+        for (cc, name), arr in zip(group_c, out_keys):
+            cols[name] = arr
+            if cc.dict_fn is not None:
+                dicts[name] = cc.dict_fn(big.dicts)
+        for (cc, how, name), arr in zip(agg_c, out_vals):
+            cols[name] = arr
+
+        result = ColumnBatch(self._schema, cols, out_mask, dicts)
+
+        # SQL semantics: a global aggregate ('single'/'final' with no keys)
+        # over empty input yields one row (count=0, sums empty)
+        if not self.group_exprs and self.mode in ("single", "final") and result.num_rows == 0:
+            data = {}
+            for a in self.aggs:
+                f = self._schema.field(a.name)
+                data[a.name] = np.zeros(1, dtype=f.dtype.np_dtype)
+            result = ColumnBatch.from_numpy(self._schema, data, dicts={})
+        self.metrics().add("output_rows", result.num_rows)
+        return [result]
+
+    def _label(self):
+        g = ", ".join(n for _, n in self.group_exprs)
+        a = ", ".join(f"{x.func}({x.name})" for x in self.aggs)
+        return f"HashAggregateExec({self.mode}): groupBy=[{g}] aggr=[{a}]"
+
+
+# --------------------------------------------------------------------------
+# join
+# --------------------------------------------------------------------------
+
+
+class JoinExec(ExecutionPlan):
+    """Equi-join: sorted-build + searchsorted probe + static-capacity pair
+    expansion (ops/kernels.py).  Probe = left child, build = right child.
+
+    ``dist``: 'partitioned' (both children hash-partitioned on keys — the
+    planner inserts shuffles) or 'broadcast' (build side read fully by every
+    probe partition; for small tables, avoids a shuffle).
+
+    Hash collisions cannot corrupt results: real key equality is re-verified
+    on every candidate pair.
+    """
+
+    def __init__(self, left: ExecutionPlan, right: ExecutionPlan,
+                 on: List[Tuple[E.Expr, E.Expr]], join_type: str = "inner",
+                 filter: Optional[E.Expr] = None, dist: str = "partitioned"):
+        assert join_type in ("inner", "left", "semi", "anti")
+        assert dist in ("partitioned", "broadcast")
+        self.left = left
+        self.right = right
+        self.on = on
+        self.join_type = join_type
+        self.filter = filter
+        self.dist = dist
+        if join_type in ("semi", "anti"):
+            self._schema = left.schema
+        else:
+            self._schema = left.schema.merge(right.schema)
+        self._compiled = None
+
+    def children(self):
+        return [self.left, self.right]
+
+    def output_partition_count(self):
+        return self.left.output_partition_count()
+
+    def output_partitioning(self):
+        return self.left.output_partitioning()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        probe = concat_batches(self.left.schema, self.left.execute(partition, ctx)).shrink()
+        if self.dist == "broadcast":
+            build_parts = []
+            for p in range(self.right.output_partition_count()):
+                build_parts.extend(self.right.execute(p, ctx))
+            build = concat_batches(self.right.schema, build_parts).shrink()
+        else:
+            build = concat_batches(self.right.schema, self.right.execute(partition, ctx)).shrink()
+
+        lsch, rsch = self.left.schema, self.right.schema
+        out_factor = ctx.config.get(JOIN_OUTPUT_FACTOR)
+
+        if self._compiled is None:
+            lcomp = ExprCompiler(lsch, "device")
+            rcomp = ExprCompiler(rsch, "device")
+            lkeys = [lcomp.compile_key(le) for le, _ in self.on]
+            rkeys = [rcomp.compile_key(re_) for _, re_ in self.on]
+            fcomp = fpred = None
+            if self.filter is not None:
+                merged = lsch.merge(rsch)
+                fcomp = ExprCompiler(merged, "device")
+                fpred = fcomp.compile(_substitute_scalars(self.filter, ctx.scalars))
+
+            jt = self.join_type
+            lnames = [f.name for f in lsch]
+            rnames = [f.name for f in rsch]
+            rnull_str = {f.name for f in rsch if f.dtype.is_string}
+
+            def join_fn(pcols, pmask, bcols, bmask, laux, raux, faux, out_cap):
+                pk = [c.fn(pcols, laux) for c in lkeys]
+                bk = [c.fn(bcols, raux) for c in rkeys]
+                bh_sorted, border, _ = K.build_side_sort(bk, bmask)
+                ph = K.hash64(pk)
+                pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
+                bidx = border[bp]
+                # verify real key equality (hash collisions) + build liveness;
+                # string keys are value-hashes: exclude the NULL sentinel so
+                # NULL never equals NULL (SQL semantics)
+                ok = pair_valid & bmask[bidx]
+                for (a, b), ck in zip(zip(pk, bk), lkeys):
+                    ok = ok & (a[pi] == b[bidx])
+                    if ck.dtype.is_string:
+                        sent = ExprCompiler.NULL_KEY_SENTINEL
+                        ok = ok & (a[pi] != sent)
+                if fpred is not None:
+                    pair_cols = {n: pcols[n][pi] for n in lnames}
+                    pair_cols.update({n: bcols[n][bidx] for n in rnames})
+                    ok = ok & fpred.fn(pair_cols, faux)
+
+                if jt in ("semi", "anti"):
+                    hit = K.segment_any(ok, pi, pmask.shape[0])
+                    new_mask = pmask & (hit if jt == "semi" else ~hit)
+                    return pcols, new_mask, total
+
+                out_cols = {n: pcols[n][pi] for n in lnames}
+                out_cols.update({n: bcols[n][bidx] for n in rnames})
+                out_mask = ok
+                if jt == "left":
+                    hit = K.segment_any(ok, pi, pmask.shape[0])
+                    miss = pmask & ~hit
+                    # append unmatched probe rows; build side filled with NULLs
+                    # (string columns use the -1 null code, numerics zero)
+                    out_cols = {
+                        n: jnp.concatenate([
+                            out_cols[n],
+                            pcols[n] if n in lnames else jnp.full(
+                                pmask.shape[0],
+                                -1 if n in rnull_str else 0,
+                                out_cols[n].dtype,
+                            ),
+                        ])
+                        for n in out_cols
+                    }
+                    out_mask = jnp.concatenate([out_mask, miss])
+                return out_cols, out_mask, total
+
+            self._compiled = (lcomp, rcomp, fcomp, jax.jit(join_fn, static_argnums=(7,)))
+        lcomp, rcomp, fcomp, jfn = self._compiled
+
+        laux = lcomp.aux_arrays(probe.dicts)
+        raux = rcomp.aux_arrays(build.dicts)
+        faux = fcomp.aux_arrays({**probe.dicts, **build.dicts}) if fcomp is not None else {}
+        out_cap = out_factor * probe.capacity
+
+        with self.metrics().timer("join_time"):
+            out_cols, out_mask, total = jfn(
+                probe.columns, probe.mask, build.columns, build.mask, laux, raux, faux, out_cap
+            )
+        if int(total) > out_cap:
+            raise CapacityError(
+                f"join produced {int(total)} candidate pairs > capacity {out_cap}; "
+                f"raise {JOIN_OUTPUT_FACTOR}"
+            )
+
+        dicts = dict(probe.dicts)
+        if self.join_type in ("inner", "left"):
+            dicts.update(build.dicts)
+        result = ColumnBatch(self._schema, dict(out_cols), out_mask, dicts)
+        self.metrics().add("output_rows", result.num_rows)
+        return [result]
+
+    def _label(self):
+        on = ", ".join(f"{l} = {r}" for l, r in self.on)
+        f = f" filter={self.filter}" if self.filter is not None else ""
+        return f"JoinExec({self.join_type}, {self.dist}): on=[{on}]{f}"
+
+
+# --------------------------------------------------------------------------
+# sort / limit / coalesce
+# --------------------------------------------------------------------------
+
+
+class SortExec(ExecutionPlan):
+    """Total sort of a single-partition input (the planner shuffles to one
+    partition first, like the reference's SortPreservingMerge stage split,
+    reference ballista/scheduler/src/planner.rs:80-165).  ``fetch`` fuses
+    LIMIT into the sort."""
+
+    def __init__(self, input: ExecutionPlan, keys: List[Tuple[E.Expr, bool]],
+                 fetch: Optional[int] = None):
+        self.input = input
+        self.keys = keys
+        self.fetch = fetch
+        self._schema = input.schema
+        self._compiled = None
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return 1
+
+    def output_partitioning(self):
+        return Partitioning.single()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        parts = []
+        for p in range(self.input.output_partition_count()):
+            parts.extend(self.input.execute(p, ctx))
+        big = concat_batches(self.input.schema, parts).shrink()
+
+        if self._compiled is None:
+            comp = ExprCompiler(self.input.schema, "device")
+            keys_c = [(comp.compile(_substitute_scalars(e, ctx.scalars)), asc) for e, asc in self.keys]
+
+            def sort_fn(cols, mask, aux):
+                key_arrays = [(c.fn(cols, aux), asc) for c, asc in keys_c]
+                order = K.sort_order(key_arrays, mask)
+                return {k: v[order] for k, v in cols.items()}, mask[order]
+
+            self._compiled = (comp, jax.jit(sort_fn))
+        comp, jfn = self._compiled
+        with self.metrics().timer("sort_time"):
+            aux = comp.aux_arrays(big.dicts)
+            cols, mask = jfn(big.columns, big.mask, aux)
+        b = ColumnBatch(self._schema, cols, mask, big.dicts)
+        if self.fetch is not None and self.fetch < b.capacity:
+            keep = max(self.fetch, 1)
+            cols = {k: v[:keep] for k, v in cols.items()}
+            mask = mask[:keep] & (jnp.arange(keep) < self.fetch)
+            b = ColumnBatch(self._schema, cols, mask, big.dicts)
+        return [b]
+
+    def _label(self):
+        k = ", ".join(f"{e} {'ASC' if asc else 'DESC'}" for e, asc in self.keys)
+        f = f" fetch={self.fetch}" if self.fetch is not None else ""
+        return f"SortExec: [{k}]{f}"
+
+
+class LimitExec(ExecutionPlan):
+    def __init__(self, input: ExecutionPlan, n: int):
+        self.input = input
+        self.n = n
+        self._schema = input.schema
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return 1
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        parts = []
+        for p in range(self.input.output_partition_count()):
+            parts.extend(self.input.execute(p, ctx))
+        big = concat_batches(self.input.schema, parts)
+        cols, mask = K.compact_columns(big.columns, big.mask)
+        keep = max(self.n, 1)
+        cols = {k: v[:keep] for k, v in cols.items()}
+        mask = mask[:keep] & (jnp.arange(keep) < self.n)
+        return [ColumnBatch(self._schema, cols, mask, big.dicts)]
+
+    def _label(self):
+        return f"LimitExec: {self.n}"
+
+
+class CoalescePartitionsExec(ExecutionPlan):
+    """Merges all input partitions into one (reference analog:
+    CoalescePartitionsExec, a stage-split point in planner.rs:117-131)."""
+
+    def __init__(self, input: ExecutionPlan):
+        self.input = input
+        self._schema = input.schema
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return 1
+
+    def output_partitioning(self):
+        return Partitioning.single()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        out = []
+        for p in range(self.input.output_partition_count()):
+            out.extend(self.input.execute(p, ctx))
+        return out

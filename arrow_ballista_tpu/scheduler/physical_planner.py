@@ -1,0 +1,189 @@
+"""Logical plan -> physical plan with exchange (repartition) insertion.
+
+The reference delegates physical planning to DataFusion and then splits the
+result into stages (reference ballista/scheduler/src/state/mod.rs:315-380
+``plan_job`` -> planner.rs stage split).  Here physical planning inserts
+``RepartitionExec`` markers at the same boundaries DataFusion would
+(partial/final aggregates, partitioned joins, shuffle-to-one before sorts),
+and ``scheduler/planner.py`` (DistributedPlanner) splits at those markers.
+
+TPU-specific decisions made here:
+- **host-finalize projections**: any projection producing float64 (division)
+  runs host-side in numpy — keeps the device program f64-free;
+- **broadcast joins**: build sides with small estimated row counts skip the
+  shuffle (every probe partition reads the whole build side);
+- static capacities (agg groups, join fan-out) come from session config.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..catalog import SchemaCatalog
+from ..models import expr as E
+from ..models import logical as L
+from ..ops import operators as O
+from ..ops.physical import ExecutionPlan, Partitioning
+from ..ops.shuffle import RepartitionExec
+from ..utils.config import BROADCAST_THRESHOLD, BallistaConfig
+from ..utils.errors import PlanningError
+
+
+@dataclasses.dataclass
+class PlannedQuery:
+    plan: ExecutionPlan
+    # scalar subqueries to execute before the main job: (scalar_id, plan)
+    scalars: List[Tuple[str, ExecutionPlan]]
+
+
+class PhysicalPlanner:
+    def __init__(self, catalog: SchemaCatalog, config: BallistaConfig):
+        self.catalog = catalog
+        self.config = config
+        self._scalars: List[Tuple[str, ExecutionPlan]] = []
+        self._scalar_seq = 0
+
+    # --- entry ----------------------------------------------------------
+    def plan_query(self, logical: L.LogicalPlan) -> PlannedQuery:
+        self._scalars = []
+        plan = self.create(logical)
+        return PlannedQuery(plan, list(self._scalars))
+
+    def create(self, node: L.LogicalPlan) -> ExecutionPlan:
+        if isinstance(node, L.TableScan):
+            provider = self.catalog.provider(node.table)
+            filters = [self._prep_expr(f) for f in node.filters]
+            return provider.scan(node.projection, filters, self.config.shuffle_partitions)
+
+        if isinstance(node, L.SubqueryAlias):
+            child = self.create(node.input)
+            return O.RenameExec(child, node.schema)
+
+        if isinstance(node, L.Projection):
+            child = self.create(node.input)
+            exprs = [(self._prep_expr(e), n) for e, n in node.exprs]
+            host = any(e.dtype(child.schema).kind == "float64" for e, _ in exprs)
+            return O.ProjectionExec(child, exprs, host_mode=host)
+
+        if isinstance(node, L.Filter):
+            child = self.create(node.input)
+            return O.FilterExec(child, self._prep_expr(node.predicate))
+
+        if isinstance(node, L.Aggregate):
+            return self._plan_aggregate(node)
+
+        if isinstance(node, L.Distinct):
+            child_logical = node.input
+            groups = [(E.Column(f.name), f.name) for f in child_logical.schema]
+            agg = L.Aggregate(child_logical, groups, [])
+            return self._plan_aggregate(agg)
+
+        if isinstance(node, L.Join):
+            return self._plan_join(node)
+
+        if isinstance(node, L.CrossJoin):
+            raise PlanningError("cross joins are not supported yet")
+
+        if isinstance(node, L.Sort):
+            child = self.create(node.input)
+            child = self._to_single_partition(child)
+            keys = [(self._prep_expr(e), asc) for e, asc in node.keys]
+            return O.SortExec(child, keys)
+
+        if isinstance(node, L.Limit):
+            if isinstance(node.input, L.Sort):
+                child = self.create(node.input.input)
+                child = self._to_single_partition(child)
+                keys = [(self._prep_expr(e), asc) for e, asc in node.input.keys]
+                return O.SortExec(child, keys, fetch=node.n)
+            child = self.create(node.input)
+            return O.LimitExec(child, node.n)
+
+        raise PlanningError(f"cannot create physical plan for {type(node).__name__}")
+
+    # --- pieces ---------------------------------------------------------
+    def _prep_expr(self, e: E.Expr) -> E.Expr:
+        """Assign stable ids to scalar subqueries and plan them."""
+        if isinstance(e, E.ScalarSubquery):
+            sid = getattr(e, "scalar_id", None)
+            if sid is None:
+                sid = f"sq{self._scalar_seq}"
+                self._scalar_seq += 1
+                object.__setattr__(e, "scalar_id", sid)
+                sub_physical = self.create(e.plan)
+                sub_physical = self._to_single_partition(sub_physical)
+                self._scalars.append((sid, sub_physical))
+            return e
+        from ..sql.planner import _map_children
+
+        return _map_children(e, self._prep_expr)
+
+    def _to_single_partition(self, plan: ExecutionPlan) -> ExecutionPlan:
+        if plan.output_partition_count() <= 1:
+            return plan
+        return RepartitionExec(plan, Partitioning.single())
+
+    def _plan_aggregate(self, node: L.Aggregate) -> ExecutionPlan:
+        child = self.create(node.input)
+        groups = [(self._prep_expr(e), n) for e, n in node.group_exprs]
+        specs = []
+        for a, n in node.agg_exprs:
+            if a.distinct:
+                raise PlanningError("DISTINCT aggregates not supported yet")
+            operand = self._prep_expr(a.operand) if a.operand is not None else None
+            specs.append(O.AggSpec(a.func, operand, n))
+
+        single_input = child.output_partition_count() <= 1
+        if single_input:
+            return O.HashAggregateExec(child, groups, specs, mode="single")
+
+        partial = O.HashAggregateExec(child, groups, specs, mode="partial")
+        if groups:
+            key_exprs = tuple(E.Column(n) for _, n in groups)
+            exchange = RepartitionExec(
+                partial, Partitioning.hash(key_exprs, self.config.shuffle_partitions)
+            )
+        else:
+            exchange = RepartitionExec(partial, Partitioning.single())
+        final_groups = [(E.Column(n), n) for _, n in groups]
+        return O.HashAggregateExec(exchange, final_groups, specs, mode="final")
+
+    def _plan_join(self, node: L.Join) -> ExecutionPlan:
+        left = self.create(node.left)
+        right = self.create(node.right)
+        on = [(self._prep_expr(l), self._prep_expr(r)) for l, r in node.on]
+        filt = self._prep_expr(node.filter) if node.filter is not None else None
+
+        if self._estimate_rows(node.right) <= self.config.get(BROADCAST_THRESHOLD):
+            right_bc = self._to_single_partition(right)
+            return O.JoinExec(left, right_bc, on, node.join_type, filt, dist="broadcast")
+
+        p = self.config.shuffle_partitions
+        lkeys = tuple(l for l, _ in on)
+        rkeys = tuple(r for _, r in on)
+        lpart = RepartitionExec(left, Partitioning.hash(lkeys, p))
+        rpart = RepartitionExec(right, Partitioning.hash(rkeys, p))
+        return O.JoinExec(lpart, rpart, on, node.join_type, filt, dist="partitioned")
+
+    def _estimate_rows(self, node: L.LogicalPlan) -> int:
+        if isinstance(node, L.TableScan):
+            n = self.catalog.provider(node.table).row_count()
+            est = n if n is not None else 10_000_000
+            return max(1, est // (4 if node.filters else 1))
+        if isinstance(node, L.Filter):
+            return max(1, self._estimate_rows(node.input) // 4)
+        if isinstance(node, (L.Projection, L.SubqueryAlias, L.Sort)):
+            return self._estimate_rows(node.input)
+        if isinstance(node, L.Limit):
+            return node.n
+        if isinstance(node, L.Aggregate):
+            return max(1, self._estimate_rows(node.input) // 8)
+        if isinstance(node, L.Distinct):
+            return self._estimate_rows(node.input)
+        if isinstance(node, L.Join):
+            if node.join_type in ("semi", "anti"):
+                return self._estimate_rows(node.left)
+            return max(self._estimate_rows(node.left), self._estimate_rows(node.right))
+        if isinstance(node, L.CrossJoin):
+            return self._estimate_rows(node.left) * self._estimate_rows(node.right)
+        return 10_000_000

@@ -26,6 +26,7 @@ JOIN_OUTPUT_FACTOR = "ballista.join.output_factor"  # out_cap = factor * probe_c
 COLLECT_STATISTICS = "ballista.collect_statistics"
 MESH_SHUFFLE = "ballista.shuffle.mesh"  # use ICI all-to-all when executors co-located on a mesh
 TASK_SLOTS = "ballista.executor.task_slots"
+BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides smaller skip the shuffle
 
 
 @dataclasses.dataclass
@@ -58,6 +59,8 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(COLLECT_STATISTICS, True, _parse_bool, ""),
         ConfigEntry(MESH_SHUFFLE, False, _parse_bool, "use ICI mesh all-to-all shuffle"),
         ConfigEntry(TASK_SLOTS, 4, int, "concurrent task slots per executor"),
+        ConfigEntry(BROADCAST_THRESHOLD, 1_000_000, int,
+                    "broadcast join build sides with fewer estimated rows"),
     ]
 }
 

@@ -1,0 +1,271 @@
+"""Synthetic TPC-H data generator (dbgen-like, numpy, deterministic).
+
+Generates the 8 standard tables at a given scale factor with spec-shaped
+schemas, key relationships, and value distributions (same role as the
+reference's ``tpch convert`` step feeding benchmarks,
+reference benchmarks/src/bin/tpch.rs:353-451).  Not a bit-exact dbgen clone:
+correctness tests compare against a pandas oracle over the *same* generated
+data, so only realistic shape/cardinality matters.
+
+Row counts at SF=1: lineitem ~6M, orders 1.5M, customer 150k, part 200k,
+partsupp 800k, supplier 10k, nation 25, region 5.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+EPOCH_1992 = 8035   # days: 1992-01-01
+EPOCH_1998_08_02 = 10440  # last orderdate per spec ~1998-08-02
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1), ("EGYPT", 4),
+    ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3), ("INDIA", 2), ("INDONESIA", 2),
+    ("IRAN", 4), ("IRAQ", 4), ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0),
+    ("MOROCCO", 0), ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3), ("UNITED KINGDOM", 3),
+    ("UNITED STATES", 1),
+]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+MODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+CONTAINERS = [f"{a} {b}" for a in ["SM", "LG", "MED", "JUMBO", "WRAP"]
+              for b in ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]]
+TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+COLORS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black", "blanched",
+    "blue", "blush", "brown", "burlywood", "burnished", "chartreuse", "chiffon",
+    "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan", "dark", "deep",
+    "dim", "dodger", "drab", "firebrick", "floral", "forest", "frosted", "gainsboro",
+    "ghost", "goldenrod", "green", "grey", "honeydew", "hot", "hotpink", "indian",
+    "ivory", "khaki", "lace", "lavender", "lawn", "lemon", "light", "lime", "linen",
+]
+WORDS = [
+    "the", "special", "pending", "final", "regular", "express", "furiously", "carefully",
+    "quickly", "deposits", "requests", "accounts", "packages", "instructions", "theodolites",
+    "dependencies", "foxes", "ideas", "pinto", "beans", "slyly", "blithely", "even",
+    "bold", "silent", "unusual", "customer", "complaints", "sleep", "wake", "haggle",
+]
+
+
+def _comments(rng: np.random.Generator, n: int, lo=4, hi=10) -> np.ndarray:
+    lengths = rng.integers(lo, hi, n)
+    words = rng.choice(WORDS, size=(n, hi))
+    return np.array([" ".join(words[i, : lengths[i]]) for i in range(n)], dtype=object)
+
+
+def _money(rng, n, lo, hi):
+    # decimal(,2) as float dollars (writers convert to decimal128)
+    return np.round(rng.uniform(lo, hi, n), 2)
+
+
+def generate_tables(scale: float, seed: int = 0) -> Dict[str, "object"]:
+    """Returns {table_name: pyarrow.Table} with spec-typed columns."""
+    import pyarrow as pa
+
+    rng = np.random.default_rng(seed)
+    n_part = max(1, int(200_000 * scale))
+    n_supp = max(1, int(10_000 * scale))
+    n_cust = max(1, int(150_000 * scale))
+    n_ord = max(1, int(1_500_000 * scale))
+    n_ps_per_part = 4
+
+    tables: Dict[str, pa.Table] = {}
+
+    from decimal import Decimal
+
+    def dec(arr):
+        cents = np.round(np.asarray(arr, dtype=np.float64) * 100).astype(np.int64)
+        return pa.array([Decimal(int(c)).scaleb(-2) for c in cents], type=pa.decimal128(15, 2))
+
+    def date32(days):
+        return pa.array(np.asarray(days, dtype=np.int32), type=pa.int32()).cast(pa.date32())
+
+    # --- region / nation ------------------------------------------------
+    tables["region"] = pa.table({
+        "r_regionkey": pa.array(np.arange(5, dtype=np.int64)),
+        "r_name": pa.array(REGIONS),
+        "r_comment": pa.array(_comments(rng, 5)),
+    })
+    tables["nation"] = pa.table({
+        "n_nationkey": pa.array(np.arange(25, dtype=np.int64)),
+        "n_name": pa.array([n for n, _ in NATIONS]),
+        "n_regionkey": pa.array(np.array([r for _, r in NATIONS], dtype=np.int64)),
+        "n_comment": pa.array(_comments(rng, 25)),
+    })
+
+    # --- supplier -------------------------------------------------------
+    s_key = np.arange(1, n_supp + 1, dtype=np.int64)
+    s_nation = rng.integers(0, 25, n_supp).astype(np.int64)
+    supp_comment = _comments(rng, n_supp)
+    # spec: some suppliers have 'Customer ... Complaints' / 'Recommends' markers (q16)
+    marks = rng.random(n_supp)
+    supp_comment = np.where(marks < 0.005, "Customer Complaints " + supp_comment, supp_comment)
+    tables["supplier"] = pa.table({
+        "s_suppkey": pa.array(s_key),
+        "s_name": pa.array([f"Supplier#{k:09d}" for k in s_key]),
+        "s_address": pa.array(_comments(rng, n_supp, 2, 4)),
+        "s_nationkey": pa.array(s_nation),
+        "s_phone": pa.array([f"{10 + int(nk)}-{rng.integers(100,1000)}-{rng.integers(100,1000)}-{rng.integers(1000,10000)}" for nk in s_nation]),
+        "s_acctbal": dec(_money(rng, n_supp, -999.99, 9999.99)),
+        "s_comment": pa.array(supp_comment),
+    })
+
+    # --- part -----------------------------------------------------------
+    p_key = np.arange(1, n_part + 1, dtype=np.int64)
+    name_colors = rng.choice(COLORS, size=(n_part, 2))
+    p_type = np.array([
+        f"{a} {b} {c}" for a, b, c in zip(
+            rng.choice(TYPE_S1, n_part), rng.choice(TYPE_S2, n_part), rng.choice(TYPE_S3, n_part))
+    ], dtype=object)
+    p_retail = 900 + (p_key % 1000) + 100 * (p_key % 10) / 100.0
+    tables["part"] = pa.table({
+        "p_partkey": pa.array(p_key),
+        "p_name": pa.array([f"{a} {b}" for a, b in name_colors]),
+        "p_mfgr": pa.array([f"Manufacturer#{m}" for m in rng.integers(1, 6, n_part)]),
+        "p_brand": pa.array([f"Brand#{m}{n}" for m, n in zip(rng.integers(1, 6, n_part), rng.integers(1, 6, n_part))]),
+        "p_type": pa.array(p_type),
+        "p_size": pa.array(rng.integers(1, 51, n_part).astype(np.int32)),
+        "p_container": pa.array(rng.choice(CONTAINERS, n_part)),
+        "p_retailprice": dec(p_retail),
+        "p_comment": pa.array(_comments(rng, n_part, 2, 5)),
+    })
+
+    # --- partsupp -------------------------------------------------------
+    ps_part = np.repeat(p_key, n_ps_per_part)
+    n_ps = len(ps_part)
+    ps_supp = ((ps_part + np.tile(np.arange(n_ps_per_part), n_part) *
+                (n_supp // n_ps_per_part + 1)) % n_supp + 1).astype(np.int64)
+    tables["partsupp"] = pa.table({
+        "ps_partkey": pa.array(ps_part),
+        "ps_suppkey": pa.array(ps_supp),
+        "ps_availqty": pa.array(rng.integers(1, 10_000, n_ps).astype(np.int32)),
+        "ps_supplycost": dec(_money(rng, n_ps, 1.0, 1000.0)),
+        "ps_comment": pa.array(_comments(rng, n_ps, 3, 8)),
+    })
+
+    # --- customer -------------------------------------------------------
+    c_key = np.arange(1, n_cust + 1, dtype=np.int64)
+    c_nation = rng.integers(0, 25, n_cust).astype(np.int64)
+    tables["customer"] = pa.table({
+        "c_custkey": pa.array(c_key),
+        "c_name": pa.array([f"Customer#{k:09d}" for k in c_key]),
+        "c_address": pa.array(_comments(rng, n_cust, 2, 4)),
+        "c_nationkey": pa.array(c_nation),
+        "c_phone": pa.array([f"{10 + int(nk)}-{a}-{b}-{c}" for nk, a, b, c in zip(
+            c_nation, rng.integers(100, 1000, n_cust), rng.integers(100, 1000, n_cust),
+            rng.integers(1000, 10000, n_cust))]),
+        "c_acctbal": dec(_money(rng, n_cust, -999.99, 9999.99)),
+        "c_mktsegment": pa.array(rng.choice(SEGMENTS, n_cust)),
+        "c_comment": pa.array(_comments(rng, n_cust, 4, 9)),
+    })
+
+    # --- orders ---------------------------------------------------------
+    o_key = (np.arange(1, n_ord + 1, dtype=np.int64) * 4) - 3  # sparse keys like dbgen
+    # only 2/3 of customers have orders (spec)
+    cust_pool = c_key[c_key % 3 != 0]
+    o_cust = rng.choice(cust_pool, n_ord).astype(np.int64)
+    o_date = rng.integers(EPOCH_1992, EPOCH_1998_08_02 - 121, n_ord).astype(np.int32)
+    tables["orders"] = pa.table({
+        "o_orderkey": pa.array(o_key),
+        "o_custkey": pa.array(o_cust),
+        "o_orderstatus": pa.array(np.full(n_ord, "O", dtype=object)),  # fixed below
+        "o_totalprice": dec(_money(rng, n_ord, 800.0, 500_000.0)),
+        "o_orderdate": date32(o_date),
+        "o_orderpriority": pa.array(rng.choice(PRIORITIES, n_ord)),
+        "o_clerk": pa.array([f"Clerk#{k:09d}" for k in rng.integers(1, max(2, n_supp), n_ord)]),
+        "o_shippriority": pa.array(np.zeros(n_ord, dtype=np.int32)),
+        "o_comment": pa.array(_comments(rng, n_ord, 3, 8)),
+    })
+
+    # --- lineitem -------------------------------------------------------
+    lines_per_order = rng.integers(1, 8, n_ord)
+    l_order = np.repeat(o_key, lines_per_order)
+    l_odate = np.repeat(o_date, lines_per_order)
+    n_li = len(l_order)
+    l_part = rng.integers(1, n_part + 1, n_li).astype(np.int64)
+    # supplier correlated with part via partsupp rows
+    which_ps = rng.integers(0, n_ps_per_part, n_li)
+    l_supp = ((l_part + which_ps * (n_supp // n_ps_per_part + 1)) % n_supp + 1).astype(np.int64)
+    l_qty = rng.integers(1, 51, n_li).astype(np.float64)
+    retail = 900 + (l_part % 1000) + 100 * (l_part % 10) / 100.0
+    l_price = np.round(l_qty * retail, 2)
+    l_disc = rng.integers(0, 11, n_li) / 100.0
+    l_tax = rng.integers(0, 9, n_li) / 100.0
+    l_ship = (l_odate + rng.integers(1, 122, n_li)).astype(np.int32)
+    l_commit = (l_odate + rng.integers(30, 91, n_li)).astype(np.int32)
+    l_receipt = (l_ship + rng.integers(1, 31, n_li)).astype(np.int32)
+    CUTOFF = 10471  # 1998-09-02: spec's pending-shipment boundary
+    RETURN_CUTOFF = 9298  # 1995-06-17: receipts before this may be returned
+    l_retflag = np.where(l_receipt <= RETURN_CUTOFF, rng.choice(["R", "A"], n_li), "N")
+    l_status = np.where(l_ship > CUTOFF - 92, "O", "F")
+    tables["lineitem"] = pa.table({
+        "l_orderkey": pa.array(l_order),
+        "l_partkey": pa.array(l_part),
+        "l_suppkey": pa.array(l_supp),
+        "l_linenumber": pa.array(
+            np.concatenate([np.arange(1, c + 1) for c in lines_per_order]).astype(np.int32)),
+        "l_quantity": dec(l_qty),
+        "l_extendedprice": dec(l_price),
+        "l_discount": dec(l_disc),
+        "l_tax": dec(l_tax),
+        "l_returnflag": pa.array(l_retflag.astype(object)),
+        "l_linestatus": pa.array(l_status.astype(object)),
+        "l_shipdate": date32(l_ship),
+        "l_commitdate": date32(l_commit),
+        "l_receiptdate": date32(l_receipt),
+        "l_shipinstruct": pa.array(rng.choice(INSTRUCTS, n_li)),
+        "l_shipmode": pa.array(rng.choice(MODES, n_li)),
+        "l_comment": pa.array(_comments(rng, n_li, 2, 5)),
+    })
+
+    # orderstatus derived from lineitem statuses: F if all F, O if all O, else P
+    import pandas as pd
+
+    is_f = pd.Series((l_status == "F"))
+    grp_f = is_f.groupby(l_order).all()
+    grp_o = (~is_f).groupby(l_order).all()
+    status_map = np.where(grp_f[o_key].to_numpy(), "F",
+                          np.where(grp_o[o_key].to_numpy(), "O", "P"))
+    tables["orders"] = tables["orders"].set_column(
+        2, "o_orderstatus", pa.array(status_map.astype(object)))
+
+    return tables
+
+
+def write_parquet(tables, out_dir: str, files_per_table: int = 4):
+    import pyarrow.parquet as pq
+
+    for name, table in tables.items():
+        tdir = os.path.join(out_dir, name)
+        os.makedirs(tdir, exist_ok=True)
+        n = table.num_rows
+        k = max(1, min(files_per_table, n))
+        per = (n + k - 1) // k
+        for i in range(k):
+            chunk = table.slice(i * per, per)
+            pq.write_table(chunk, os.path.join(tdir, f"part-{i}.parquet"))
+
+
+def generate_to_dir(scale: float, out_dir: str, seed: int = 0, files_per_table: int = 4):
+    tables = generate_tables(scale, seed)
+    write_parquet(tables, out_dir, files_per_table)
+    return {name: t.num_rows for name, t in tables.items()}
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--out", default="/tmp/tpch_data")
+    ap.add_argument("--files", type=int, default=4)
+    args = ap.parse_args()
+    counts = generate_to_dir(args.scale, args.out, files_per_table=args.files)
+    print(counts)
