@@ -1,0 +1,25 @@
+"""Mesh-parallel execution: ICI shuffle + distributed stage programs.
+
+Replaces the reference's Arrow Flight data plane for co-located executors
+(SURVEY.md §2.5, "Communication backend" row) with XLA collectives over a
+`jax.sharding.Mesh`.
+"""
+from .mesh import PART_AXIS, make_mesh, mesh_axis_size, replicated, row_sharding
+from .ici_shuffle import all_to_all_rows, dispatch_to_buckets, shuffle_rows
+from .distributed import (
+    distributed_filter_aggregate,
+    distributed_grouped_aggregate,
+)
+
+__all__ = [
+    "PART_AXIS",
+    "make_mesh",
+    "mesh_axis_size",
+    "replicated",
+    "row_sharding",
+    "all_to_all_rows",
+    "dispatch_to_buckets",
+    "shuffle_rows",
+    "distributed_filter_aggregate",
+    "distributed_grouped_aggregate",
+]

@@ -1,0 +1,109 @@
+"""Distributed operators over the ICI mesh: whole stages as one XLA program.
+
+Where the reference runs partial-agg tasks, materializes shuffle files,
+then runs final-agg tasks as a separate stage (stage DAG built by
+DistributedPlanner, reference ballista/scheduler/src/planner.rs:80-165),
+the on-pod TPU path fuses partial agg → all_to_all → final agg into ONE
+compiled program per stage pair: XLA overlaps the collective with compute
+and nothing touches the host.  This is the "fuse co-located stages" row of
+SURVEY.md §2.5's parallelism table.
+
+The same two-phase plan shape is kept (partial by every device over its
+rows, exchange by key hash, final by the bucket owner), so results are
+bit-identical to the file-shuffle path — the scheduler can pick either
+transport per stage boundary.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import kernels as K
+from .ici_shuffle import shuffle_rows
+from .mesh import PART_AXIS, mesh_axis_size
+
+# aggregate merge rule: partial counts merge by summation, rest by themselves
+_MERGE = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
+
+def _shuffle_capacity(rows_per_shard: int, n: int, factor: float) -> int:
+    return max(1, math.ceil(rows_per_shard / n * factor))
+
+
+def _identity_filter(cols, mask):
+    return cols, mask
+
+
+def distributed_filter_aggregate(
+    mesh: Mesh,
+    filter_fn,
+    key_names: Sequence[str],
+    agg_specs: Sequence[Tuple[str, str]],
+    partial_capacity: int,
+    final_capacity: int,
+    axis: str = PART_AXIS,
+    skew_factor: float = 2.0,
+):
+    """Fused scan-filter → partial agg → ICI shuffle → final agg step.
+
+    ``filter_fn(cols, mask) -> (cols, mask)`` runs per shard first (the
+    stage's projection/filter pipeline).  ``agg_specs``: (value_column,
+    how) with how in sum/count/min/max — AVG is decomposed into sum+count
+    by the planner, the same two-phase split the reference inherits from
+    DataFusion.
+
+    Returns ``run(cols, mask) -> (out_keys, out_vals, out_mask, overflow)``
+    with outputs sharded over the mesh (device d owns the groups whose
+    key-hash bucket is d), each of shape ``[n * final_capacity]``.  This is
+    the full TPC-H q1 execution shape as ONE compiled multi-chip program.
+    """
+    n = mesh_axis_size(mesh, axis)
+    cap = _shuffle_capacity(partial_capacity, n, skew_factor)
+
+    def per_shard(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
+        cols, mask = filter_fn(cols, mask)
+        keys = [cols[k] for k in key_names]
+        vals = [(cols[v], how) for v, how in agg_specs]
+        pk, pv, pmask, ovf1 = K.grouped_aggregate(keys, vals, mask,
+                                                  partial_capacity)
+        shuffled = {f"k{i}": a for i, a in enumerate(pk)}
+        shuffled.update({f"v{i}": a for i, a in enumerate(pv)})
+        dest = K.bucket_of(pk, n)
+        recv, rmask, ovf2 = shuffle_rows(shuffled, dest, pmask, axis, n, cap)
+        rk = [recv[f"k{i}"] for i in range(len(pk))]
+        rv = [(recv[f"v{i}"], _MERGE[agg_specs[i][1]]) for i in range(len(pv))]
+        fk, fv, fmask, ovf3 = K.grouped_aggregate(rk, rv, rmask,
+                                                  final_capacity)
+        overflow = lax.psum((ovf1 | ovf2[0] | ovf3).astype(jnp.int32), axis) > 0
+        return fk, fv, fmask, overflow
+
+    row = P(axis)
+
+    def run(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
+        in_specs = ({name: row for name in cols}, row)
+        out_specs = ([row] * len(key_names), [row] * len(agg_specs), row, P())
+        shard_fn = jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)
+        return jax.jit(shard_fn)(cols, mask)
+
+    return run
+
+
+def distributed_grouped_aggregate(
+    mesh: Mesh,
+    key_names: Sequence[str],
+    agg_specs: Sequence[Tuple[str, str]],
+    partial_capacity: int,
+    final_capacity: int,
+    axis: str = PART_AXIS,
+    skew_factor: float = 2.0,
+):
+    """Distributed GROUP BY without a fused filter stage."""
+    return distributed_filter_aggregate(
+        mesh, _identity_filter, key_names, agg_specs, partial_capacity,
+        final_capacity, axis=axis, skew_factor=skew_factor)

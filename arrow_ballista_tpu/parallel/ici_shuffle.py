@@ -1,0 +1,107 @@
+"""ICI-mesh shuffle: hash repartition as one all_to_all collective.
+
+Parity mapping (SURVEY.md §2.5): the reference's shuffle is
+ShuffleWriterExec hash-partitioning batches to IPC files
+(reference ballista/core/src/execution_plans/shuffle_writer.rs:201-252)
+followed by M×N Arrow Flight fetches in ShuffleReaderExec
+(shuffle_reader.rs:267-318).  On-pod we collapse write+fetch into a single
+`lax.all_to_all` over HBM buffers: no files, no serialization, no host.
+
+Static-shape discipline (XLA cannot all_to_all ragged rows):
+- each device ranks its live rows within their destination bucket and
+  scatters them into a ``[n_dest, capacity]`` send buffer (MoE-style
+  capacity-factor dispatch);
+- ``capacity = ceil(rows/n * factor)`` bounds skew; rows past capacity set
+  an ``overflow`` flag the host checks (same contract as the kernels'
+  grouped_aggregate overflow — the host re-runs with a bigger factor);
+- the all_to_all swaps the leading axis, so device d ends up with every
+  source's bucket-d block; flattening gives rows+mask again.
+
+This file is pure device code usable inside `jax.shard_map`; host-side
+orchestration (choosing factor, re-running on overflow) lives in the
+executor's stage runner.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dispatch_to_buckets(
+    cols: Dict[str, jnp.ndarray],
+    dest: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_dest: int,
+    capacity: int,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Scatter rows into a ``[num_dest, capacity]`` send buffer per column.
+
+    Returns (send_cols, send_mask, overflow).  Rows whose within-bucket rank
+    exceeds ``capacity`` are dropped and flagged via ``overflow``.
+    """
+    n_rows = mask.shape[0]
+    dkey = jnp.where(mask, dest, num_dest)  # dead rows -> sentinel bucket
+    order = jnp.argsort(dkey, stable=True)
+    dsorted = dkey[order]
+    counts = jnp.bincount(dkey, length=num_dest + 1)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])[:-1]
+    rank = jnp.arange(n_rows) - starts[dsorted]
+    slot_ok = (dsorted < num_dest) & (rank < capacity)
+    flat = jnp.where(slot_ok, dsorted * capacity + rank, num_dest * capacity)
+
+    send_cols = {}
+    for name, col in cols.items():
+        buf = jnp.zeros((num_dest * capacity + 1,), dtype=col.dtype)
+        buf = buf.at[flat].set(col[order], mode="drop")
+        send_cols[name] = buf[:-1].reshape(num_dest, capacity)
+    mbuf = jnp.zeros((num_dest * capacity + 1,), dtype=jnp.bool_)
+    mbuf = mbuf.at[flat].set(slot_ok, mode="drop")
+    send_mask = mbuf[:-1].reshape(num_dest, capacity)
+    overflow = jnp.any(counts[:num_dest] > capacity)
+    return send_cols, send_mask, overflow
+
+
+def all_to_all_rows(
+    send_cols: Dict[str, jnp.ndarray],
+    send_mask: jnp.ndarray,
+    axis: str,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Swap bucket blocks across the mesh axis and flatten to rows.
+
+    Must run inside shard_map.  ``send_cols[name]`` is ``[n, capacity]``
+    (bucket-major); the collective delivers ``[n, capacity]`` source-major
+    blocks which flatten into this device's received rows.
+    """
+    recv_cols = {
+        name: lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                             tiled=True).reshape(-1)
+        for name, buf in send_cols.items()
+    }
+    recv_mask = lax.all_to_all(send_mask, axis, split_axis=0, concat_axis=0,
+                               tiled=True).reshape(-1)
+    return recv_cols, recv_mask
+
+
+def shuffle_rows(
+    cols: Dict[str, jnp.ndarray],
+    dest: jnp.ndarray,
+    mask: jnp.ndarray,
+    axis: str,
+    num_partitions: int,
+    capacity: int,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Full on-pod shuffle for one stage boundary (inside shard_map).
+
+    Each device sends row i to device ``dest[i]``; returns the rows this
+    device received (``num_partitions * capacity`` of them, masked), plus
+    the local overflow flag as a shape-(1,) bool (rank ≥1 so it can cross
+    shard_map out_specs; callers psum/any it across the mesh).
+    """
+    send_cols, send_mask, overflow = dispatch_to_buckets(
+        cols, dest, mask, num_partitions, capacity)
+    recv_cols, recv_mask = all_to_all_rows(send_cols, send_mask, axis)
+    return recv_cols, recv_mask, overflow[None]

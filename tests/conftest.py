@@ -12,6 +12,14 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
+# The image pre-imports jax with the TPU platform via a site hook, so the
+# env vars above can be too late; config.update before first backend use
+# still wins (XLA reads XLA_FLAGS when the CPU client is created).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
