@@ -87,12 +87,19 @@ class BallistaContext:
 
     @staticmethod
     def standalone(config: Optional[BallistaConfig] = None,
-                   concurrent_tasks: int = 4) -> "BallistaContext":
+                   concurrent_tasks: int = 4,
+                   num_executors: int = 1) -> "BallistaContext":
         ctx = BallistaContext(config, engine="standalone")
         from ..scheduler.standalone import StandaloneCluster
 
-        ctx._standalone = StandaloneCluster(ctx.config, concurrent_tasks)
+        ctx._standalone = StandaloneCluster(ctx.config, concurrent_tasks,
+                                            num_executors)
         return ctx
+
+    def shutdown(self) -> None:
+        if self._standalone is not None:
+            self._standalone.shutdown()
+            self._standalone = None
 
     @staticmethod
     def remote(host: str, port: int, config: Optional[BallistaConfig] = None) -> "BallistaContext":

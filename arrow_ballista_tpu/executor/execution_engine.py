@@ -1,0 +1,65 @@
+"""ExecutionEngine: the pluggable backend seam.
+
+Parity: reference ballista/executor/src/execution_engine.rs:32-121 — the
+trait through which alternative engines (there: a possible Ballista fork;
+here: the TPU engine vs a host-side debug engine) plug into the executor.
+``create_query_stage_exec`` rebinds the scheduler-sent plan to the
+executor's work_dir; ``QueryStageExecutor.execute_query_stage`` runs one
+partition and returns shuffle-write metadata.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..ops.physical import TaskContext
+from ..ops.shuffle import ShuffleWritePartition, ShuffleWriterExec
+from ..utils.config import BallistaConfig
+
+
+class QueryStageExecutor:
+    def execute_query_stage(self, partition: int, ctx: TaskContext
+                            ) -> List[ShuffleWritePartition]:
+        raise NotImplementedError
+
+    def collect_plan_metrics(self) -> Dict[str, Dict[str, float]]:
+        return {}
+
+
+class DefaultQueryStageExecutor(QueryStageExecutor):
+    def __init__(self, plan: ShuffleWriterExec):
+        self.plan = plan
+
+    def execute_query_stage(self, partition: int, ctx: TaskContext
+                            ) -> List[ShuffleWritePartition]:
+        return self.plan.execute_write(partition, ctx)
+
+    def collect_plan_metrics(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+
+        def walk(p, path="0"):
+            out[f"{path}:{type(p).__name__}"] = dict(p.metrics().values)
+            for i, c in enumerate(p.children()):
+                walk(c, f"{path}.{i}")
+
+        walk(self.plan)
+        return out
+
+
+class ExecutionEngine:
+    def create_query_stage_exec(self, job_id: str, stage_id: int,
+                                plan: ShuffleWriterExec, work_dir: str
+                                ) -> QueryStageExecutor:
+        raise NotImplementedError
+
+
+class DefaultExecutionEngine(ExecutionEngine):
+    """The TPU engine: plans arrive as ShuffleWriterExec trees whose
+    operators compile to XLA programs on first execute (parity with the
+    reference default engine rewrapping ShuffleWriterExec,
+    execution_engine.rs:62-89)."""
+
+    def create_query_stage_exec(self, job_id, stage_id, plan, work_dir):
+        if not isinstance(plan, ShuffleWriterExec):
+            raise TypeError(f"stage plan must be a ShuffleWriterExec, "
+                            f"got {type(plan).__name__}")
+        return DefaultQueryStageExecutor(plan)

@@ -64,7 +64,13 @@ class PartitionLocation:
 
 
 class ShuffleWriterExec(ExecutionPlan):
-    def __init__(self, input: ExecutionPlan, partitioning: Partitioning,
+    """``partitioning=None`` marks a **final** stage (reference
+    shuffle_writer.rs with ``shuffle_output_partitioning: None``): the input
+    partition's rows are written verbatim to one file, and the metadata's
+    output_partition is the input partition index — the client fetches these
+    as the query result."""
+
+    def __init__(self, input: ExecutionPlan, partitioning: Optional[Partitioning],
                  stage_id: int = 0):
         self.input = input
         self.partitioning = partitioning
@@ -80,15 +86,24 @@ class ShuffleWriterExec(ExecutionPlan):
         return self.input.output_partition_count()
 
     def output_partitioning(self):
-        return self.partitioning
+        return self.partitioning or Partitioning.unknown(self.output_partition_count())
 
     def execute_write(self, partition: int, ctx: TaskContext) -> List[ShuffleWritePartition]:
         """Run the child for ``partition`` and write shuffle files."""
         batches = self.input.execute(partition, ctx)
         big = concat_batches(self.input.schema, batches).shrink()
-        num_out = self.partitioning.count
         base = os.path.join(ctx.work_dir, ctx.job_id, str(self.stage_id), str(partition))
 
+        if self.partitioning is None:
+            # final stage: pass-through; output partition == input partition
+            path = os.path.join(base, "data-0.arrow")
+            with self.metrics().timer("write_time"):
+                rows, nbytes = write_ipc_file(big, path)
+            self.metrics().add("input_rows", big.num_rows)
+            self.metrics().add("output_rows", rows)
+            return [ShuffleWritePartition(partition, path, rows, nbytes)]
+
+        num_out = self.partitioning.count
         if self.partitioning.kind == "hash" and num_out > 1:
             if self._compiled is None:
                 comp = ExprCompiler(self.input.schema, "device")
@@ -130,8 +145,9 @@ class ShuffleWriterExec(ExecutionPlan):
         return []
 
     def _label(self):
-        return (f"ShuffleWriterExec: stage={self.stage_id} "
-                f"{self.partitioning.kind}[{self.partitioning.count}]")
+        part = ("final" if self.partitioning is None
+                else f"{self.partitioning.kind}[{self.partitioning.count}]")
+        return f"ShuffleWriterExec: stage={self.stage_id} {part}"
 
 
 class ShuffleReaderExec(ExecutionPlan):

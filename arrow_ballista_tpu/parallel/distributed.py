@@ -83,13 +83,18 @@ def distributed_filter_aggregate(
         return fk, fv, fmask, overflow
 
     row = P(axis)
+    compiled: Dict[Tuple[str, ...], object] = {}  # col-name set -> jitted fn
 
     def run(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
-        in_specs = ({name: row for name in cols}, row)
-        out_specs = ([row] * len(key_names), [row] * len(agg_specs), row, P())
-        shard_fn = jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs)
-        return jax.jit(shard_fn)(cols, mask)
+        key = tuple(sorted(cols))
+        fn = compiled.get(key)
+        if fn is None:
+            in_specs = ({name: row for name in cols}, row)
+            out_specs = ([row] * len(key_names), [row] * len(agg_specs), row, P())
+            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs))
+            compiled[key] = fn
+        return fn(cols, mask)
 
     return run
 

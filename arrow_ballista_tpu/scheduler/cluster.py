@@ -1,0 +1,200 @@
+"""Cluster state: executor pool + job registry (in-memory backend).
+
+Parity: reference ballista/scheduler/src/cluster/ — the ``ClusterState`` /
+``JobState`` traits (cluster/mod.rs:199-372) and their in-memory
+implementation (cluster/memory.rs).  Slot reservation is atomic under a
+lock, with the reference's two distribution policies: **bias** (pack onto
+the fewest executors, reference cluster/mod.rs reserve_slots_bias) and
+**round-robin** (spread, reserve_slots_round_robin).
+
+The KV/etcd-backed variants of the reference are future backends behind the
+same interface (SURVEY.md §2.2 cluster abstraction).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .execution_graph import ExecutionGraph
+from .types import (
+    ExecutorHeartbeat,
+    ExecutorMetadata,
+    ExecutorReservation,
+    JobStatus,
+)
+
+DEFAULT_EXECUTOR_TIMEOUT_S = 180.0
+
+
+class ClusterState:
+    """Executor slots + metadata + heartbeats."""
+
+    def __init__(self, task_distribution: str = "bias"):
+        assert task_distribution in ("bias", "round-robin")
+        self.task_distribution = task_distribution
+        self._lock = threading.Lock()
+        self._executors: Dict[str, ExecutorMetadata] = {}
+        self._heartbeats: Dict[str, ExecutorHeartbeat] = {}
+        self._available: Dict[str, int] = {}  # free task slots
+        self._rr_cursor = 0
+
+    # --- registration ----------------------------------------------------
+    def register_executor(self, meta: ExecutorMetadata) -> None:
+        with self._lock:
+            fresh = meta.executor_id not in self._executors
+            self._executors[meta.executor_id] = meta
+            if fresh:
+                self._available[meta.executor_id] = meta.task_slots
+            self._heartbeats[meta.executor_id] = ExecutorHeartbeat(meta.executor_id)
+
+    def remove_executor(self, executor_id: str) -> None:
+        with self._lock:
+            self._executors.pop(executor_id, None)
+            self._available.pop(executor_id, None)
+            hb = self._heartbeats.get(executor_id)
+            if hb is not None:
+                hb.status = "dead"
+
+    def save_heartbeat(self, hb: ExecutorHeartbeat) -> None:
+        with self._lock:
+            self._heartbeats[hb.executor_id] = hb
+
+    def executors(self) -> List[ExecutorMetadata]:
+        with self._lock:
+            return list(self._executors.values())
+
+    def get_executor(self, executor_id: str) -> Optional[ExecutorMetadata]:
+        with self._lock:
+            return self._executors.get(executor_id)
+
+    def alive_executors(self, timeout_s: float = 60.0) -> List[str]:
+        now = time.time()
+        with self._lock:
+            return [eid for eid, hb in self._heartbeats.items()
+                    if hb.status == "active" and now - hb.timestamp <= timeout_s
+                    and eid in self._executors]
+
+    def expired_executors(self, timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S
+                          ) -> List[str]:
+        now = time.time()
+        with self._lock:
+            return [eid for eid in self._executors
+                    if (hb := self._heartbeats.get(eid)) is not None
+                    and (hb.status != "active" or now - hb.timestamp > timeout_s)]
+
+    # --- slots -----------------------------------------------------------
+    def reserve_slots(self, n: int, executors: Optional[List[str]] = None
+                      ) -> List[ExecutorReservation]:
+        """Atomically grab up to ``n`` free slots (reference
+        cluster/mod.rs:265-304)."""
+        with self._lock:
+            pool = executors if executors is not None else list(self._available)
+            pool = [e for e in pool if e in self._available]
+            out: List[ExecutorReservation] = []
+            if self.task_distribution == "bias":
+                # pack: drain one executor before touching the next
+                for eid in sorted(pool, key=lambda e: -self._available[e]):
+                    take = min(n - len(out), self._available[eid])
+                    self._available[eid] -= take
+                    out.extend(ExecutorReservation(eid) for _ in range(take))
+                    if len(out) >= n:
+                        break
+            else:
+                # round-robin: one slot per executor per cycle
+                pool = sorted(pool)
+                while len(out) < n and pool:
+                    progressed = False
+                    for i in range(len(pool)):
+                        eid = pool[(self._rr_cursor + i) % len(pool)]
+                        if self._available[eid] > 0:
+                            self._available[eid] -= 1
+                            out.append(ExecutorReservation(eid))
+                            progressed = True
+                            if len(out) >= n:
+                                self._rr_cursor = (self._rr_cursor + i + 1) % len(pool)
+                                break
+                    if not progressed:
+                        break
+            return out
+
+    def cancel_reservations(self, reservations: List[ExecutorReservation]) -> None:
+        with self._lock:
+            for r in reservations:
+                if r.executor_id in self._available:
+                    self._available[r.executor_id] += 1
+
+    def free_slots(self, executor_id: str, n: int = 1) -> None:
+        with self._lock:
+            if executor_id in self._available:
+                cap = self._executors[executor_id].task_slots
+                self._available[executor_id] = min(
+                    cap, self._available[executor_id] + n)
+
+    def total_available(self) -> int:
+        with self._lock:
+            return sum(self._available.values())
+
+
+class JobState:
+    """Job registry + graph store + completion signalling (parity:
+    reference JobState trait, cluster/mod.rs:306-372)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._status: Dict[str, JobStatus] = {}
+        self._graphs: Dict[str, ExecutionGraph] = {}
+        self._events: List[Callable[[JobStatus], None]] = []
+        self._done: Dict[str, threading.Event] = {}
+
+    def accept_job(self, job_id: str) -> None:
+        with self._lock:
+            self._status[job_id] = JobStatus(job_id, "queued")
+            self._done[job_id] = threading.Event()
+
+    def submit_job(self, job_id: str, graph: ExecutionGraph) -> None:
+        with self._lock:
+            self._graphs[job_id] = graph
+            self._status[job_id] = JobStatus(job_id, "running")
+
+    def get_graph(self, job_id: str) -> Optional[ExecutionGraph]:
+        with self._lock:
+            return self._graphs.get(job_id)
+
+    def active_graphs(self) -> List[ExecutionGraph]:
+        with self._lock:
+            return [g for g in self._graphs.values() if g.status == "running"]
+
+    def get_status(self, job_id: str) -> Optional[JobStatus]:
+        with self._lock:
+            return self._status.get(job_id)
+
+    def set_status(self, status: JobStatus) -> None:
+        with self._lock:
+            self._status[status.job_id] = status
+            done = self._done.get(status.job_id)
+        if status.state in ("successful", "failed", "cancelled"):
+            if done is not None:
+                done.set()
+        for cb in list(self._events):
+            cb(status)
+
+    def subscribe(self, cb: Callable[[JobStatus], None]) -> None:
+        self._events.append(cb)
+
+    def wait_for_completion(self, job_id: str, timeout: float = 300.0
+                            ) -> JobStatus:
+        with self._lock:
+            done = self._done.get(job_id)
+        if done is None:
+            raise KeyError(job_id)
+        done.wait(timeout)
+        status = self.get_status(job_id)
+        assert status is not None
+        return status
+
+    def remove_job(self, job_id: str) -> None:
+        with self._lock:
+            self._status.pop(job_id, None)
+            self._graphs.pop(job_id, None)
+            self._done.pop(job_id, None)

@@ -1,0 +1,74 @@
+"""Generic single-consumer event loop (parity: reference
+ballista/core/src/event_loop.rs:27-142 — mpsc-backed EventLoop/EventAction).
+
+Python rendition: a daemon thread draining a bounded queue.  The scheduler
+state machine (``QueryStageScheduler``) is the one EventAction; everything
+that mutates scheduler state flows through here, exactly as in the
+reference, so state transitions are single-threaded by construction.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class EventLoop:
+    def __init__(self, name: str, on_receive: Callable[[object], None],
+                 buffer_size: int = 10000,
+                 slow_event_threshold_s: float = 1.0):
+        self.name = name
+        self._on_receive = on_receive
+        self._queue: "queue.Queue[object]" = queue.Queue(maxsize=buffer_size)
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self.slow_event_threshold_s = slow_event_threshold_s
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stopped.set()
+        self._queue.put(None)  # wake the consumer
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def post(self, event: object) -> None:
+        if self._stopped.is_set():
+            return
+        self._queue.put(event)
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            event = self._queue.get()
+            if event is None:
+                continue
+            t0 = time.monotonic()
+            try:
+                self._on_receive(event)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("%s: event handler raised on %r", self.name, event)
+            dt = time.monotonic() - t0
+            if dt > self.slow_event_threshold_s:
+                # reference slow-event watchdog
+                # (query_stage_scheduler.rs:378-389)
+                log.warning("%s: slow event %r took %.2fs", self.name, event, dt)
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait until the queue is empty (test helper)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._queue.empty():
+                return True
+            time.sleep(0.005)
+        return False

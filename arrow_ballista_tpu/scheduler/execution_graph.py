@@ -1,0 +1,371 @@
+"""ExecutionGraph: per-job DAG of shuffle stages + fault tolerance.
+
+Parity with the reference's scheduler core
+(reference ballista/scheduler/src/state/execution_graph.rs:61-1211 and
+execution_graph/execution_stage.rs): stages move through
+
+    UNRESOLVED -> RESOLVED/RUNNING -> SUCCESSFUL
+         ^                |
+         └── rollback ────┘        (FetchPartitionError / executor lost)
+
+``update_task_status`` implements the same lineage-aware recovery
+(execution_graph.rs:270-657): a fetch failure rolls the consumer stage back
+to UNRESOLVED and re-opens the producer's poisoned map partition; retryable
+task errors reset the task; execution errors fail the job.  Retry budgets
+mirror task_manager.rs:55-57 (TASK_MAX_FAILURES=4, STAGE_MAX_FAILURES=4).
+
+Design deviation from the reference: consumer input locations are *derived*
+from producer stage outputs at resolve time instead of being incrementally
+pushed — a stage resolves only when every producer is SUCCESSFUL, at which
+point producer outputs are final, so the derived view is equivalent and
+removes a whole class of partial-update states.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from ..ops.shuffle import PartitionLocation, ShuffleWritePartition, ShuffleWriterExec
+from ..utils.errors import InternalError
+from .planner import (
+    DistributedPlanner,
+    QueryStage,
+    collect_nodes,
+    remove_unresolved_shuffles,
+)
+from ..ops.shuffle import UnresolvedShuffleExec
+from .types import (
+    EXECUTION_ERROR,
+    FETCH_PARTITION_ERROR,
+    TASK_KILLED,
+    FailedReason,
+    TaskDescription,
+    TaskId,
+    TaskStatus,
+)
+
+TASK_MAX_FAILURES = 4
+STAGE_MAX_FAILURES = 4
+
+UNRESOLVED = "unresolved"
+RUNNING = "running"
+SUCCESSFUL = "successful"
+FAILED = "failed"
+
+
+@dataclasses.dataclass
+class TaskInfo:
+    partition: int
+    executor_id: str
+    state: str  # 'running' | 'success'
+
+
+class ExecutionStage:
+    def __init__(self, stage_id: int, plan: ShuffleWriterExec):
+        self.stage_id = stage_id
+        self.plan = plan  # with UnresolvedShuffleExec leaves
+        self.partitions = plan.output_partition_count()
+        self.producer_ids = sorted(
+            {u.stage_id for u in collect_nodes(plan, UnresolvedShuffleExec)})
+        self.output_links: List[int] = []
+        self.state = UNRESOLVED
+        # stage_attempt is a monotonic *epoch*: it identifies which attempt a
+        # task belongs to, so late statuses from rolled-back attempts can be
+        # dropped.  failures is the *budget* counter checked against
+        # STAGE_MAX_FAILURES — rollbacks that aren't the query's fault
+        # (executor loss) bump the epoch but not the budget.
+        self.stage_attempt = 0
+        self.failures = 0
+        self.resolved_plan: Optional[ShuffleWriterExec] = None
+        self.task_infos: List[Optional[TaskInfo]] = [None] * self.partitions
+        self.task_failures: List[int] = [0] * self.partitions
+        # map partition -> (executor_id, [ShuffleWritePartition])
+        self.outputs: Dict[int, Tuple[str, List[ShuffleWritePartition]]] = {}
+
+    # --- queries ---------------------------------------------------------
+    def pending_partitions(self) -> List[int]:
+        if self.state != RUNNING:
+            return []
+        return [p for p in range(self.partitions) if self.task_infos[p] is None]
+
+    def all_successful(self) -> bool:
+        return all(t is not None and t.state == "success" for t in self.task_infos)
+
+    def output_locations(self) -> Dict[int, List[PartitionLocation]]:
+        """output partition -> locations across all map tasks."""
+        locs: Dict[int, List[PartitionLocation]] = {}
+        for map_part, (executor_id, writes) in sorted(self.outputs.items()):
+            for w in writes:
+                locs.setdefault(w.output_partition, []).append(
+                    PartitionLocation(executor_id, map_part, w.output_partition,
+                                      w.path, w.num_rows, w.num_bytes))
+        return locs
+
+    # --- transitions -----------------------------------------------------
+    def rollback(self, count_failure: bool = True) -> None:
+        """RUNNING/RESOLVED -> UNRESOLVED (reference execution_stage.rs
+        rollback arrows); outputs are discarded, tasks forgotten.
+
+        ``remove_unresolved_shuffles`` resolves in place (each stage owns
+        its subtree), so the inverse walk here restores the
+        UnresolvedShuffleExec leaves — without it a re-resolve would keep
+        the *previous* attempt's partition locations (dead paths)."""
+        from .planner import rollback_resolved_shuffles
+
+        self.plan = rollback_resolved_shuffles(self.plan)
+        self.state = UNRESOLVED
+        self.resolved_plan = None
+        self.task_infos = [None] * self.partitions
+        self.outputs.clear()
+        self.stage_attempt += 1
+        if count_failure:
+            self.failures += 1
+
+    def reopen_partitions(self, partitions: List[int], count_attempt: bool = True) -> None:
+        """SUCCESSFUL/RUNNING -> RUNNING with the given map partitions
+        pending again (reference SuccessfulStage::to_running).  Partitions
+        already pending or re-running (reported lost twice, e.g. by two
+        reducer tasks that both failed to fetch) are left alone."""
+        reopened = False
+        for p in partitions:
+            info = self.task_infos[p]
+            if p not in self.outputs and (info is None or info.state != "success"):
+                continue  # already re-opened; a re-run may be in flight
+            self.outputs.pop(p, None)
+            self.task_infos[p] = None
+            reopened = True
+        if reopened and self.state == SUCCESSFUL:
+            self.state = RUNNING
+            self.stage_attempt += 1  # new epoch either way
+            if count_attempt:
+                self.failures += 1
+
+    def __repr__(self):
+        done = sum(1 for t in self.task_infos if t and t.state == "success")
+        return (f"Stage(id={self.stage_id}, {self.state}, "
+                f"{done}/{self.partitions} tasks, attempt={self.stage_attempt})")
+
+
+class ExecutionGraph:
+    """Parity: reference state/execution_graph.rs ExecutionGraph."""
+
+    def __init__(self, job_id: str, stages: List[QueryStage]):
+        self.job_id = job_id
+        self.stages: Dict[int, ExecutionStage] = {
+            s.stage_id: ExecutionStage(s.stage_id, s.plan) for s in stages}
+        # link producers -> consumers (reference ExecutionStageBuilder,
+        # execution_graph.rs:1441-1543)
+        for stage in self.stages.values():
+            for pid in stage.producer_ids:
+                if pid not in self.stages:
+                    raise InternalError(f"stage {stage.stage_id} references "
+                                        f"unknown producer {pid}")
+                self.stages[pid].output_links.append(stage.stage_id)
+        finals = [s for s in self.stages.values() if not s.output_links]
+        if len(finals) != 1:
+            raise InternalError(f"expected exactly one final stage, got {finals}")
+        self.final_stage_id = finals[0].stage_id
+        self.status = "running"
+        self.error = ""
+        self.scalars: Dict[str, object] = {}
+        self._task_id_gen = itertools.count()
+        self.revive()
+
+    @staticmethod
+    def build(job_id: str, plan) -> "ExecutionGraph":
+        stages = DistributedPlanner().plan_query_stages(job_id, plan)
+        return ExecutionGraph(job_id, stages)
+
+    # --- scheduling ------------------------------------------------------
+    def revive(self) -> bool:
+        """Resolve every UNRESOLVED stage whose producers are all
+        SUCCESSFUL (reference execution_graph.rs:242-266)."""
+        changed = False
+        for stage in self.stages.values():
+            if stage.state != UNRESOLVED:
+                continue
+            if all(self.stages[p].state == SUCCESSFUL for p in stage.producer_ids):
+                locations = {p: self.stages[p].output_locations()
+                             for p in stage.producer_ids}
+                stage.resolved_plan = remove_unresolved_shuffles(stage.plan, locations) \
+                    if stage.producer_ids else stage.plan
+                stage.state = RUNNING
+                changed = True
+        return changed
+
+    def available_task_count(self) -> int:
+        if self.status != "running":
+            return 0
+        return sum(len(s.pending_partitions()) for s in self.stages.values())
+
+    def pop_next_task(self, executor_id: str) -> Optional[TaskDescription]:
+        """Hand out one pending task (reference execution_graph.rs:834-935)."""
+        if self.status != "running":
+            return None
+        for stage in sorted(self.stages.values(), key=lambda s: s.stage_id):
+            pending = stage.pending_partitions()
+            if not pending:
+                continue
+            p = pending[0]
+            stage.task_infos[p] = TaskInfo(p, executor_id, "running")
+            tid = TaskId(self.job_id, stage.stage_id, p,
+                         task_attempt=stage.task_failures[p],
+                         stage_attempt=stage.stage_attempt)
+            return TaskDescription(tid, stage.resolved_plan,
+                                   task_internal_id=next(self._task_id_gen),
+                                   scalars=self.scalars)
+        return None
+
+    # --- status intake ---------------------------------------------------
+    def update_task_status(self, statuses: List[TaskStatus]) -> List[Tuple[str, object]]:
+        """Absorb executor task outcomes; returns job-level events:
+        ('job_successful', locations) | ('job_failed', message).
+        Parity: reference execution_graph.rs:270-657."""
+        events: List[Tuple[str, object]] = []
+        if self.status != "running":
+            return events
+        for st in statuses:
+            stage = self.stages.get(st.task.stage_id)
+            if stage is None:
+                continue
+            if st.task.stage_attempt != stage.stage_attempt:
+                # late message from a rolled-back attempt — drop it
+                # (reference handles these via attempt checks)
+                continue
+            if st.state == "success":
+                self._on_task_success(stage, st, events)
+            elif st.state == "failed":
+                self._on_task_failed(stage, st, events)
+            # 'killed' -> nothing: job-level cancel already recorded
+            if self.status != "running":
+                break
+        return events
+
+    def _on_task_success(self, stage: ExecutionStage, st: TaskStatus,
+                         events: List[Tuple[str, object]]) -> None:
+        p = st.task.partition
+        info = stage.task_infos[p]
+        if info is not None and info.state == "success":
+            return  # duplicate
+        stage.task_infos[p] = TaskInfo(p, st.executor_id, "success")
+        stage.outputs[p] = (st.executor_id, list(st.shuffle_writes))
+        if stage.all_successful() and stage.state == RUNNING:
+            stage.state = SUCCESSFUL
+            if stage.stage_id == self.final_stage_id:
+                self.status = "successful"
+                events.append(("job_successful", stage.output_locations()))
+            else:
+                self.revive()
+
+    def _on_task_failed(self, stage: ExecutionStage, st: TaskStatus,
+                        events: List[Tuple[str, object]]) -> None:
+        p = st.task.partition
+        reason = st.failure or FailedReason(EXECUTION_ERROR, "unknown failure")
+
+        if reason.kind == EXECUTION_ERROR:
+            self._fail_job(f"task {st.task.job_id}/{stage.stage_id}/{p}: "
+                           f"{reason.message}", events)
+            return
+
+        if reason.kind == TASK_KILLED:
+            return
+
+        if reason.kind == FETCH_PARTITION_ERROR:
+            self._on_fetch_failure(stage, reason, events)
+            return
+
+        # retryable (IOError / ExecutorLost / ResultLost)
+        if reason.count_to_failures:
+            stage.task_failures[p] += 1
+        if stage.task_failures[p] >= TASK_MAX_FAILURES:
+            self._fail_job(
+                f"task {st.task.job_id}/{stage.stage_id}/{p} failed "
+                f"{TASK_MAX_FAILURES} times: {reason.message}", events)
+            return
+        stage.task_infos[p] = None  # back to pending
+
+    def _on_fetch_failure(self, stage: ExecutionStage, reason: FailedReason,
+                          events: List[Tuple[str, object]]) -> None:
+        """Shuffle-lineage retry (execution_graph.rs: fetch failures remove
+        poisoned inputs, roll back the reducer, re-run the producer)."""
+        producer = self.stages.get(reason.map_stage_id)
+        if producer is None:
+            self._fail_job(f"fetch failure names unknown stage "
+                           f"{reason.map_stage_id}", events)
+            return
+        stage.rollback()
+        if stage.failures >= STAGE_MAX_FAILURES:
+            self._fail_job(
+                f"stage {stage.stage_id} exceeded {STAGE_MAX_FAILURES} "
+                f"attempts after fetch failures", events)
+            return
+        producer.reopen_partitions([reason.map_partition_id])
+        if producer.failures >= STAGE_MAX_FAILURES:
+            self._fail_job(
+                f"stage {producer.stage_id} exceeded {STAGE_MAX_FAILURES} "
+                f"re-runs", events)
+            return
+        self.revive()
+
+    # --- executor loss ---------------------------------------------------
+    def executor_lost(self, executor_id: str) -> None:
+        """Reset tasks and roll back stages whose outputs lived on the lost
+        executor (reference execution_graph.rs:950-1095).  Does not count
+        toward stage attempt budgets: losing a node is not the query's
+        fault."""
+        if self.status != "running":
+            return
+        # 1. forget running tasks on the executor
+        for stage in self.stages.values():
+            if stage.state != RUNNING:
+                continue
+            for p, info in enumerate(stage.task_infos):
+                if info is not None and info.state == "running" \
+                        and info.executor_id == executor_id:
+                    stage.task_infos[p] = None
+        # 2. re-open map partitions whose outputs are gone
+        poisoned: List[int] = []
+        for stage in self.stages.values():
+            lost = [p for p, (ex, _) in stage.outputs.items() if ex == executor_id]
+            if lost:
+                stage.reopen_partitions(lost, count_attempt=False)
+                poisoned.append(stage.stage_id)
+        # 3. roll back non-successful consumers of poisoned stages
+        #    (they may hold resolved plans pointing at dead locations);
+        #    consumers that are already SUCCESSFUL keep their outputs.
+        #    No recursion needed: a consumer-of-a-consumer can only be
+        #    RUNNING if its producer was SUCCESSFUL, whose lost outputs
+        #    step 2 already handles directly.
+        for sid in poisoned:
+            for cid in self.stages[sid].output_links:
+                consumer = self.stages[cid]
+                if consumer.state == RUNNING:
+                    consumer.rollback(count_failure=False)
+        self.revive()
+
+    # --- job level -------------------------------------------------------
+    def _fail_job(self, message: str, events: List[Tuple[str, object]]) -> None:
+        self.status = "failed"
+        self.error = message
+        events.append(("job_failed", message))
+
+    def cancel(self) -> None:
+        self.status = "cancelled"
+
+    def running_tasks(self) -> List[Tuple[int, int, str]]:
+        """(stage_id, partition, executor_id) of in-flight tasks."""
+        out = []
+        for stage in self.stages.values():
+            if stage.state != RUNNING:
+                continue
+            for info in stage.task_infos:
+                if info is not None and info.state == "running":
+                    out.append((stage.stage_id, info.partition, info.executor_id))
+        return out
+
+    def __repr__(self):
+        lines = [f"ExecutionGraph(job={self.job_id}, status={self.status})"]
+        for sid in sorted(self.stages):
+            lines.append("  " + repr(self.stages[sid]))
+        return "\n".join(lines)

@@ -1,0 +1,278 @@
+"""SchedulerServer: the event-driven scheduler state machine.
+
+Parity with the reference scheduler
+(reference ballista/scheduler/src/scheduler_server/):
+- event set mirrors QueryStageSchedulerEvent (event.rs:14-57):
+  JobQueued -> (async planning) -> JobSubmitted | JobPlanningFailed,
+  ReservationOffering, TaskUpdating, ExecutorLost, JobCancel, JobFinished;
+- all state transitions run on one EventLoop (query_stage_scheduler.rs);
+- push scheduling via slot reservations: free slots are reserved
+  atomically, filled with tasks from active jobs, and launched through the
+  ``TaskLauncher`` seam (state/task_manager.rs:59-119) — the seam is what
+  lets tests fabricate a whole cluster in-process (SURVEY.md §4);
+- a reaper thread expires dead executors (scheduler_server/mod.rs:224-305).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import string
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .cluster import ClusterState, JobState
+from .event_loop import EventLoop
+from .execution_graph import ExecutionGraph
+from .types import (
+    ExecutorHeartbeat,
+    ExecutorMetadata,
+    ExecutorReservation,
+    JobStatus,
+    TaskDescription,
+    TaskStatus,
+)
+
+log = logging.getLogger(__name__)
+
+
+def random_job_id() -> str:
+    """7-char alphanumeric job ids (reference task_manager.rs generates the
+    same shape)."""
+    return "".join(random.choices(string.ascii_lowercase + string.digits, k=7))
+
+
+class TaskLauncher:
+    """Launch seam (reference TaskLauncher trait, task_manager.rs:59-67)."""
+
+    def launch_tasks(self, executor_id: str, tasks: List[TaskDescription]) -> None:
+        raise NotImplementedError
+
+    def cancel_tasks(self, executor_id: str, job_id: str) -> None:
+        """Best-effort cancellation of a job's running tasks."""
+
+    def stop(self) -> None:
+        pass
+
+
+# --- events (reference scheduler_server/event.rs) -------------------------
+@dataclasses.dataclass
+class JobQueued:
+    job_id: str
+    plan_fn: Callable[[], Tuple[object, Dict[str, object]]]
+    # plan_fn() -> (root physical plan, scalar values) — planning runs inside
+    # the event loop worker, failures become JobPlanningFailed
+
+
+@dataclasses.dataclass
+class TaskUpdating:
+    executor_id: str
+    statuses: List[TaskStatus]
+
+
+@dataclasses.dataclass
+class ExecutorLost:
+    executor_id: str
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class JobCancel:
+    job_id: str
+
+
+@dataclasses.dataclass
+class Offer:
+    """Try to hand out tasks (reference ReservationOffering)."""
+
+
+class SchedulerConfig:
+    def __init__(self, task_distribution: str = "bias",
+                 executor_timeout_s: float = 180.0,
+                 reaper_interval_s: float = 15.0,
+                 event_buffer_size: int = 10000):
+        self.task_distribution = task_distribution
+        self.executor_timeout_s = executor_timeout_s
+        self.reaper_interval_s = reaper_interval_s
+        self.event_buffer_size = event_buffer_size
+
+
+class SchedulerServer:
+    def __init__(self, launcher: TaskLauncher,
+                 config: Optional[SchedulerConfig] = None):
+        self.config = config or SchedulerConfig()
+        self.cluster = ClusterState(self.config.task_distribution)
+        self.jobs = JobState()
+        self.launcher = launcher
+        self._event_loop = EventLoop("scheduler-events", self._on_event,
+                                     self.config.event_buffer_size)
+        self._launch_pool = ThreadPoolExecutor(max_workers=8,
+                                               thread_name_prefix="launch")
+        self._reaper: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    # --- lifecycle -------------------------------------------------------
+    def init(self, start_reaper: bool = True) -> None:
+        self._event_loop.start()
+        if start_reaper:
+            self._reaper = threading.Thread(target=self._reap_loop,
+                                            name="executor-reaper", daemon=True)
+            self._reaper.start()
+
+    def shutdown(self) -> None:
+        self._stopped.set()
+        self._event_loop.stop()
+        self._launch_pool.shutdown(wait=False)
+        self.launcher.stop()
+
+    # --- public API (the SchedulerGrpc surface, ballista.proto:665-689) --
+    def register_executor(self, meta: ExecutorMetadata) -> None:
+        self.cluster.register_executor(meta)
+        self._event_loop.post(Offer())
+
+    def heartbeat(self, hb: ExecutorHeartbeat) -> None:
+        known = self.cluster.get_executor(hb.executor_id) is not None
+        self.cluster.save_heartbeat(hb)
+        if not known:
+            log.info("heartbeat from unknown executor %s", hb.executor_id)
+
+    def executor_stopped(self, executor_id: str, reason: str = "") -> None:
+        self._event_loop.post(ExecutorLost(executor_id, reason))
+
+    def submit_job(self, job_id: str,
+                   plan_fn: Callable[[], Tuple[object, Dict[str, object]]]) -> None:
+        self.jobs.accept_job(job_id)
+        self._event_loop.post(JobQueued(job_id, plan_fn))
+
+    def update_task_status(self, executor_id: str,
+                           statuses: List[TaskStatus]) -> None:
+        self._event_loop.post(TaskUpdating(executor_id, statuses))
+
+    def cancel_job(self, job_id: str) -> None:
+        self._event_loop.post(JobCancel(job_id))
+
+    def get_job_status(self, job_id: str) -> Optional[JobStatus]:
+        return self.jobs.get_status(job_id)
+
+    def wait_for_job(self, job_id: str, timeout: float = 300.0) -> JobStatus:
+        return self.jobs.wait_for_completion(job_id, timeout)
+
+    def pending_task_count(self) -> int:
+        return sum(g.available_task_count() for g in self.jobs.active_graphs())
+
+    # --- event machine ---------------------------------------------------
+    def _on_event(self, event: object) -> None:
+        if isinstance(event, JobQueued):
+            self._on_job_queued(event)
+        elif isinstance(event, TaskUpdating):
+            self._on_task_updating(event)
+        elif isinstance(event, ExecutorLost):
+            self._on_executor_lost(event)
+        elif isinstance(event, JobCancel):
+            self._on_job_cancel(event)
+        elif isinstance(event, Offer):
+            self._offer()
+        else:
+            log.warning("unknown scheduler event %r", event)
+
+    def _on_job_queued(self, ev: JobQueued) -> None:
+        try:
+            plan, scalars = ev.plan_fn()
+            graph = ExecutionGraph.build(ev.job_id, plan)
+            graph.scalars = scalars
+        except Exception as e:  # noqa: BLE001 — planning failures fail the job
+            log.exception("planning failed for job %s", ev.job_id)
+            self.jobs.set_status(JobStatus(ev.job_id, "failed",
+                                           error=f"planning error: {e}"))
+            return
+        self.jobs.submit_job(ev.job_id, graph)
+        self._offer()
+
+    def _on_task_updating(self, ev: TaskUpdating) -> None:
+        self.cluster.free_slots(ev.executor_id, len(ev.statuses))
+        by_job: Dict[str, List[TaskStatus]] = {}
+        for st in ev.statuses:
+            by_job.setdefault(st.task.job_id, []).append(st)
+        for job_id, sts in by_job.items():
+            graph = self.jobs.get_graph(job_id)
+            if graph is None:
+                continue
+            for kind, payload in graph.update_task_status(sts):
+                if kind == "job_successful":
+                    self.jobs.set_status(
+                        JobStatus(job_id, "successful", locations=payload))
+                elif kind == "job_failed":
+                    self.jobs.set_status(
+                        JobStatus(job_id, "failed", error=str(payload)))
+                    self._cancel_running(graph)
+        self._offer()
+
+    def _on_executor_lost(self, ev: ExecutorLost) -> None:
+        log.info("executor %s lost: %s", ev.executor_id, ev.reason)
+        self.cluster.remove_executor(ev.executor_id)
+        for graph in self.jobs.active_graphs():
+            graph.executor_lost(ev.executor_id)
+        self._offer()
+
+    def _on_job_cancel(self, ev: JobCancel) -> None:
+        graph = self.jobs.get_graph(ev.job_id)
+        if graph is None or graph.status != "running":
+            return
+        graph.cancel()
+        self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
+        self._cancel_running(graph)
+
+    def _cancel_running(self, graph: ExecutionGraph) -> None:
+        executors = {eid for _, _, eid in graph.running_tasks()}
+        for eid in executors:
+            try:
+                self.launcher.cancel_tasks(eid, graph.job_id)
+            except Exception:  # noqa: BLE001
+                log.exception("cancel_tasks failed for %s", eid)
+
+    # --- push scheduling -------------------------------------------------
+    def _offer(self) -> None:
+        """Reserve free slots and fill them with tasks (reference
+        state/mod.rs:195-233 offer_reservation + fill_reservations)."""
+        alive = set(self.cluster.alive_executors(self.config.executor_timeout_s))
+        pending = self.pending_task_count()
+        if pending == 0 or not alive:
+            return
+        reservations = self.cluster.reserve_slots(pending, sorted(alive))
+        if not reservations:
+            return
+        assignments: Dict[str, List[TaskDescription]] = {}
+        unused: List[ExecutorReservation] = []
+        graphs = self.jobs.active_graphs()
+        for r in reservations:
+            task = None
+            for graph in graphs:
+                task = graph.pop_next_task(r.executor_id)
+                if task is not None:
+                    break
+            if task is None:
+                unused.append(r)
+            else:
+                assignments.setdefault(r.executor_id, []).append(task)
+        if unused:
+            self.cluster.cancel_reservations(unused)
+        for executor_id, tasks in assignments.items():
+            self._launch_pool.submit(self._launch, executor_id, tasks)
+
+    def _launch(self, executor_id: str, tasks: List[TaskDescription]) -> None:
+        try:
+            self.launcher.launch_tasks(executor_id, tasks)
+        except Exception as e:  # noqa: BLE001 — treat as executor failure
+            log.exception("launch on %s failed", executor_id)
+            self.cluster.free_slots(executor_id, len(tasks))
+            self._event_loop.post(ExecutorLost(executor_id, f"launch failed: {e}"))
+
+    # --- failure detection ----------------------------------------------
+    def _reap_loop(self) -> None:
+        """Dead-executor reaper (reference expire_dead_executors,
+        scheduler_server/mod.rs:224-305)."""
+        while not self._stopped.wait(self.config.reaper_interval_s):
+            for eid in self.cluster.expired_executors(self.config.executor_timeout_s):
+                self._event_loop.post(ExecutorLost(eid, "heartbeat timeout"))

@@ -1,0 +1,127 @@
+"""Standalone mode: scheduler + executors in one process.
+
+Parity: reference ballista/scheduler/src/standalone.rs +
+ballista/executor/src/standalone.rs + BallistaContext::standalone
+(client context.rs:142-212) — the full stage-DAG machinery, shuffle files,
+and fault-tolerance paths run in-process with no RPC, which is also the
+test configuration (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..executor.executor import Executor
+from ..models.batch import ColumnBatch
+from ..models.ipc import read_ipc_files
+from ..ops.physical import TaskContext
+from ..utils.config import BallistaConfig
+from ..utils.errors import ExecutionError
+from .scheduler import (
+    SchedulerConfig,
+    SchedulerServer,
+    TaskLauncher,
+    random_job_id,
+)
+from .types import ExecutorHeartbeat, ExecutorMetadata, TaskDescription
+
+
+class InProcessTaskLauncher(TaskLauncher):
+    """Launch seam wired directly to in-proc Executor objects."""
+
+    def __init__(self):
+        self.executors: Dict[str, Executor] = {}
+        self.scheduler: Optional[SchedulerServer] = None
+
+    def launch_tasks(self, executor_id: str, tasks: List[TaskDescription]) -> None:
+        executor = self.executors[executor_id]
+        for task in tasks:
+            executor.submit_task(
+                task,
+                lambda st: self.scheduler.update_task_status(executor_id, [st]))
+
+    def cancel_tasks(self, executor_id: str, job_id: str) -> None:
+        self.executors[executor_id].cancel_job_tasks(job_id)
+
+    def stop(self) -> None:
+        for ex in self.executors.values():
+            ex.shutdown()
+
+
+class StandaloneCluster:
+    """In-proc scheduler + N executors sharing a work_dir tree."""
+
+    def __init__(self, config: Optional[BallistaConfig] = None,
+                 concurrent_tasks: int = 4, num_executors: int = 1,
+                 work_dir: Optional[str] = None,
+                 scheduler_config: Optional[SchedulerConfig] = None):
+        self.config = config or BallistaConfig()
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-")
+        self._owns_work_dir = work_dir is None
+        self.launcher = InProcessTaskLauncher()
+        self.scheduler = SchedulerServer(self.launcher, scheduler_config)
+        self.launcher.scheduler = self.scheduler
+        self.scheduler.init()
+        self.executors: List[Executor] = []
+        for i in range(num_executors):
+            meta = ExecutorMetadata(executor_id=f"executor-{i}",
+                                    task_slots=concurrent_tasks)
+            ex = Executor(meta, self.work_dir, self.config,
+                          concurrent_tasks=concurrent_tasks)
+            self.executors.append(ex)
+            self.launcher.executors[meta.executor_id] = ex
+            self.scheduler.register_executor(meta)
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           name="standalone-heartbeat",
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        # reference executors heartbeat every 60 s (executor_server.rs:465)
+        while not self._hb_stop.wait(10.0):
+            for ex in self.executors:
+                self.scheduler.heartbeat(
+                    ExecutorHeartbeat(ex.metadata.executor_id))
+
+    # --- query execution -------------------------------------------------
+    def execute(self, planned) -> List[ColumnBatch]:
+        """Run a PlannedQuery through the distributed machinery and fetch
+        the final-stage output files (the client side of
+        DistributedQueryExec, reference distributed_query.rs:226-329)."""
+        from ..client.context import extract_scalar
+
+        # scalar subqueries run first, host-side (they are tiny by
+        # construction: single-row reductions)
+        scalar_ctx = TaskContext(config=self.config, work_dir=self.work_dir,
+                                 job_id="scalars")
+        scalars: Dict[str, object] = {}
+        for sid, splan in planned.scalars:
+            scalar_ctx.scalars = scalars
+            scalars[sid] = extract_scalar(splan, scalar_ctx)
+
+        job_id = random_job_id()
+        self.scheduler.submit_job(job_id, lambda: (planned.plan, scalars))
+        status = self.scheduler.wait_for_job(job_id)
+        if status.state == "failed":
+            raise ExecutionError(f"job {job_id} failed: {status.error}")
+        if status.state != "successful":
+            raise ExecutionError(f"job {job_id} ended as {status.state}")
+
+        schema = planned.plan.schema
+        batches: List[ColumnBatch] = []
+        for part in sorted(status.locations):
+            paths = [loc.path for loc in status.locations[part] if loc.num_rows]
+            batches.extend(read_ipc_files(paths, schema,
+                                          capacity=self.config.batch_size))
+        return batches
+
+    def shutdown(self) -> None:
+        self._hb_stop.set()
+        self.scheduler.shutdown()
+        if self._owns_work_dir:
+            shutil.rmtree(self.work_dir, ignore_errors=True)

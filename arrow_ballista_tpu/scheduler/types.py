@@ -1,0 +1,123 @@
+"""Control-plane message types.
+
+Python mirrors of the reference's protobuf contract
+(reference ballista/core/proto/ballista.proto): task identity/status with
+the full failure taxonomy (ballista.proto:360-431), executor metadata and
+heartbeats (284-358), and task definitions (440-463).  These are plain
+dataclasses — the wire encoding for remote mode lives in
+``arrow_ballista_tpu/net/wire.py`` and serializes exactly these shapes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from ..ops.shuffle import PartitionLocation, ShuffleWritePartition
+
+# failure taxonomy (ballista.proto:391-431 FailedTask oneof)
+EXECUTION_ERROR = "ExecutionError"      # fatal: fails the job
+FETCH_PARTITION_ERROR = "FetchPartitionError"  # re-run producer stage
+IO_ERROR = "IOError"                    # retryable on another executor
+EXECUTOR_LOST = "ExecutorLost"          # retryable
+RESULT_LOST = "ResultLost"              # retryable, outputs discarded
+TASK_KILLED = "TaskKilled"              # cancellation
+
+
+@dataclasses.dataclass
+class TaskId:
+    job_id: str
+    stage_id: int
+    partition: int
+    task_attempt: int = 0
+    stage_attempt: int = 0
+
+
+@dataclasses.dataclass
+class TaskDescription:
+    """A runnable task handed to an executor (parity: TaskDefinition,
+    ballista.proto:440-452)."""
+
+    task: TaskId
+    plan: "object"  # ShuffleWriterExec root (encoded bytes in remote mode)
+    task_internal_id: int = 0
+    # job-level scalar-subquery values, shipped with every task (the
+    # reference ships session props the same way, ballista.proto:446-449)
+    scalars: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class FailedReason:
+    kind: str  # one of the taxonomy constants
+    message: str = ""
+    # FetchPartitionError details (ballista.proto:399-404)
+    map_stage_id: int = -1
+    map_partition_id: int = -1
+    executor_id: str = ""
+
+    @property
+    def retryable(self) -> bool:
+        return self.kind in (IO_ERROR, EXECUTOR_LOST, RESULT_LOST)
+
+    @property
+    def count_to_failures(self) -> bool:
+        return self.kind == IO_ERROR
+
+
+@dataclasses.dataclass
+class TaskStatus:
+    """Executor -> scheduler task outcome (ballista.proto:360-390)."""
+
+    task: TaskId
+    executor_id: str
+    state: str  # 'success' | 'failed' | 'killed'
+    shuffle_writes: List[ShuffleWritePartition] = dataclasses.field(default_factory=list)
+    failure: Optional[FailedReason] = None
+    launch_time_ms: int = 0
+    start_time_ms: int = 0
+    end_time_ms: int = 0
+    metrics: Dict[str, Dict[str, int]] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ExecutorMetadata:
+    """ballista.proto:284-300."""
+
+    executor_id: str
+    host: str = "localhost"
+    port: int = 0
+    grpc_port: int = 0
+    task_slots: int = 1
+
+
+@dataclasses.dataclass
+class ExecutorHeartbeat:
+    executor_id: str
+    timestamp: float = dataclasses.field(default_factory=time.time)
+    status: str = "active"  # 'active' | 'dead' | 'terminating'
+
+
+@dataclasses.dataclass
+class ExecutorReservation:
+    """A reserved task slot, optionally job-affine (parity:
+    reference scheduler state/executor_manager.rs:48-66)."""
+
+    executor_id: str
+    job_id: Optional[str] = None
+
+
+# job status (ballista.proto:528-663 JobStatus oneof)
+JOB_QUEUED = "queued"
+JOB_RUNNING = "running"
+JOB_SUCCESSFUL = "successful"
+JOB_FAILED = "failed"
+JOB_CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class JobStatus:
+    job_id: str
+    state: str
+    error: str = ""
+    # successful: per output-partition locations of the final stage
+    locations: Dict[int, List[PartitionLocation]] = dataclasses.field(default_factory=dict)

@@ -127,6 +127,14 @@ def prune_columns(plan: L.LogicalPlan, required: Optional[Set[str]] = None) -> L
                 if r not in needed:
                     needed.append(r)
         needed = [f.name for f in plan.table_schema if f.name in set(needed)]
+        if not needed:
+            # count(*)-only scans need no columns, but a zero-column batch
+            # cannot carry a row count: keep the narrowest column
+            width = {"bool": 1, "int32": 4, "date32": 4, "float32": 4,
+                     "int64": 8, "float64": 8, "decimal": 8, "string": 64}
+            fields = sorted(plan.table_schema,
+                            key=lambda f: (width.get(f.dtype.kind, 64), f.name))
+            needed = [fields[0].name]
         return L.TableScan(plan.table, plan.table_schema, needed, plan.filters)
 
     if isinstance(plan, L.SubqueryAlias):

@@ -1,0 +1,69 @@
+"""Headline benchmark: TPC-H q1 pipeline throughput on one chip.
+
+Runs the flagship fused query step (filter -> derived columns -> grouped
+aggregate, the TPC-H q1 execution shape) over synthetic lineitem-shaped
+data resident in HBM, and reports rows/sec.
+
+Baseline: the reference's README chart puts Ballista 0.11 at ~3.1 s for
+q1 at SF10 (~59.99M lineitem rows) on a 24-core single-node executor
+(reference README.md:52-60, BASELINE.md) => ~19.35M rows/s.
+``vs_baseline`` = our rows/s divided by that.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_ROWS_PER_S = 59_986_052 / 3.1  # reference q1 SF10 wall-clock
+
+ROWS = 8_000_000
+ITERS = 5
+
+
+def main() -> None:
+    from __graft_entry__ import _q1_augment, _q1_example, _q1_filter, _Q1_AGGS, _Q1_KEYS
+    from arrow_ballista_tpu.ops import kernels as K
+
+    cols_np, mask_np = _q1_example(ROWS, seed=7)
+    cols = {k: jax.device_put(jnp.asarray(v)) for k, v in cols_np.items()}
+    mask = jax.device_put(jnp.asarray(mask_np))
+
+    @jax.jit
+    def step(cols, mask):
+        cols, mask = _q1_filter(cols, mask)
+        cols = _q1_augment(cols)
+        keys = [cols[k] for k in _Q1_KEYS]
+        vals = [(cols[v], how) for v, how in _Q1_AGGS]
+        return K.grouped_aggregate(keys, vals, mask, 16)
+
+    # warmup / compile
+    out = step(cols, mask)
+    jax.block_until_ready(out[1])
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = step(cols, mask)
+        jax.block_until_ready(out[1])
+        times.append(time.perf_counter() - t0)
+
+    elapsed = float(np.median(times))
+    rows_per_s = ROWS / elapsed
+    print(json.dumps({
+        "metric": "tpch_q1_pipeline_rows_per_sec",
+        "value": round(rows_per_s, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_s / BASELINE_ROWS_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,430 @@
+"""Scheduler control-plane tests: virtual-executor cluster in one process.
+
+Mirrors the reference's three test seams (SURVEY.md §4, reference
+ballista/scheduler/src/test_utils.rs):
+
+1. ``VirtualTaskLauncher`` — synchronously fabricates TaskStatus results
+   (incl. fake shuffle paths) and feeds them back through
+   ``update_task_status``: a full cluster, no I/O, no executors.
+2. ``SchedulerTest``-style harness — parameterized executors/slots with a
+   per-task outcome hook for failure injection.
+3. ExecutionGraph drain simulation — mock task completions pump the graph
+   to completion in-process (reference execution_graph.rs test mod).
+"""
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.catalog import MemoryTable, SchemaCatalog
+from arrow_ballista_tpu.ops.shuffle import ShuffleWritePartition
+from arrow_ballista_tpu.scheduler.execution_graph import (
+    RUNNING,
+    STAGE_MAX_FAILURES,
+    SUCCESSFUL,
+    TASK_MAX_FAILURES,
+    UNRESOLVED,
+    ExecutionGraph,
+)
+from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+from arrow_ballista_tpu.scheduler.scheduler import (
+    SchedulerConfig,
+    SchedulerServer,
+    TaskLauncher,
+)
+from arrow_ballista_tpu.scheduler.types import (
+    EXECUTION_ERROR,
+    FETCH_PARTITION_ERROR,
+    IO_ERROR,
+    ExecutorMetadata,
+    FailedReason,
+    TaskDescription,
+    TaskStatus,
+)
+from arrow_ballista_tpu.sql.optimizer import optimize
+from arrow_ballista_tpu.sql.parser import parse_sql
+from arrow_ballista_tpu.sql.planner import SqlToRel
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+
+# --------------------------------------------------------------------------
+# plan fixture: a 2-stage aggregation + sort over a tiny in-memory table
+# --------------------------------------------------------------------------
+
+def physical_plan(sql: str = None, partitions: int = 4):
+    rng = np.random.default_rng(0)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 5, 1000).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, 1000).astype(np.int64)),
+    })
+    catalog = SchemaCatalog()
+    catalog.register(MemoryTable("t", t))
+    config = BallistaConfig({"ballista.shuffle.partitions": str(partitions)})
+    sql = sql or "select k, sum(v) as s from t group by k order by k"
+    logical = optimize(SqlToRel(catalog).plan(parse_sql(sql)))
+    return PhysicalPlanner(catalog, config).plan_query(logical).plan
+
+
+def fake_success(task: TaskDescription, executor_id: str) -> TaskStatus:
+    """Fabricate a successful status with fake shuffle files (parity:
+    reference test_utils.rs VirtualExecutor mock_completed_task)."""
+    writer = task.plan
+    if writer.partitioning is None:
+        writes = [ShuffleWritePartition(task.task.partition,
+                                        f"/fake/{task.task.job_id}/{task.task.stage_id}"
+                                        f"/{task.task.partition}/data-0.arrow", 10, 100)]
+    else:
+        writes = [ShuffleWritePartition(q, f"/fake/{task.task.job_id}"
+                                        f"/{task.task.stage_id}/{task.task.partition}"
+                                        f"/data-{q}.arrow", 10, 100)
+                  for q in range(writer.partitioning.count)]
+    return TaskStatus(task.task, executor_id, "success", shuffle_writes=writes)
+
+
+class VirtualTaskLauncher(TaskLauncher):
+    """Synchronous virtual cluster: every launched task completes (or
+    fails, per ``outcome_fn``) immediately, looping status back into the
+    scheduler (reference test_utils.rs:313-372)."""
+
+    def __init__(self, outcome_fn: Optional[Callable] = None):
+        self.scheduler: Optional[SchedulerServer] = None
+        self.outcome_fn = outcome_fn  # (task, executor_id) -> TaskStatus|None
+        self.launched: List[Tuple[str, TaskDescription]] = []
+        self.cancelled: List[Tuple[str, str]] = []
+        self._lock = threading.Lock()
+
+    def launch_tasks(self, executor_id, tasks):
+        statuses = []
+        with self._lock:
+            for t in tasks:
+                self.launched.append((executor_id, t))
+        for t in tasks:
+            st = None
+            if self.outcome_fn is not None:
+                st = self.outcome_fn(t, executor_id)
+            statuses.append(st or fake_success(t, executor_id))
+        self.scheduler.update_task_status(executor_id, statuses)
+
+    def cancel_tasks(self, executor_id, job_id):
+        self.cancelled.append((executor_id, job_id))
+
+
+class BlackholeTaskLauncher(TaskLauncher):
+    """Drops tasks on the floor (reference test_utils.rs:327-339)."""
+
+    def __init__(self):
+        self.count = 0
+
+    def launch_tasks(self, executor_id, tasks):
+        self.count += len(tasks)
+
+
+def scheduler_test(n_executors=2, slots=4, outcome_fn=None, launcher=None):
+    """SchedulerTest harness (reference test_utils.rs:375-672)."""
+    launcher = launcher or VirtualTaskLauncher(outcome_fn)
+    server = SchedulerServer(launcher, SchedulerConfig())
+    if hasattr(launcher, "scheduler"):
+        launcher.scheduler = server
+    server.init(start_reaper=False)
+    for i in range(n_executors):
+        server.register_executor(
+            ExecutorMetadata(executor_id=f"exec-{i}", task_slots=slots))
+    return server, launcher
+
+
+def run_job(server, plan, job_id="job1", timeout=30.0):
+    server.submit_job(job_id, lambda: (plan, {}))
+    return server.wait_for_job(job_id, timeout)
+
+
+# --------------------------------------------------------------------------
+# happy path
+# --------------------------------------------------------------------------
+
+def test_virtual_cluster_job_success():
+    server, launcher = scheduler_test()
+    status = run_job(server, physical_plan())
+    assert status.state == "successful"
+    assert status.locations, "final stage locations must be reported"
+    # every launched task had a resolved (executable) plan
+    for _, task in launcher.launched:
+        assert task.plan is not None
+    server.shutdown()
+
+
+def test_tasks_spread_over_executors_round_robin():
+    launcher = VirtualTaskLauncher()
+    server = SchedulerServer(launcher, SchedulerConfig(task_distribution="round-robin"))
+    launcher.scheduler = server
+    server.init(start_reaper=False)
+    for i in range(4):
+        server.register_executor(ExecutorMetadata(f"exec-{i}", task_slots=8))
+    status = run_job(server, physical_plan())
+    assert status.state == "successful"
+    used = {eid for eid, _ in launcher.launched}
+    assert len(used) >= 2, f"round-robin should spread tasks, used {used}"
+    server.shutdown()
+
+
+def test_job_queued_until_executor_registers():
+    launcher = VirtualTaskLauncher()
+    server = SchedulerServer(launcher, SchedulerConfig())
+    launcher.scheduler = server
+    server.init(start_reaper=False)
+    server.submit_job("job1", lambda: (physical_plan(), {}))
+    # no executors: job must stay running with pending tasks
+    server._event_loop.drain()
+    assert server.get_job_status("job1").state == "running"
+    assert server.pending_task_count() > 0
+    server.register_executor(ExecutorMetadata("exec-0", task_slots=4))
+    assert server.wait_for_job("job1", 30).state == "successful"
+    server.shutdown()
+
+
+def test_planning_failure_fails_job():
+    def exploding_plan():
+        raise RuntimeError("ExplodingTableProvider")  # test_utils.rs:71-103
+
+    server, _ = scheduler_test()
+    server.submit_job("boom", exploding_plan)
+    status = server.wait_for_job("boom", 10)
+    assert status.state == "failed"
+    assert "ExplodingTableProvider" in status.error
+    server.shutdown()
+
+
+# --------------------------------------------------------------------------
+# failure handling through the full scheduler
+# --------------------------------------------------------------------------
+
+def test_retryable_failure_then_success():
+    failed_once: Dict[tuple, bool] = {}
+
+    def outcome(task, executor_id):
+        key = (task.task.stage_id, task.task.partition)
+        if task.task.stage_id == 1 and task.task.partition == 0 \
+                and not failed_once.get(key):
+            failed_once[key] = True
+            return TaskStatus(task.task, executor_id, "failed",
+                              failure=FailedReason(IO_ERROR, "flaky disk"))
+        return None
+
+    server, launcher = scheduler_test(outcome_fn=outcome)
+    status = run_job(server, physical_plan())
+    assert status.state == "successful"
+    assert failed_once, "the injected failure must have fired"
+    server.shutdown()
+
+
+def test_execution_error_fails_job():
+    def outcome(task, executor_id):
+        return TaskStatus(task.task, executor_id, "failed",
+                          failure=FailedReason(EXECUTION_ERROR, "div by zero"))
+
+    server, _ = scheduler_test(outcome_fn=outcome)
+    status = run_job(server, physical_plan())
+    assert status.state == "failed"
+    assert "div by zero" in status.error
+    server.shutdown()
+
+
+def test_task_retries_exhausted_fails_job():
+    def outcome(task, executor_id):
+        if task.task.stage_id == 1 and task.task.partition == 0:
+            return TaskStatus(task.task, executor_id, "failed",
+                              failure=FailedReason(IO_ERROR, "always broken"))
+        return None
+
+    server, _ = scheduler_test(outcome_fn=outcome)
+    status = run_job(server, physical_plan())
+    assert status.state == "failed"
+    assert "4 times" in status.error
+    server.shutdown()
+
+
+def test_fetch_failure_triggers_producer_rerun():
+    reran_map: List[int] = []
+    injected = threading.Event()
+
+    def outcome(task, executor_id):
+        tid = task.task
+        # final stage tasks: first one reports it couldn't fetch map
+        # partition 2 of stage 1
+        if tid.stage_id == 2 and not injected.is_set():
+            injected.set()
+            return TaskStatus(tid, executor_id, "failed",
+                              failure=FailedReason(
+                                  FETCH_PARTITION_ERROR, "connection reset",
+                                  map_stage_id=1, map_partition_id=2,
+                                  executor_id=executor_id))
+        if tid.stage_id == 1 and injected.is_set():
+            reran_map.append(tid.partition)
+        return None
+
+    server, launcher = scheduler_test(outcome_fn=outcome)
+    status = run_job(server, physical_plan())
+    assert status.state == "successful"
+    assert injected.is_set()
+    assert 2 in reran_map, f"map partition 2 must re-run, got {reran_map}"
+    server.shutdown()
+
+
+def test_job_cancel():
+    launcher = BlackholeTaskLauncher()
+    server = SchedulerServer(launcher, SchedulerConfig())
+    server.init(start_reaper=False)
+    server.register_executor(ExecutorMetadata("exec-0", task_slots=4))
+    server.submit_job("job1", lambda: (physical_plan(), {}))
+    server._event_loop.drain()
+    assert launcher.count > 0, "tasks must have been launched (and dropped)"
+    server.cancel_job("job1")
+    status = server.wait_for_job("job1", 10)
+    assert status.state == "cancelled"
+    server.shutdown()
+
+
+# --------------------------------------------------------------------------
+# ExecutionGraph drain simulation (no scheduler, no launcher)
+# --------------------------------------------------------------------------
+
+def drain(graph: ExecutionGraph, executor_id="exec-0", hook=None):
+    """Pump the graph with fabricated completions (reference
+    execution_graph.rs drain_tasks test helper)."""
+    events = []
+    for _ in range(10000):
+        task = graph.pop_next_task(executor_id)
+        if task is None:
+            if graph.status != "running":
+                break
+            # nothing runnable but job alive -> deadlock in the graph
+            raise AssertionError(f"graph stalled: {graph!r}")
+        st = hook(task) if hook else None
+        events.extend(graph.update_task_status([st or fake_success(task, executor_id)]))
+    return events
+
+
+def test_graph_stage_structure():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=4))
+    # agg: partial (stage 1) -> final agg + sort-to-one (stage 2) -> final (stage 3)
+    assert len(graph.stages) == 3
+    s1, s2, s3 = (graph.stages[i] for i in (1, 2, 3))
+    assert s1.state == RUNNING and s2.state == UNRESOLVED and s3.state == UNRESOLVED
+    assert s1.output_links == [2] and s2.output_links == [3]
+    assert s2.producer_ids == [1] and s3.producer_ids == [2]
+    assert graph.final_stage_id == 3
+
+
+def test_graph_drain_to_success():
+    graph = ExecutionGraph.build("j", physical_plan())
+    events = drain(graph)
+    assert graph.status == "successful"
+    assert events and events[-1][0] == "job_successful"
+    locations = events[-1][1]
+    assert sorted(locations) == [0]  # single final partition (sort)
+
+
+def test_graph_executor_lost_mid_flight():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=4))
+    # run stage 1 fully on exec-A
+    while graph.stages[1].pending_partitions():
+        t = graph.pop_next_task("exec-A")
+        graph.update_task_status([fake_success(t, "exec-A")])
+    assert graph.stages[1].state == SUCCESSFUL
+    assert graph.stages[2].state == RUNNING
+    # start one stage-2 task on exec-B, then lose exec-A (all stage-1 outputs)
+    t2 = graph.pop_next_task("exec-B")
+    graph.executor_lost("exec-A")
+    assert graph.stages[1].state == RUNNING, "stage 1 outputs lost -> re-run"
+    assert graph.stages[2].state == UNRESOLVED, "stage 2 must roll back"
+    # graph still completes, now on exec-B
+    drain(graph, "exec-B")
+    assert graph.status == "successful"
+
+
+def test_graph_reresolve_uses_fresh_locations():
+    """After a rollback, re-resolution must see the re-run producer's NEW
+    locations, not the dead attempt's (regression: resolve mutates the
+    stage plan in place; rollback must restore the unresolved leaves)."""
+    from arrow_ballista_tpu.ops.shuffle import ShuffleReaderExec
+    from arrow_ballista_tpu.scheduler.planner import collect_nodes
+
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    while graph.stages[1].pending_partitions():
+        t = graph.pop_next_task("exec-A")
+        graph.update_task_status([fake_success(t, "exec-A")])
+    assert graph.stages[2].state == RUNNING
+    graph.executor_lost("exec-A")  # all stage-1 outputs gone
+    assert graph.stages[2].state == UNRESOLVED
+    # stage 1 re-runs on exec-B
+    while graph.stages[1].pending_partitions():
+        t = graph.pop_next_task("exec-B")
+        graph.update_task_status([fake_success(t, "exec-B")])
+    assert graph.stages[2].state == RUNNING
+    readers = collect_nodes(graph.stages[2].resolved_plan, ShuffleReaderExec)
+    assert readers, "stage 2 must have re-resolved shuffle readers"
+    for r in readers:
+        for locs in r.locations.values():
+            for loc in locs:
+                assert loc.executor_id == "exec-B", \
+                    f"stale location from dead executor: {loc}"
+    drain(graph, "exec-B")
+    assert graph.status == "successful"
+
+
+def test_graph_fetch_failure_attempt_budget():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    # stage 1 completes
+    while graph.stages[1].pending_partitions():
+        t = graph.pop_next_task("e")
+        graph.update_task_status([fake_success(t, "e")])
+
+    # every stage-2 attempt immediately reports a fetch failure
+    failures = 0
+    events = []
+    for _ in range(20):
+        t = graph.pop_next_task("e")
+        if t is None:
+            break
+        if t.task.stage_id != 2:
+            events.extend(graph.update_task_status([fake_success(t, "e")]))
+            continue
+        failures += 1
+        events.extend(graph.update_task_status([TaskStatus(
+            t.task, "e", "failed",
+            failure=FailedReason(FETCH_PARTITION_ERROR, "dead peer",
+                                 map_stage_id=1, map_partition_id=0,
+                                 executor_id="e"))]))
+    assert graph.status == "failed"
+    assert failures <= STAGE_MAX_FAILURES
+    assert any(k == "job_failed" for k, _ in events)
+
+
+def test_graph_duplicate_success_ignored():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    t = graph.pop_next_task("e")
+    st = fake_success(t, "e")
+    graph.update_task_status([st])
+    before = dict(graph.stages[t.task.stage_id].outputs)
+    graph.update_task_status([st])  # duplicate report
+    assert graph.stages[t.task.stage_id].outputs == before
+
+
+def test_graph_late_status_from_old_attempt_dropped():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    while graph.stages[1].pending_partitions():
+        t = graph.pop_next_task("e")
+        graph.update_task_status([fake_success(t, "e")])
+    t2 = graph.pop_next_task("e")
+    assert t2.task.stage_id == 2
+    # fetch failure rolls stage 2 back; its attempt counter bumps
+    graph.update_task_status([TaskStatus(
+        t2.task, "e", "failed",
+        failure=FailedReason(FETCH_PARTITION_ERROR, "x", map_stage_id=1,
+                             map_partition_id=0, executor_id="e"))])
+    # a late success from the old attempt must be ignored
+    graph.update_task_status([fake_success(t2, "e")])
+    stage2 = graph.stages[2]
+    assert stage2.state == UNRESOLVED
+    assert all(i is None for i in stage2.task_infos)
