@@ -337,3 +337,55 @@ def and_all(es: Sequence[Expr]) -> Optional[Expr]:
     for e in es[1:]:
         out = BinOp("and", out, e)
     return out
+
+
+def disjuncts(e: Expr) -> List[Expr]:
+    if isinstance(e, BinOp) and e.op == "or":
+        return disjuncts(e.left) + disjuncts(e.right)
+    return [e]
+
+
+def or_all(es: Sequence[Expr]) -> Optional[Expr]:
+    es = list(es)
+    if not es:
+        return None
+    out = es[0]
+    for e in es[1:]:
+        out = BinOp("or", out, e)
+    return out
+
+
+def factored_conjuncts(e: Optional[Expr]) -> List[Expr]:
+    """Conjuncts with OR-branch common-factor extraction:
+    ``(A and B) or (A and C)`` -> ``[A, (B or C)]``.
+
+    This is what lets TPC-H q19's OR-of-ANDs expose its ``p_partkey =
+    l_partkey`` join edge (the reference inherits the same rewrite from
+    DataFusion's predicate simplification)."""
+    out: List[Expr] = []
+    for c in conjuncts(e):
+        out.extend(_factor_or(c))
+    return out
+
+
+def _factor_or(e: Expr) -> List[Expr]:
+    if not (isinstance(e, BinOp) and e.op == "or"):
+        return [e]
+    branch_conjs = [conjuncts(b) for b in disjuncts(e)]
+    common_keys = set(str(c) for c in branch_conjs[0])
+    for bc in branch_conjs[1:]:
+        common_keys &= {str(c) for c in bc}
+    if not common_keys:
+        return [e]
+    common, seen = [], set()
+    for c in branch_conjs[0]:
+        if str(c) in common_keys and str(c) not in seen:
+            common.append(c)
+            seen.add(str(c))
+    residuals = []
+    for bc in branch_conjs:
+        rem = [c for c in bc if str(c) not in common_keys]
+        if not rem:
+            return common  # a branch reduces to the common part alone
+        residuals.append(and_all(rem))
+    return common + [or_all(residuals)]

@@ -142,8 +142,13 @@ class Join(LogicalPlan):
         self.filter = filter
         if join_type in ("semi", "anti"):
             self.schema = left.schema
-        elif join_type in ("inner", "left"):
+        elif join_type == "inner":
             self.schema = left.schema.merge(right.schema)
+        elif join_type == "left":
+            # right side is nullable: unmatched probe rows carry NULLs
+            self.schema = Schema(
+                list(left.schema)
+                + [Field(f.name, f.dtype, nullable=True) for f in right.schema])
         else:
             raise PlanningError(f"unsupported join type {join_type}")
 

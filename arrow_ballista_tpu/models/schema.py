@@ -57,6 +57,23 @@ class DataType:
         return _NP_DTYPES[self.kind]
 
     @property
+    def null_sentinel(self):
+        """In-band NULL marker for nullable columns (outer-join fill).
+
+        Strings use the dictionary code -1 (the existing null code);
+        integers/decimals/dates use the dtype minimum (never produced by
+        real data paths: TPC-H values are small positive); floats use NaN."""
+        if self.kind == "string":
+            return -1
+        if self.kind in ("int64", "decimal"):
+            return np.iinfo(np.int64).min
+        if self.kind in ("int32", "date32"):
+            return np.iinfo(np.int32).min
+        if self.kind in ("float32", "float64"):
+            return float("nan")
+        return False  # bool
+
+    @property
     def is_numeric(self) -> bool:
         return self.kind in ("int32", "int64", "float32", "float64", "decimal")
 

@@ -373,6 +373,17 @@ class ExprCompiler:
                 if e.negated:
                     return Compiled(lambda c, a: oc.fn(c, a) >= 0, BOOL)
                 return Compiled(lambda c, a: oc.fn(c, a) < 0, BOOL)
+            # nullable numerics (outer-join columns) carry in-band sentinels
+            if isinstance(e.operand, E.Column) and e.operand.name in self.schema \
+                    and self.schema.field(e.operand.name).nullable:
+                sent = self.schema.field(e.operand.name).dtype.null_sentinel
+                if isinstance(sent, float) and sent != sent:  # NaN
+                    isnull = lambda c, a: xp.isnan(oc.fn(c, a))  # noqa: E731
+                else:
+                    isnull = lambda c, a: oc.fn(c, a) == sent  # noqa: E731
+                if e.negated:
+                    return Compiled(lambda c, a: ~isnull(c, a), BOOL)
+                return Compiled(isnull, BOOL)
             val = e.negated
             return Compiled(lambda c, a: xp.full(oc.fn(c, a).shape, val, dtype=bool), BOOL)
 
@@ -562,4 +573,8 @@ class ExprCompiler:
             return Compiled(lambda c, a: lc.fn(c, a) + rc.fn(c, a), out_t)
         if op == "-":
             return Compiled(lambda c, a: lc.fn(c, a) - rc.fn(c, a), out_t)
+        if op == "*":
+            # float multiply (decimal*decimal is handled above): both sides
+            # coerced to the float result type
+            return Compiled(lambda c, a: lc.fn(c, a) * rc.fn(c, a), out_t)
         raise PlanningError(f"unsupported arithmetic {op}")

@@ -150,9 +150,16 @@ class RenameExec(ExecutionPlan):
 
 
 class FilterExec(ExecutionPlan):
-    def __init__(self, input: ExecutionPlan, predicate: E.Expr):
+    """``host_mode`` evaluates the predicate in numpy float64 — used when
+    the predicate contains float arithmetic (e.g. decorrelated scalar
+    comparisons like ``l_quantity < 0.2 * avg``), which the device compiler
+    refuses to keep the XLA programs f64-free."""
+
+    def __init__(self, input: ExecutionPlan, predicate: E.Expr,
+                 host_mode: bool = False):
         self.input = input
         self.predicate = predicate
+        self.host_mode = host_mode
         self._schema = input.schema
         self._compiled = None
 
@@ -167,22 +174,35 @@ class FilterExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         if self._compiled is None:
-            comp = ExprCompiler(self.input.schema, "device")
+            comp = ExprCompiler(self.input.schema,
+                                "host" if self.host_mode else "device")
             pred = comp.compile(_substitute_scalars(self.predicate, ctx.scalars))
             if pred.dtype != BOOL:
                 raise InternalError("filter predicate must be boolean")
-            jfn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
-            self._compiled = (comp, jfn)
-        comp, jfn = self._compiled
+            if self.host_mode:
+                jfn = None
+            else:
+                jfn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
+            self._compiled = (comp, pred, jfn)
+        comp, pred, jfn = self._compiled
         out = []
         for b in self.input.execute(partition, ctx):
             with self.metrics().timer("compute_time"):
                 aux = comp.aux_arrays(b.dicts)
-                out.append(ColumnBatch(b.schema, b.columns, jfn(b.columns, b.mask, aux), b.dicts))
+                if self.host_mode:
+                    cols_np = {k: np.asarray(v) for k, v in b.columns.items()}
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        keep = np.broadcast_to(
+                            np.asarray(pred.fn(cols_np, aux)), (b.capacity,))
+                    mask = jnp.asarray(np.asarray(b.mask) & keep)
+                else:
+                    mask = jfn(b.columns, b.mask, aux)
+                out.append(ColumnBatch(b.schema, b.columns, mask, b.dicts))
         return out
 
     def _label(self):
-        return f"FilterExec: {self.predicate}"
+        mode = " (host)" if self.host_mode else ""
+        return f"FilterExec{mode}: {self.predicate}"
 
 
 # --------------------------------------------------------------------------
@@ -265,16 +285,36 @@ class HashAggregateExec(ExecutionPlan):
                     operand = a.operand if a.operand is not None else None
                     how = a.func
                 cc = comp.compile(_substitute_scalars(operand, ctx.scalars)) if operand is not None else None
-                agg_c.append((cc, how, a.name))
+                # SQL NULL semantics: aggregates skip NULL inputs.  Nullable
+                # operands (outer-join columns) carry the in-band sentinel.
+                sent = None
+                if (self.mode != "final" and isinstance(operand, E.Column)
+                        and operand.name in in_schema
+                        and in_schema.field(operand.name).nullable):
+                    sent = in_schema.field(operand.name).dtype.null_sentinel
+                agg_c.append((cc, how, a.name, sent))
 
             def agg_fn(cols, mask, aux, out_cap):
                 keys = [c.fn(cols, aux) for c, _ in group_c]
                 vals = []
-                for cc, how, _ in agg_c:
+                for cc, how, _, sent in agg_c:
                     if cc is None:  # count(*)
                         vals.append((jnp.zeros(mask.shape, jnp.int64), K.AGG_COUNT))
-                    else:
-                        vals.append((cc.fn(cols, aux), how))
+                        continue
+                    v = cc.fn(cols, aux)
+                    if sent is not None:
+                        valid = jnp.isnan(v) == False if isinstance(sent, float) and sent != sent \
+                            else v != sent  # noqa: E712 — jnp elementwise
+                        if how == "count":
+                            vals.append((valid.astype(jnp.int64), K.AGG_SUM))
+                            continue
+                        if how == "sum":
+                            v = jnp.where(valid, v, jnp.zeros((), v.dtype))
+                        elif how == "min":
+                            v = jnp.where(valid, v, K._max_ident(v.dtype))
+                        elif how == "max":
+                            v = jnp.where(valid, v, K._min_ident(v.dtype))
+                    vals.append((v, how))
                 return K.grouped_aggregate(keys, vals, mask, out_cap)
 
             self._compiled = (comp, group_c, agg_c, jax.jit(agg_fn, static_argnums=(3,)))
@@ -295,7 +335,7 @@ class HashAggregateExec(ExecutionPlan):
             cols[name] = arr
             if cc.dict_fn is not None:
                 dicts[name] = cc.dict_fn(big.dicts)
-        for (cc, how, name), arr in zip(agg_c, out_vals):
+        for (cc, how, name, _), arr in zip(agg_c, out_vals):
             cols[name] = arr
 
         result = ColumnBatch(self._schema, cols, out_mask, dicts)
@@ -347,6 +387,10 @@ class JoinExec(ExecutionPlan):
         self.dist = dist
         if join_type in ("semi", "anti"):
             self._schema = left.schema
+        elif join_type == "left":
+            self._schema = Schema(
+                list(left.schema)
+                + [Field(f.name, f.dtype, nullable=True) for f in right.schema])
         else:
             self._schema = left.schema.merge(right.schema)
         self._compiled = None
@@ -387,7 +431,7 @@ class JoinExec(ExecutionPlan):
             jt = self.join_type
             lnames = [f.name for f in lsch]
             rnames = [f.name for f in rsch]
-            rnull_str = {f.name for f in rsch if f.dtype.is_string}
+            rfill = {f.name: f.dtype.null_sentinel for f in rsch}
 
             def join_fn(pcols, pmask, bcols, bmask, laux, raux, faux, out_cap):
                 pk = [c.fn(pcols, laux) for c in lkeys]
@@ -421,14 +465,14 @@ class JoinExec(ExecutionPlan):
                 if jt == "left":
                     hit = K.segment_any(ok, pi, pmask.shape[0])
                     miss = pmask & ~hit
-                    # append unmatched probe rows; build side filled with NULLs
-                    # (string columns use the -1 null code, numerics zero)
+                    # append unmatched probe rows; build side filled with the
+                    # per-dtype NULL sentinel (schema marks those nullable)
                     out_cols = {
                         n: jnp.concatenate([
                             out_cols[n],
                             pcols[n] if n in lnames else jnp.full(
                                 pmask.shape[0],
-                                -1 if n in rnull_str else 0,
+                                rfill[n],
                                 out_cols[n].dtype,
                             ),
                         ])
@@ -449,11 +493,17 @@ class JoinExec(ExecutionPlan):
             out_cols, out_mask, total = jfn(
                 probe.columns, probe.mask, build.columns, build.mask, laux, raux, faux, out_cap
             )
-        if int(total) > out_cap:
-            raise CapacityError(
-                f"join produced {int(total)} candidate pairs > capacity {out_cap}; "
-                f"raise {JOIN_OUTPUT_FACTOR}"
-            )
+            # bucketed recompilation: the first pass reports the true pair
+            # count, so one retry at the next power-of-two capacity always
+            # fits.  Static shapes stay static per bucket — the XLA-friendly
+            # answer to data-dependent join fan-out (SURVEY.md §7 hard parts).
+            if int(total) > out_cap:
+                need = 1 << (int(total) - 1).bit_length()
+                self.metrics().add("capacity_recompiles", 1)
+                out_cols, out_mask, total = jfn(
+                    probe.columns, probe.mask, build.columns, build.mask,
+                    laux, raux, faux, need
+                )
 
         dicts = dict(probe.dicts)
         if self.join_type in ("inner", "left"):

@@ -29,6 +29,17 @@ from ..utils.config import BROADCAST_THRESHOLD, BallistaConfig
 from ..utils.errors import PlanningError
 
 
+def _has_float_subexpr(e: E.Expr, schema) -> bool:
+    """True if any subexpression is float-typed: such expressions must run
+    host-side to keep device programs f64-free (the decimal discipline)."""
+    try:
+        if e.dtype(schema).kind in ("float32", "float64"):
+            return True
+    except Exception:  # noqa: BLE001 — untypable nodes (subquery carriers)
+        pass
+    return any(_has_float_subexpr(c, schema) for c in e.children())
+
+
 @dataclasses.dataclass
 class PlannedQuery:
     plan: ExecutionPlan
@@ -67,7 +78,9 @@ class PhysicalPlanner:
 
         if isinstance(node, L.Filter):
             child = self.create(node.input)
-            return O.FilterExec(child, self._prep_expr(node.predicate))
+            pred = self._prep_expr(node.predicate)
+            return O.FilterExec(child, pred,
+                                host_mode=_has_float_subexpr(pred, child.schema))
 
         if isinstance(node, L.Aggregate):
             return self._plan_aggregate(node)
@@ -124,6 +137,7 @@ class PhysicalPlanner:
         return RepartitionExec(plan, Partitioning.single())
 
     def _plan_aggregate(self, node: L.Aggregate) -> ExecutionPlan:
+        node = self._rewrite_distinct_aggs(node)
         child = self.create(node.input)
         groups = [(self._prep_expr(e), n) for e, n in node.group_exprs]
         specs = []
@@ -147,6 +161,25 @@ class PhysicalPlanner:
             exchange = RepartitionExec(partial, Partitioning.single())
         final_groups = [(E.Column(n), n) for _, n in groups]
         return O.HashAggregateExec(exchange, final_groups, specs, mode="final")
+
+    def _rewrite_distinct_aggs(self, node: L.Aggregate) -> L.Aggregate:
+        """agg(distinct x) -> dedup-by-(groups, x) aggregate feeding a plain
+        aggregate (the classic two-level rewrite; DataFusion does the same
+        for the reference via single_distinct_to_groupby)."""
+        distincts = [(a, n) for a, n in node.agg_exprs if a.distinct]
+        if not distincts:
+            return node
+        if len(distincts) != len(node.agg_exprs):
+            raise PlanningError("mixing DISTINCT and plain aggregates is not supported")
+        operands = {str(a.operand) for a, _ in distincts}
+        if len(operands) != 1 or distincts[0][0].operand is None:
+            raise PlanningError("DISTINCT aggregates must share one operand")
+        dkey = "__distinct_key"
+        inner_groups = list(node.group_exprs) + [(distincts[0][0].operand, dkey)]
+        inner = L.Aggregate(node.input, inner_groups, [])
+        outer_groups = [(E.Column(n), n) for _, n in node.group_exprs]
+        outer_aggs = [(E.Agg(a.func, E.Column(dkey)), n) for a, n in distincts]
+        return L.Aggregate(inner, outer_groups, outer_aggs)
 
     def _plan_join(self, node: L.Join) -> ExecutionPlan:
         left = self.create(node.left)

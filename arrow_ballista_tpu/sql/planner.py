@@ -346,7 +346,7 @@ class SqlToRel:
         reference gets this from DataFusion's planner; TPC-H queries list
         relations in a joinable order)."""
         where = self.resolve_expr(sel.where, scope) if sel.where is not None else None
-        conjs = E.conjuncts(where)
+        conjs = E.factored_conjuncts(where)
 
         single_rel_filters: Dict[str, List[E.Expr]] = {}
         join_edges: List[Tuple[str, str, E.Expr, E.Expr]] = []  # (relA, relB, exprA, exprB)
@@ -484,10 +484,10 @@ class SqlToRel:
             return L.Join(plan, pred.subplan, pred.on_pairs, jt, pred.residual)
         if isinstance(pred, _ScalarCmpPred):
             # correlated scalar aggregate: join decorrelated agg subplan, then
-            # plain comparison against the agg output column.
+            # plain comparison against the value expression over its outputs.
             joined = L.Join(plan, pred.subplan, pred.on_pairs, "inner")
-            cmp = E.BinOp(pred.op, pred.operand, E.Column(pred.agg_col)) if pred.operand_is_left else \
-                E.BinOp(pred.op, E.Column(pred.agg_col), pred.operand)
+            cmp = E.BinOp(pred.op, pred.operand, pred.value_expr) if pred.operand_is_left else \
+                E.BinOp(pred.op, pred.value_expr, pred.operand)
             return L.Filter(joined, cmp)
         raise PlanningError(f"unsupported subquery predicate {pred}")
 
@@ -776,9 +776,16 @@ class SqlToRel:
             relations.extend(self._plan_relation(rel_ast, scope))
         inner_scope = Scope(self._flat(relations), scope)
         item = self.resolve_expr(sub.items[0].expr, inner_scope)
+
+        def rewrite_avg(e: E.Expr) -> E.Expr:
+            if isinstance(e, E.Agg) and e.func == "avg":
+                return E.BinOp("/", E.Agg("sum", e.operand), E.Agg("count", e.operand))
+            return _map_children(e, rewrite_avg)
+
+        item = rewrite_avg(item)
         aggs = E.find_aggs(item)
-        if len(aggs) != 1 or _outer_refs(item):
-            raise PlanningError("correlated scalar subquery must be a single aggregate")
+        if not aggs or _outer_refs(item):
+            raise PlanningError("correlated scalar subquery must aggregate")
 
         conjs = E.conjuncts(self.resolve_expr(sub.where, inner_scope)) if sub.where is not None else []
         inner_conjs, corr_pairs = [], []
@@ -794,15 +801,29 @@ class SqlToRel:
             raise PlanningError("correlated scalar subquery needs equality correlation")
 
         inner_plan = self._combine_cross_with_edges(relations, inner_conjs)
-        # group the subplan by the inner correlation keys, compute the agg
+        # group the subplan by the inner correlation keys, compute every
+        # distinct aggregate in the item, then rebuild the item expression
+        # over the aggregate outputs (covers e.g. 0.2 * avg(x) in q17,
+        # 0.5 * sum(x) in q20, and decomposed avg = sum/count)
         group_named = [(inner_e, self._fresh("ck")) for _, inner_e in corr_pairs]
-        agg = aggs[0]
-        agg_name = self._fresh("sq")
-        agg_plan = L.Aggregate(inner_plan, group_named, [(agg, agg_name)])
-        if _expr_key(item) != _expr_key(agg):
-            raise PlanningError("correlated scalar subquery must be exactly one aggregate call")
+        agg_named: Dict[str, str] = {}
+        agg_specs: List[Tuple[E.Expr, str]] = []
+        for a in aggs:
+            k = _expr_key(a)
+            if k not in agg_named:
+                name = self._fresh("sq")
+                agg_named[k] = name
+                agg_specs.append((a, name))
+
+        def subst(e: E.Expr) -> E.Expr:
+            if isinstance(e, E.Agg):
+                return E.Column(agg_named[_expr_key(e)])
+            return _map_children(e, subst)
+
+        value_expr = subst(item)
+        agg_plan = L.Aggregate(inner_plan, group_named, agg_specs)
         on_pairs = [(outer_e, E.Column(name)) for (outer_e, _), (_, name) in zip(corr_pairs, group_named)]
-        return _ScalarCmpPred(op, operand, agg_plan, on_pairs, agg_name, operand_is_left)
+        return _ScalarCmpPred(op, operand, agg_plan, on_pairs, value_expr, operand_is_left)
 
 
 class _CompositeRelation(Relation):
@@ -844,7 +865,7 @@ class _ScalarCmpPred(E.Expr):
     operand: E.Expr
     subplan: L.LogicalPlan
     on_pairs: List[Tuple[E.Expr, E.Expr]]
-    agg_col: str
+    value_expr: "E.Expr"  # expression over subplan's aggregate outputs
     operand_is_left: bool
 
     def dtype(self, schema):
