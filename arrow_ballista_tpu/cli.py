@@ -1,0 +1,155 @@
+"""Interactive SQL shell: ``python -m arrow_ballista_tpu.cli``.
+
+Parity: ballista-cli (reference ballista-cli/src/main.rs + command.rs) —
+remote or standalone connection, psql-style backslash commands, ``--file``
+batch mode, timing output.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def split_sql(text: str):
+    """Split on ';' outside single-quoted strings ('' escapes a quote)."""
+    stmts, cur, in_str = [], [], False
+    i = 0
+    while i < len(text):
+        ch = text[i]
+        if in_str:
+            cur.append(ch)
+            if ch == "'":
+                if i + 1 < len(text) and text[i + 1] == "'":
+                    cur.append("'")
+                    i += 1
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+            cur.append(ch)
+        elif ch == ";":
+            stmts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    stmts.append("".join(cur))
+    return [s.strip() for s in stmts if s.strip()], in_str
+
+
+HELP = """\
+\\d            list tables
+\\d NAME       describe table
+\\q            quit
+\\h            this help
+\\timing       toggle timing output
+anything else is executed as SQL.
+"""
+
+
+def run_command(ctx, line: str, timing: bool) -> bool:
+    """Returns the (possibly toggled) timing flag; raises SystemExit on \\q."""
+    cmd = line.strip()
+    if cmd in ("\\q", "quit", "exit"):
+        raise SystemExit(0)
+    if cmd == "\\h":
+        print(HELP, end="")
+        return timing
+    if cmd == "\\timing":
+        timing = not timing
+        print(f"timing {'on' if timing else 'off'}")
+        return timing
+    if cmd == "\\d":
+        if ctx._remote is not None:
+            names = ctx._remote.list_tables()
+        else:
+            names = ctx.catalog.table_names()
+        for n in sorted(names):
+            print(n)
+        return timing
+    if cmd.startswith("\\d "):
+        name = cmd[3:].strip()
+        df = ctx.sql(f"show columns from {name}")
+        print(df.to_pandas().to_string(index=False))
+        return timing
+    t0 = time.perf_counter()
+    df = ctx.sql(cmd)
+    out = df.to_pandas()
+    dt = time.perf_counter() - t0
+    if len(out):
+        print(out.to_string(index=False))
+    print(f"{len(out)} row(s) in set.", end="")
+    print(f" Query took {dt:.3f} seconds." if timing else "")
+    return timing
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="arrow_ballista_tpu SQL shell")
+    ap.add_argument("--host", default=None, help="remote scheduler host")
+    ap.add_argument("--port", type=int, default=50050)
+    ap.add_argument("--concurrent-tasks", type=int, default=4,
+                    help="standalone mode task slots")
+    ap.add_argument("--file", default=None, help="run SQL from file and exit")
+    ap.add_argument("-c", "--command", default=None, help="run one SQL command")
+    args = ap.parse_args(argv)
+
+    from .client.context import BallistaContext
+
+    if args.host:
+        ctx = BallistaContext.remote(args.host, args.port)
+        print(f"connected to scheduler {args.host}:{args.port}")
+    else:
+        ctx = BallistaContext.standalone(concurrent_tasks=args.concurrent_tasks)
+        print("standalone mode (in-process scheduler + executor)")
+
+    timing = True
+    if args.command or args.file:
+        text = args.command or open(args.file).read()
+        stmts, _ = split_sql(text)
+        for stmt in stmts:
+            timing = run_command(ctx, stmt, timing)
+        return
+
+    buffer = ""
+    while True:
+        try:
+            prompt = "ballista> " if not buffer else "      -> "
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            break
+        if line.strip().startswith("\\") and not buffer:
+            try:
+                timing = run_command(ctx, line, timing)
+            except SystemExit:
+                break
+            except Exception as e:  # noqa: BLE001
+                print(f"error: {e}")
+            continue
+        buffer += line + "\n"
+        if not _ends_stmt(buffer):
+            continue
+        stmts, _ = split_sql(buffer)
+        buffer = ""
+        for stmt in stmts:
+            try:
+                timing = run_command(ctx, stmt, timing)
+            except SystemExit:
+                return
+            except Exception as e:  # noqa: BLE001
+                print(f"error: {e}")
+
+
+def _ends_stmt(buffer: str) -> bool:
+    """A buffer is complete when its last non-space char (outside strings)
+    is ';'."""
+    stripped = buffer.rstrip()
+    if not stripped.endswith(";"):
+        return False
+    _, open_quote = split_sql(stripped)
+    return not open_quote
+
+
+if __name__ == "__main__":
+    main()

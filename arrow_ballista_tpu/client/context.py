@@ -71,6 +71,35 @@ class BallistaDataFrame:
         return out
 
 
+class RemoteDataFrame:
+    """Lazy remote query (collect polls the scheduler, then fetches the
+    final-stage partitions from executors)."""
+
+    def __init__(self, ctx: "BallistaContext", sql: Optional[str], static=None):
+        self.ctx = ctx
+        self._sql = sql
+        self._static = static  # pre-computed frame (SHOW …)
+
+    def collect(self) -> List[ColumnBatch]:
+        if self._sql is None:
+            return []  # DDL / SHOW
+        return self.ctx._remote.execute_sql(self._sql)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        if self._static is not None:
+            return self._static
+        frames = [b.to_pandas() for b in self.collect()]
+        return pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        tables = [b.to_arrow() for b in self.collect() if b.num_rows > 0]
+        return pa.concat_tables(tables) if tables else pa.table({})
+
+
 class BallistaContext:
     def __init__(self, config: Optional[BallistaConfig] = None, engine: str = "local",
                  work_dir: Optional[str] = None):
@@ -79,6 +108,7 @@ class BallistaContext:
         self.catalog = SchemaCatalog()
         self.work_dir = work_dir or os.path.join(tempfile.gettempdir(), "ballista_tpu")
         self._standalone = None
+        self._remote = None
 
     # --- constructors (parity: context.rs:80-212) -----------------------
     @staticmethod
@@ -100,31 +130,51 @@ class BallistaContext:
         if self._standalone is not None:
             self._standalone.shutdown()
             self._standalone = None
+        self._remote = None
 
     @staticmethod
     def remote(host: str, port: int, config: Optional[BallistaConfig] = None) -> "BallistaContext":
+        """Connect to a scheduler daemon (parity: BallistaContext::remote,
+        reference client context.rs:80-140).  SQL text ships to the
+        scheduler; results stream back from executor data planes."""
         ctx = BallistaContext(config, engine="remote")
         from .remote import RemoteCluster
 
-        ctx._standalone = RemoteCluster(host, port, ctx.config)
+        ctx._remote = RemoteCluster(host, port, ctx.config)
         return ctx
 
     # --- registration ---------------------------------------------------
     def register_table(self, name: str, table) -> None:
+        if self._remote is not None:
+            import pyarrow as pa
+
+            if not isinstance(table, pa.Table):
+                table = pa.Table.from_pandas(table)
+            self._remote.register_table(name, table)
+            return
         self.catalog.register(MemoryTable(name, table))
 
     def register_parquet(self, name: str, path, schema: Optional[Schema] = None) -> None:
+        if self._remote is not None:
+            self._remote.register_external_table(name, "parquet", path, schema)
+            return
         self.catalog.register(ParquetTable(name, path, schema))
 
     def register_csv(self, name: str, path, schema: Optional[Schema] = None,
                      delimiter: str = ",", has_header: bool = True) -> None:
+        if self._remote is not None:
+            self._remote.register_external_table(name, "csv", path, schema,
+                                                 delimiter, has_header)
+            return
         self.catalog.register(CsvTable(name, path, schema, delimiter, has_header))
 
     def deregister_table(self, name: str) -> None:
         self.catalog.deregister(name)
 
     # --- SQL ------------------------------------------------------------
-    def sql(self, sql: str) -> BallistaDataFrame:
+    def sql(self, sql: str) -> "BallistaDataFrame":
+        if self._remote is not None:
+            return self._remote_sql(sql)
         stmt = parse_sql(sql)
         if isinstance(stmt, ast.CreateExternalTable):
             return self._create_external_table(stmt)
@@ -148,6 +198,29 @@ class BallistaContext:
             return self.sql(f"select column_name, data_type from {name}")
         logical = SqlToRel(self.catalog).plan(stmt)
         return BallistaDataFrame(self, logical)
+
+    def _remote_sql(self, sql: str) -> "RemoteDataFrame":
+        # DDL and SHOW are handled via scheduler RPCs; SELECT ships verbatim
+        import pandas as pd
+
+        stmt = parse_sql(sql)
+        if isinstance(stmt, ast.CreateExternalTable):
+            schema = None
+            if stmt.columns:
+                schema = Schema(Field(n, parse_type_name(t)) for n, t in stmt.columns)
+            self._remote.register_external_table(
+                stmt.name, stmt.file_format, stmt.location, schema,
+                delimiter=stmt.delimiter, has_header=stmt.has_header)
+            return RemoteDataFrame(self, None)
+        if isinstance(stmt, ast.ShowTables):
+            return RemoteDataFrame(self, None, static=pd.DataFrame(
+                {"table_name": sorted(self._remote.list_tables())}))
+        if isinstance(stmt, ast.ShowColumns):
+            schema = self._remote.table_schema(stmt.table)
+            return RemoteDataFrame(self, None, static=pd.DataFrame({
+                "column_name": [f.name for f in schema],
+                "data_type": [str(f.dtype) for f in schema]}))
+        return RemoteDataFrame(self, sql)
 
     def _create_external_table(self, stmt: ast.CreateExternalTable) -> BallistaDataFrame:
         schema = None

@@ -1,0 +1,89 @@
+"""Remote cluster client: the DistributedQueryExec role.
+
+Parity: reference core/src/execution_plans/distributed_query.rs — submit
+the query to the scheduler, poll GetJobStatus every 100 ms (:262), then
+open data-plane streams to the executors holding the final-stage
+partitions (:305-329, via BallistaClient::fetch_partition).
+"""
+from __future__ import annotations
+
+import io
+import time
+from typing import Dict, List, Optional
+
+from .. import serde
+from ..models.batch import ColumnBatch
+from ..net import wire
+from ..utils.config import BallistaConfig
+from ..utils.errors import ExecutionError
+
+POLL_INTERVAL_S = 0.1  # reference: 100 ms
+
+
+class RemoteCluster:
+    def __init__(self, host: str, port: int, config: Optional[BallistaConfig] = None):
+        self.host, self.port = host, port
+        self.config = config or BallistaConfig()
+
+    def _call(self, method: str, payload: dict = None, binary: bytes = b""):
+        return wire.call(self.host, self.port, method, payload or {}, binary)
+
+    # --- catalog ---------------------------------------------------------
+    def register_table(self, name: str, table) -> None:
+        import pyarrow.ipc as ipc
+
+        buf = io.BytesIO()
+        with ipc.new_stream(buf, table.schema) as w:
+            w.write_table(table)
+        self._call("register_table", {"name": name}, buf.getvalue())
+
+    def register_external_table(self, name: str, fmt: str, path: str,
+                                schema=None, delimiter: str = ",",
+                                has_header: bool = True) -> None:
+        self._call("register_external_table", {
+            "name": name, "format": fmt, "path": path,
+            "schema": serde.schema_to_obj(schema) if schema is not None else None,
+            "delimiter": delimiter, "has_header": has_header})
+
+    def list_tables(self) -> List[str]:
+        payload, _ = self._call("list_tables")
+        return payload["tables"]
+
+    def table_schema(self, name: str):
+        payload, _ = self._call("table_schema", {"name": name})
+        return serde.schema_from_obj(payload["schema"])
+
+    # --- query execution -------------------------------------------------
+    def execute_sql(self, sql: str, timeout: float = 600.0) -> List[ColumnBatch]:
+        payload, _ = self._call("execute_query",
+                                {"sql": sql, "config": dict(self.config._settings)})
+        job_id = payload["job_id"]
+        deadline = time.monotonic() + timeout
+        while True:
+            status, _ = self._call("get_job_status", {"job_id": job_id})
+            state = status["state"]
+            if state == "successful":
+                break
+            if state in ("failed", "cancelled", "not_found"):
+                raise ExecutionError(
+                    f"job {job_id} {state}: {status.get('error', '')}")
+            if time.monotonic() > deadline:
+                self._call("cancel_job", {"job_id": job_id})
+                raise ExecutionError(f"job {job_id} timed out after {timeout}s")
+            time.sleep(POLL_INTERVAL_S)
+
+        schema = serde.schema_from_obj(status["schema"])
+        batches: List[ColumnBatch] = []
+        for part in sorted(status["locations"], key=int):
+            for obj in status["locations"][part]:
+                loc = serde.location_from_obj(obj)
+                if not loc.num_rows:
+                    continue
+                batches.extend(self._fetch(loc, schema))
+        return batches
+
+    def _fetch(self, loc, schema) -> List[ColumnBatch]:
+        from ..net.dataplane import fetch_partition_batches
+
+        return fetch_partition_batches(loc.host, loc.port, loc.path, schema,
+                                       self.config.batch_size)

@@ -1,0 +1,178 @@
+"""Executor network service: task intake + shuffle data plane.
+
+Parity: reference ballista/executor/src/executor_server.rs (push-mode gRPC:
+launch_multi_task / cancel_tasks / remove_job_data / stop_executor, status
+batching back to the scheduler, 60 s heartbeats) + flight_service.rs
+(do_get FetchPartition with IPC streaming).  Both services share one RPC
+port here; the path-traversal guard mirrors is_subdirectory
+(executor_server.rs:839-876).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import List, Optional
+
+from .. import serde
+from ..net.rpc import RpcServer
+from ..net import wire
+from ..scheduler.types import ExecutorHeartbeat, ExecutorMetadata, TaskStatus
+from ..utils.config import BallistaConfig
+from ..utils.errors import ExecutionError
+from .executor import Executor
+
+log = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 60.0
+
+
+class SchedulerClient:
+    """Executor -> scheduler control-plane client."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+
+    def register_executor(self, meta: ExecutorMetadata) -> None:
+        wire.call(self.host, self.port, "register_executor", {"meta": vars(meta)})
+
+    def heartbeat(self, executor_id: str, status: str = "active") -> None:
+        wire.call(self.host, self.port, "heartbeat",
+                  {"executor_id": executor_id, "status": status})
+
+    def update_task_status(self, executor_id: str,
+                           statuses: List[TaskStatus]) -> None:
+        wire.call(self.host, self.port, "update_task_status",
+                  {"executor_id": executor_id,
+                   "statuses": [serde.status_to_obj(s) for s in statuses]})
+
+    def executor_stopped(self, executor_id: str, reason: str = "") -> None:
+        wire.call(self.host, self.port, "executor_stopped",
+                  {"executor_id": executor_id, "reason": reason})
+
+
+class ExecutorServer:
+    def __init__(self, scheduler_host: str, scheduler_port: int,
+                 host: str = "127.0.0.1", port: int = 0,
+                 work_dir: Optional[str] = None, concurrent_tasks: int = 4,
+                 executor_id: Optional[str] = None,
+                 config: Optional[BallistaConfig] = None,
+                 external_host: Optional[str] = None):
+        import socket as socketmod
+        import tempfile
+        import uuid
+
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-exec-")
+        executor_id = executor_id or f"exec-{uuid.uuid4().hex[:8]}"
+        self.rpc = RpcServer(host, port)
+        # advertised address: what peers dial for shuffle fetch (reference
+        # executor's external_host flag).  Binding 0.0.0.0 is not routable,
+        # so fall back to the machine hostname there.
+        if external_host is None:
+            external_host = host if host not in ("0.0.0.0", "::") \
+                else socketmod.gethostname()
+        # data plane: prefer the native (C++) server — shuffle bytes then
+        # move kernel->socket via sendfile with no GIL involvement
+        # (reference analog: the Flight service next to the gRPC port).
+        # One native server per process; extra in-proc executors fall back
+        # to the Python RPC handler.
+        self._native_dp = None
+        data_port = self.rpc.port
+        from .. import native as native_mod
+
+        lib = native_mod.dataplane()
+        if lib is not None:
+            p = lib.dp_start(self.work_dir.encode(), 0)
+            if p > 0:
+                self._native_dp = lib
+                data_port = p
+                log.info("native data plane on port %d", p)
+        self.metadata = ExecutorMetadata(
+            executor_id=executor_id, host=external_host, port=data_port,
+            grpc_port=self.rpc.port, task_slots=concurrent_tasks)
+        self.executor = Executor(self.metadata, self.work_dir, config,
+                                 concurrent_tasks=concurrent_tasks)
+        self.scheduler = SchedulerClient(scheduler_host, scheduler_port)
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+        self.rpc.register("launch_multi_task", self._launch_multi_task)
+        self.rpc.register("cancel_tasks", self._cancel_tasks)
+        self.rpc.register("fetch_partition", self._fetch_partition)
+        self.rpc.register("remove_job_data", self._remove_job_data)
+        self.rpc.register("stop_executor", self._stop_executor)
+        self.rpc.register("ping", lambda p, b: ({"executor_id": executor_id}, b""))
+
+    # --- lifecycle -------------------------------------------------------
+    def start(self, register: bool = True) -> None:
+        self.rpc.start()
+        if register:
+            self.scheduler.register_executor(self.metadata)
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           name="executor-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def stop(self, notify: bool = True) -> None:
+        self._stop.set()
+        if notify:
+            try:
+                self.scheduler.executor_stopped(self.metadata.executor_id, "shutdown")
+            except Exception:  # noqa: BLE001 — scheduler may be gone
+                pass
+        self.executor.shutdown()
+        self.rpc.stop()
+        if self._native_dp is not None:
+            self._native_dp.dp_stop()
+            self._native_dp = None
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(HEARTBEAT_INTERVAL_S):
+            try:
+                self.scheduler.heartbeat(self.metadata.executor_id)
+            except Exception:  # noqa: BLE001 — retried next interval
+                log.warning("heartbeat to scheduler failed", exc_info=True)
+
+    # --- RPC handlers ----------------------------------------------------
+    def _launch_multi_task(self, payload: dict, _bin: bytes):
+        tasks = [serde.task_from_obj(t) for t in payload["tasks"]]
+        for task in tasks:
+            self.executor.submit_task(task, self._report_status)
+        return {"accepted": len(tasks)}, b""
+
+    def _report_status(self, status: TaskStatus) -> None:
+        try:
+            self.scheduler.update_task_status(self.metadata.executor_id, [status])
+        except Exception:  # noqa: BLE001
+            log.exception("status report to scheduler failed")
+
+    def _cancel_tasks(self, payload: dict, _bin: bytes):
+        self.executor.cancel_job_tasks(payload["job_id"])
+        return {}, b""
+
+    def _is_under_work_dir(self, path: str) -> bool:
+        base = os.path.realpath(self.work_dir)
+        target = os.path.realpath(path)
+        return os.path.commonpath([base, target]) == base
+
+    def _fetch_partition(self, payload: dict, _bin: bytes):
+        path = payload["path"]
+        if not self._is_under_work_dir(path):
+            raise ExecutionError(f"path {path!r} escapes the work dir")
+        if not os.path.exists(path):
+            raise ExecutionError(f"no such shuffle file: {path}")
+        with open(path, "rb") as f:
+            data = f.read()
+        return {"num_bytes": len(data)}, data
+
+    def _remove_job_data(self, payload: dict, _bin: bytes):
+        job_dir = os.path.join(self.work_dir, payload["job_id"])
+        if self._is_under_work_dir(job_dir) and os.path.isdir(job_dir):
+            shutil.rmtree(job_dir, ignore_errors=True)
+        return {}, b""
+
+    def _stop_executor(self, payload: dict, _bin: bytes):
+        threading.Thread(target=self.stop, kwargs={"notify": False},
+                         daemon=True).start()
+        return {}, b""

@@ -1,0 +1,67 @@
+"""Executor daemon: ``python -m arrow_ballista_tpu.executor_daemon``.
+
+Parity: the ballista-executor binary (reference ballista/executor/src/
+bin/main.rs + executor_process.rs — work_dir setup, scheduler connect with
+retry, graceful SIGTERM shutdown draining in-flight tasks).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import time
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="arrow_ballista_tpu executor")
+    ap.add_argument("--scheduler-host", default="127.0.0.1")
+    ap.add_argument("--scheduler-port", type=int, default=50050)
+    ap.add_argument("--bind-host", default="127.0.0.1")
+    ap.add_argument("--bind-port", type=int, default=0)
+    ap.add_argument("--external-host", default=None,
+                    help="address advertised to peers for shuffle fetch "
+                         "(defaults to bind host, or hostname when 0.0.0.0)")
+    ap.add_argument("--work-dir", default=None)
+    ap.add_argument("--concurrent-tasks", type=int, default=4)
+    ap.add_argument("--connect-timeout-s", type=float, default=30.0)
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from .executor.server import ExecutorServer
+    from .net import wire
+
+    # connect-with-retry (reference executor_process.rs:194-232)
+    deadline = time.monotonic() + args.connect_timeout_s
+    while True:
+        try:
+            wire.call(args.scheduler_host, args.scheduler_port, "ping", timeout=3.0)
+            break
+        except Exception as e:  # noqa: BLE001
+            if time.monotonic() > deadline:
+                raise SystemExit(f"cannot reach scheduler: {e}")
+            time.sleep(0.5)
+
+    server = ExecutorServer(
+        args.scheduler_host, args.scheduler_port, args.bind_host,
+        args.bind_port, args.work_dir, args.concurrent_tasks,
+        external_host=args.external_host)
+    server.start()
+    logging.info("executor %s on %s:%s (work_dir %s)",
+                 server.metadata.executor_id, server.rpc.host, server.rpc.port,
+                 server.work_dir)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.5)
+    logging.info("executor draining %d tasks", server.executor.active_tasks())
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Shared data-plane fetch: partition bytes -> device batches.
+
+One implementation for both consumers (reference parity: BallistaClient::
+fetch_partition, core/src/client.rs:112-187, used by shuffle reads and
+result collection alike) — 3 retries with linear backoff (client.rs:57-58).
+"""
+from __future__ import annotations
+
+import io
+import time
+from typing import List
+
+from ..models.batch import ColumnBatch
+from ..models.schema import Schema
+from . import wire
+
+FETCH_RETRIES = 3
+RETRY_BACKOFF_S = 3.0
+
+
+def fetch_partition_batches(host: str, port: int, path: str, schema: Schema,
+                            capacity: int,
+                            retries: int = FETCH_RETRIES,
+                            backoff_s: float = RETRY_BACKOFF_S) -> List[ColumnBatch]:
+    """Fetch one shuffle/result file from an executor data plane and decode
+    it into device batches.  Raises the last error after ``retries``."""
+    import pyarrow.ipc as ipc
+
+    from ..models.ipc import physical_table_to_batches
+
+    err: Exception = RuntimeError("unreachable")
+    for attempt in range(retries):
+        try:
+            _, data = wire.call(host, port, "fetch_partition", {"path": path})
+            table = ipc.open_file(io.BytesIO(data)).read_all()
+            return physical_table_to_batches(table, schema, capacity=capacity)
+        except Exception as e:  # noqa: BLE001 — caller maps to its taxonomy
+            err = e
+            if attempt + 1 < retries:
+                time.sleep(backoff_s * (attempt + 1))
+    raise err

@@ -1,0 +1,73 @@
+"""Threaded RPC server: dispatches wire frames to registered handlers.
+
+The reference runs tonic gRPC services (SchedulerGrpc/ExecutorGrpc,
+reference ballista/core/proto/ballista.proto:665-701); this is the same
+shape with one thread per connection (handlers are short — long work is
+delegated to the scheduler event loop / executor task pool).
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import threading
+from typing import Callable, Dict, Tuple
+
+from ..utils.errors import BallistaError
+from .wire import recv_frame, send_frame
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[dict, bytes], Tuple[dict, bytes]]
+
+
+class RpcServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.handlers: Dict[str, Handler] = {}
+        outer = self
+
+        class _Conn(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        req, binary = recv_frame(sock)
+                        outer._dispatch(sock, req, binary)
+                except (ConnectionError, OSError):
+                    return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Conn)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"rpc-{self.port}", daemon=True)
+
+    def register(self, method: str, fn: Handler) -> None:
+        self.handlers[method] = fn
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _dispatch(self, sock, req: dict, binary: bytes) -> None:
+        method = req.get("method", "")
+        fn = self.handlers.get(method)
+        if fn is None:
+            send_frame(sock, {"ok": False, "error": f"unknown method {method!r}"})
+            return
+        try:
+            payload, rbin = fn(req.get("payload", {}), binary)
+            send_frame(sock, {"ok": True, "payload": payload}, rbin)
+        except BallistaError as e:
+            send_frame(sock, {"ok": False, "error": str(e),
+                              "error_kind": type(e).__name__})
+        except Exception as e:  # noqa: BLE001 — report, keep serving
+            log.exception("rpc handler %s failed", method)
+            send_frame(sock, {"ok": False, "error": f"{type(e).__name__}: {e}"})

@@ -1,0 +1,79 @@
+"""Length-prefixed JSON+binary framing over TCP.
+
+The reference uses tonic gRPC (control plane) + Arrow Flight (data plane)
+over HTTP/2 (reference ballista/core/src/utils.rs:434-461 tuned endpoints,
+client.rs Flight streams).  Here both planes share one framing:
+
+    frame := u32 json_len | json bytes | u32 bin_len | bin bytes
+
+Control messages put everything in the JSON part; the data plane returns
+Arrow IPC file bytes in the binary part (no base64 overhead).  Requests
+carry a ``method`` field; responses carry ``ok`` plus either payload or
+``error``.  TCP_NODELAY is set on every socket (same reason the reference
+does: small control frames must not wait on Nagle).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+_HDR = struct.Struct("!II")
+MAX_FRAME = 1 << 30  # 1 GiB guard
+
+
+def send_frame(sock: socket.socket, obj: dict, binary: bytes = b"") -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(_HDR.pack(len(payload), len(binary)) + payload + binary)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    hdr = _recv_exact(sock, _HDR.size)
+    jlen, blen = _HDR.unpack(hdr)
+    if jlen > MAX_FRAME or blen > MAX_FRAME:
+        raise ConnectionError(f"oversized frame ({jlen}/{blen})")
+    obj = json.loads(_recv_exact(sock, jlen)) if jlen else {}
+    binary = _recv_exact(sock, blen) if blen else b""
+    return obj, binary
+
+
+def connect(host: str, port: int, timeout: float = 20.0) -> socket.socket:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def call(host: str, port: int, method: str, payload: Optional[dict] = None,
+         binary: bytes = b"", timeout: float = 60.0) -> Tuple[dict, bytes]:
+    """One-shot RPC: connect, send request, read response, close."""
+    sock = connect(host, port, timeout)
+    try:
+        sock.settimeout(timeout)
+        req = {"method": method, "payload": payload or {}}
+        send_frame(sock, req, binary)
+        resp, rbin = recv_frame(sock)
+        if not resp.get("ok"):
+            raise RemoteError(resp.get("error", "unknown remote error"),
+                              resp.get("error_kind", ""))
+        return resp.get("payload", {}), rbin
+    finally:
+        sock.close()
+
+
+class RemoteError(Exception):
+    def __init__(self, message: str, kind: str = ""):
+        super().__init__(message)
+        self.kind = kind

@@ -273,7 +273,12 @@ class ExprCompiler:
         if isinstance(e, E.Lit):
             dt = e.dtype(sch)
             if dt.is_string:
-                raise PlanningError("bare string literal outside a comparison")
+                # constant string column: one-entry dictionary, code 0
+                val = str(e.value)
+                return Compiled(
+                    lambda c, a: xp.zeros((), dtype=xp.int64), dt,
+                    dict_fn=lambda d, v=val: np.array([v], dtype=object),
+                    lit_value=e.value)
             v = self._lit_physical(e, dt) if not dt.is_float else float(e.value)
             npdt = dt.np_dtype
             return Compiled(lambda c, a, v=v, t=npdt: xp.asarray(v, dtype=t), dt, lit_value=e.value)

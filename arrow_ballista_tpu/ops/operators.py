@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from ..models import expr as E
 from ..models.batch import ColumnBatch, concat_batches
 from ..models.schema import BOOL, DataType, Field, INT64, Schema
-from ..utils.config import AGG_CAPACITY, JOIN_OUTPUT_FACTOR
+from ..utils.config import AGG_CAPACITY, JOIN_MAX_CAPACITY, JOIN_OUTPUT_FACTOR
 from ..utils.errors import CapacityError, ExecutionError, InternalError
 from .expressions import Compiled, ExprCompiler
 from . import kernels as K
@@ -499,6 +499,12 @@ class JoinExec(ExecutionPlan):
             # answer to data-dependent join fan-out (SURVEY.md §7 hard parts).
             if int(total) > out_cap:
                 need = 1 << (int(total) - 1).bit_length()
+                ceiling = ctx.config.get(JOIN_MAX_CAPACITY)
+                if need > ceiling:
+                    raise CapacityError(
+                        f"join produced {int(total)} candidate pairs, above the "
+                        f"{ceiling}-row ceiling; likely an accidental near-cross "
+                        f"join — check join keys, or raise {JOIN_MAX_CAPACITY}")
                 self.metrics().add("capacity_recompiles", 1)
                 out_cols, out_mask, total = jfn(
                     probe.columns, probe.mask, build.columns, build.mask,

@@ -53,7 +53,9 @@ class ShuffleWritePartition:
 
 @dataclasses.dataclass
 class PartitionLocation:
-    """Where a map output lives (reference ballista.proto:211-221)."""
+    """Where a map output lives (reference ballista.proto:211-221).
+    ``host``/``port`` address the owning executor's data plane for remote
+    fetch (the reference embeds ExecutorMetadata the same way)."""
 
     executor_id: str
     map_partition: int
@@ -61,6 +63,8 @@ class PartitionLocation:
     path: str
     num_rows: int = 0
     num_bytes: int = 0
+    host: str = ""
+    port: int = 0
 
 
 class ShuffleWriterExec(ExecutionPlan):
@@ -177,17 +181,35 @@ class ShuffleReaderExec(ExecutionPlan):
                 f"no shuffle locations for stage {self.stage_id} partition {partition}"
             )
         paths = []
+        remote: List[PartitionLocation] = []
         for loc in locs:
             if loc.num_rows == 0:
                 continue  # skip empty map outputs
-            if not os.path.exists(loc.path):
+            if os.path.exists(loc.path):
+                paths.append(loc.path)  # local fast path (shuffle_reader.rs:316)
+            elif loc.port:
+                remote.append(loc)
+            else:
                 raise FetchFailedError(loc.executor_id, self.stage_id, loc.map_partition,
                                        f"shuffle file missing: {loc.path}")
-            paths.append(loc.path)
         with self.metrics().timer("fetch_time"):
             batches = read_ipc_files(paths, self._schema, capacity=ctx.config.batch_size)
+            for loc in remote:
+                batches.extend(self._fetch_remote(loc, ctx))
         self.metrics().add("output_rows", sum(b.num_rows for b in batches))
         return batches
+
+    def _fetch_remote(self, loc: PartitionLocation, ctx: TaskContext) -> List[ColumnBatch]:
+        from ..net.dataplane import fetch_partition_batches
+
+        try:
+            batches = fetch_partition_batches(loc.host, loc.port, loc.path,
+                                              self._schema, ctx.config.batch_size)
+            self.metrics().add("remote_fetches", 1)
+            return batches
+        except Exception as err:  # noqa: BLE001 — retries exhausted
+            raise FetchFailedError(loc.executor_id, self.stage_id, loc.map_partition,
+                                   f"remote fetch failed: {err}") from err
 
     def _label(self):
         return f"ShuffleReaderExec: stage={self.stage_id} partitions={self.partition_count}"

@@ -92,14 +92,20 @@ class ExecutionStage:
     def all_successful(self) -> bool:
         return all(t is not None and t.state == "success" for t in self.task_infos)
 
-    def output_locations(self) -> Dict[int, List[PartitionLocation]]:
-        """output partition -> locations across all map tasks."""
+    def output_locations(self, addr_resolver=None) -> Dict[int, List[PartitionLocation]]:
+        """output partition -> locations across all map tasks.
+        ``addr_resolver(executor_id) -> (host, port)`` stamps the data-plane
+        address for remote fetch (None in purely local deployments)."""
         locs: Dict[int, List[PartitionLocation]] = {}
         for map_part, (executor_id, writes) in sorted(self.outputs.items()):
+            host, port = ("", 0)
+            if addr_resolver is not None:
+                host, port = addr_resolver(executor_id)
             for w in writes:
                 locs.setdefault(w.output_partition, []).append(
                     PartitionLocation(executor_id, map_part, w.output_partition,
-                                      w.path, w.num_rows, w.num_bytes))
+                                      w.path, w.num_rows, w.num_bytes,
+                                      host, port))
         return locs
 
     # --- transitions -----------------------------------------------------
@@ -169,6 +175,8 @@ class ExecutionGraph:
         self.status = "running"
         self.error = ""
         self.scalars: Dict[str, object] = {}
+        # executor_id -> (host, port) of the data plane; None = local-only
+        self.addr_resolver = None
         self._task_id_gen = itertools.count()
         self.revive()
 
@@ -186,7 +194,7 @@ class ExecutionGraph:
             if stage.state != UNRESOLVED:
                 continue
             if all(self.stages[p].state == SUCCESSFUL for p in stage.producer_ids):
-                locations = {p: self.stages[p].output_locations()
+                locations = {p: self.stages[p].output_locations(self.addr_resolver)
                              for p in stage.producer_ids}
                 stage.resolved_plan = remove_unresolved_shuffles(stage.plan, locations) \
                     if stage.producer_ids else stage.plan
@@ -254,7 +262,8 @@ class ExecutionGraph:
             stage.state = SUCCESSFUL
             if stage.stage_id == self.final_stage_id:
                 self.status = "successful"
-                events.append(("job_successful", stage.output_locations()))
+                events.append(("job_successful",
+                               stage.output_locations(self.addr_resolver)))
             else:
                 self.revive()
 

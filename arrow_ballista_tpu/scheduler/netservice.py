@@ -1,0 +1,200 @@
+"""Scheduler network service: the SchedulerGrpc surface over the wire.
+
+Parity: reference ballista/scheduler/src/scheduler_server/grpc.rs — the 10
+RPC handlers (execute_query, get_job_status, register_executor,
+heart_beat_from_executor, update_task_status, executor_stopped, cancel_job,
+clean_job_data, …) — plus table registration (the reference client ships
+CREATE EXTERNAL TABLE inside the logical plan, context.rs:358-530; here the
+scheduler owns the catalog and clients register tables by RPC).
+
+Launching goes through ``NetTaskLauncher`` -> executor launch_multi_task,
+i.e. push scheduling (TaskSchedulingPolicy::PushStaged).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from .. import serde
+from ..catalog import CsvTable, MemoryTable, ParquetTable, SchemaCatalog
+from ..models.schema import Field, Schema
+from ..net.rpc import RpcServer
+from ..net import wire
+from ..utils.config import BallistaConfig
+from ..utils.errors import PlanningError
+from .scheduler import SchedulerConfig, SchedulerServer, TaskLauncher, random_job_id
+from .types import ExecutorHeartbeat, ExecutorMetadata, TaskDescription
+
+log = logging.getLogger(__name__)
+
+
+class NetTaskLauncher(TaskLauncher):
+    """Pushes tasks to executors over the wire (reference
+    DefaultTaskLauncher -> ExecutorGrpc.LaunchMultiTask,
+    state/task_manager.rs:69-119)."""
+
+    def __init__(self):
+        self.scheduler: Optional[SchedulerServer] = None
+
+    def _addr(self, executor_id: str):
+        meta = self.scheduler.cluster.get_executor(executor_id)
+        if meta is None:
+            raise PlanningError(f"unknown executor {executor_id}")
+        return meta.host, meta.grpc_port or meta.port
+
+    def launch_tasks(self, executor_id: str, tasks: List[TaskDescription]) -> None:
+        host, port = self._addr(executor_id)
+        wire.call(host, port, "launch_multi_task",
+                  {"tasks": [serde.task_to_obj(t) for t in tasks]})
+
+    def cancel_tasks(self, executor_id: str, job_id: str) -> None:
+        try:
+            host, port = self._addr(executor_id)
+            wire.call(host, port, "cancel_tasks", {"job_id": job_id})
+        except Exception:  # noqa: BLE001 — best effort
+            log.warning("cancel_tasks on %s failed", executor_id, exc_info=True)
+
+
+class SchedulerNetService:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 config: Optional[BallistaConfig] = None,
+                 scheduler_config: Optional[SchedulerConfig] = None):
+        self.config = config or BallistaConfig()
+        self.catalog = SchemaCatalog()
+        launcher = NetTaskLauncher()
+        self.server = SchedulerServer(launcher, scheduler_config)
+        launcher.scheduler = self.server
+        self.rpc = RpcServer(host, port)
+        self.host, self.port = self.rpc.host, self.rpc.port
+        # job -> result schema, LRU-bounded: clients fetch results right
+        # after completion, so old entries are dead weight in a long-running
+        # daemon
+        from collections import OrderedDict
+
+        self._final_schemas: "OrderedDict[str, Schema]" = OrderedDict()
+        self._max_schemas = 1024
+        self._lock = threading.Lock()
+
+        r = self.rpc.register
+        r("execute_query", self._execute_query)
+        r("get_job_status", self._get_job_status)
+        r("cancel_job", self._cancel_job)
+        r("register_executor", self._register_executor)
+        r("heartbeat", self._heartbeat)
+        r("update_task_status", self._update_task_status)
+        r("executor_stopped", self._executor_stopped)
+        r("register_table", self._register_table)
+        r("register_external_table", self._register_external_table)
+        r("list_tables", self._list_tables)
+        r("table_schema", self._table_schema)
+        r("ping", lambda p, b: ({}, b""))
+
+    def start(self) -> None:
+        self.server.init()
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.rpc.stop()
+
+    # --- query handling --------------------------------------------------
+    def _execute_query(self, payload: dict, _bin: bytes):
+        sql = payload["sql"]
+        session_config = BallistaConfig({**self.config._settings,
+                                         **payload.get("config", {})})
+        job_id = random_job_id()
+
+        def plan_fn():
+            from ..client.context import extract_scalar
+            from ..ops.physical import TaskContext
+            from ..sql.optimizer import optimize
+            from ..sql.parser import parse_sql
+            from ..sql.planner import SqlToRel
+            from .physical_planner import PhysicalPlanner
+
+            logical = optimize(SqlToRel(self.catalog).plan(parse_sql(sql)))
+            planned = PhysicalPlanner(self.catalog, session_config).plan_query(logical)
+            ctx = TaskContext(config=session_config, job_id=f"{job_id}-scalars")
+            scalars: Dict[str, object] = {}
+            for sid, splan in planned.scalars:
+                ctx.scalars = scalars
+                scalars[sid] = extract_scalar(splan, ctx)
+            with self._lock:
+                self._final_schemas[job_id] = planned.plan.schema
+                while len(self._final_schemas) > self._max_schemas:
+                    self._final_schemas.popitem(last=False)
+            return planned.plan, scalars
+
+        self.server.submit_job(job_id, plan_fn)
+        return {"job_id": job_id}, b""
+
+    def _get_job_status(self, payload: dict, _bin: bytes):
+        job_id = payload["job_id"]
+        status = self.server.get_job_status(job_id)
+        if status is None:
+            return {"state": "not_found"}, b""
+        out = {"state": status.state, "error": status.error}
+        if status.state == "successful":
+            out["locations"] = {
+                str(part): [serde.location_to_obj(l) for l in locs]
+                for part, locs in status.locations.items()}
+            with self._lock:
+                schema = self._final_schemas.get(job_id)
+            if schema is not None:
+                out["schema"] = serde.schema_to_obj(schema)
+        return out, b""
+
+    def _cancel_job(self, payload: dict, _bin: bytes):
+        self.server.cancel_job(payload["job_id"])
+        return {}, b""
+
+    # --- executor control ------------------------------------------------
+    def _register_executor(self, payload: dict, _bin: bytes):
+        self.server.register_executor(ExecutorMetadata(**payload["meta"]))
+        return {}, b""
+
+    def _heartbeat(self, payload: dict, _bin: bytes):
+        self.server.heartbeat(ExecutorHeartbeat(
+            payload["executor_id"], status=payload.get("status", "active")))
+        return {}, b""
+
+    def _update_task_status(self, payload: dict, _bin: bytes):
+        statuses = [serde.status_from_obj(s) for s in payload["statuses"]]
+        self.server.update_task_status(payload["executor_id"], statuses)
+        return {}, b""
+
+    def _executor_stopped(self, payload: dict, _bin: bytes):
+        self.server.executor_stopped(payload["executor_id"],
+                                     payload.get("reason", ""))
+        return {}, b""
+
+    # --- catalog ---------------------------------------------------------
+    def _register_table(self, payload: dict, binary: bytes):
+        import io
+
+        import pyarrow.ipc as ipc
+
+        table = ipc.open_stream(io.BytesIO(binary)).read_all()
+        self.catalog.register(MemoryTable(payload["name"], table))
+        return {}, b""
+
+    def _register_external_table(self, payload: dict, _bin: bytes):
+        name, fmt, path = payload["name"], payload["format"], payload["path"]
+        schema = serde.schema_from_obj(payload["schema"]) if payload.get("schema") else None
+        if fmt == "parquet":
+            self.catalog.register(ParquetTable(name, path, schema))
+        elif fmt == "csv":
+            self.catalog.register(CsvTable(
+                name, path, schema, payload.get("delimiter", ","),
+                payload.get("has_header", True)))
+        else:
+            raise PlanningError(f"unsupported format {fmt!r}")
+        return {}, b""
+
+    def _list_tables(self, payload: dict, _bin: bytes):
+        return {"tables": self.catalog.table_names()}, b""
+
+    def _table_schema(self, payload: dict, _bin: bytes):
+        schema = self.catalog.table_schema(payload["name"])
+        return {"schema": serde.schema_to_obj(schema)}, b""

@@ -182,6 +182,7 @@ class SchedulerServer:
             plan, scalars = ev.plan_fn()
             graph = ExecutionGraph.build(ev.job_id, plan)
             graph.scalars = scalars
+            graph.addr_resolver = self._resolve_addr
         except Exception as e:  # noqa: BLE001 — planning failures fail the job
             log.exception("planning failed for job %s", ev.job_id)
             self.jobs.set_status(JobStatus(ev.job_id, "failed",
@@ -231,6 +232,10 @@ class SchedulerServer:
                 self.launcher.cancel_tasks(eid, graph.job_id)
             except Exception:  # noqa: BLE001
                 log.exception("cancel_tasks failed for %s", eid)
+
+    def _resolve_addr(self, executor_id: str):
+        meta = self.cluster.get_executor(executor_id)
+        return (meta.host, meta.port) if meta is not None else ("", 0)
 
     # --- push scheduling -------------------------------------------------
     def _offer(self) -> None:

@@ -1,0 +1,54 @@
+"""Scheduler daemon: ``python -m arrow_ballista_tpu.scheduler_daemon``.
+
+Parity: the ballista-scheduler binary (reference ballista/scheduler/src/
+bin/main.rs + scheduler_process.rs — single-port server hosting the gRPC
+surface; the configure_me TOML spec maps to argparse flags here).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import time
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="arrow_ballista_tpu scheduler")
+    ap.add_argument("--bind-host", default="0.0.0.0")
+    ap.add_argument("--bind-port", type=int, default=50050)
+    ap.add_argument("--task-distribution", choices=["bias", "round-robin"],
+                    default="bias")
+    ap.add_argument("--executor-timeout-s", type=float, default=180.0)
+    ap.add_argument("--shuffle-partitions", type=int, default=16)
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from .scheduler.netservice import SchedulerNetService
+    from .scheduler.scheduler import SchedulerConfig
+    from .utils.config import BallistaConfig
+
+    svc = SchedulerNetService(
+        args.bind_host, args.bind_port,
+        config=BallistaConfig(
+            {"ballista.shuffle.partitions": str(args.shuffle_partitions)}),
+        scheduler_config=SchedulerConfig(
+            task_distribution=args.task_distribution,
+            executor_timeout_s=args.executor_timeout_s))
+    svc.start()
+    logging.info("scheduler listening on %s:%s", svc.host, svc.port)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.5)
+    logging.info("scheduler shutting down")
+    svc.stop()
+
+
+if __name__ == "__main__":
+    main()

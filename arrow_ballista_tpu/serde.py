@@ -1,0 +1,342 @@
+"""Plan/expression/schema serde: the wire contract between processes.
+
+Parity: the reference's protobuf layer (reference ballista/core/proto/
+ballista.proto + datafusion.proto and serde/mod.rs BallistaCodec — 157
+messages of logical+physical plan serde).  Here the encoding is tagged
+JSON-safe dicts (stable, versioned, no pickle across trust boundaries);
+Arrow IPC bytes ride in a separate binary frame (see net/wire.py).
+
+Covers: DataType/Field/Schema, every Expr node, every physical operator,
+Partitioning, PartitionLocation, TaskDescription/TaskStatus.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Dict, List, Optional
+
+from .models import expr as E
+from .models.schema import DataType, Field, Schema
+from .ops import operators as O
+from .ops import physical as P
+from .ops import shuffle as SH
+from .ops.shuffle import PartitionLocation, ShuffleWritePartition
+from .scheduler.types import FailedReason, TaskDescription, TaskId, TaskStatus
+from .utils.errors import InternalError
+
+SERDE_VERSION = 1
+
+
+# --------------------------------------------------------------------------
+# schema
+# --------------------------------------------------------------------------
+
+def dtype_to_obj(t: DataType) -> dict:
+    return {"kind": t.kind, "scale": t.scale}
+
+
+def dtype_from_obj(o: dict) -> DataType:
+    return DataType(o["kind"], o.get("scale", 0))
+
+
+def schema_to_obj(s: Schema) -> list:
+    return [{"name": f.name, "dtype": dtype_to_obj(f.dtype),
+             "nullable": f.nullable} for f in s]
+
+
+def schema_from_obj(o: list) -> Schema:
+    return Schema(Field(f["name"], dtype_from_obj(f["dtype"]),
+                        f.get("nullable", False)) for f in o)
+
+
+# --------------------------------------------------------------------------
+# expressions
+# --------------------------------------------------------------------------
+
+def expr_to_obj(e: Optional[E.Expr]):
+    if e is None:
+        return None
+    if isinstance(e, E.Column):
+        return {"t": "col", "name": e.name}
+    if isinstance(e, E.Lit):
+        return {"t": "lit", "v": e.value, "kind": e.kind}
+    if isinstance(e, E.BinOp):
+        return {"t": "bin", "op": e.op, "l": expr_to_obj(e.left),
+                "r": expr_to_obj(e.right)}
+    if isinstance(e, E.Not):
+        return {"t": "not", "o": expr_to_obj(e.operand)}
+    if isinstance(e, E.Negate):
+        return {"t": "neg", "o": expr_to_obj(e.operand)}
+    if isinstance(e, E.Case):
+        return {"t": "case",
+                "whens": [[expr_to_obj(c), expr_to_obj(v)] for c, v in e.whens],
+                "else": expr_to_obj(e.else_)}
+    if isinstance(e, E.Cast):
+        return {"t": "cast", "o": expr_to_obj(e.operand), "to": dtype_to_obj(e.to)}
+    if isinstance(e, E.InList):
+        return {"t": "inlist", "o": expr_to_obj(e.operand), "vs": list(e.values),
+                "neg": e.negated}
+    if isinstance(e, E.Like):
+        return {"t": "like", "o": expr_to_obj(e.operand), "p": e.pattern,
+                "neg": e.negated}
+    if isinstance(e, E.IsNull):
+        return {"t": "isnull", "o": expr_to_obj(e.operand), "neg": e.negated}
+    if isinstance(e, E.Extract):
+        return {"t": "extract", "f": e.field, "o": expr_to_obj(e.operand)}
+    if isinstance(e, E.Substring):
+        return {"t": "substr", "o": expr_to_obj(e.operand), "start": e.start,
+                "len": e.length}
+    if isinstance(e, E.Agg):
+        return {"t": "agg", "f": e.func, "o": expr_to_obj(e.operand),
+                "distinct": e.distinct}
+    if isinstance(e, E.ScalarSubquery):
+        # scalar subqueries are evaluated before tasks ship; only the id
+        # reference crosses the wire (values ride in TaskDescription.scalars)
+        sid = getattr(e, "scalar_id", None)
+        if sid is None:
+            raise InternalError("unplanned scalar subquery cannot be serialized")
+        return {"t": "scalarref", "id": sid}
+    raise InternalError(f"cannot serialize expr {type(e).__name__}")
+
+
+def expr_from_obj(o) -> Optional[E.Expr]:
+    if o is None:
+        return None
+    t = o["t"]
+    if t == "col":
+        return E.Column(o["name"])
+    if t == "lit":
+        return E.Lit(o["v"], o.get("kind", "auto"))
+    if t == "bin":
+        return E.BinOp(o["op"], expr_from_obj(o["l"]), expr_from_obj(o["r"]))
+    if t == "not":
+        return E.Not(expr_from_obj(o["o"]))
+    if t == "neg":
+        return E.Negate(expr_from_obj(o["o"]))
+    if t == "case":
+        return E.Case([(expr_from_obj(c), expr_from_obj(v)) for c, v in o["whens"]],
+                      expr_from_obj(o["else"]))
+    if t == "cast":
+        return E.Cast(expr_from_obj(o["o"]), dtype_from_obj(o["to"]))
+    if t == "inlist":
+        return E.InList(expr_from_obj(o["o"]), list(o["vs"]), o["neg"])
+    if t == "like":
+        return E.Like(expr_from_obj(o["o"]), o["p"], o["neg"])
+    if t == "isnull":
+        return E.IsNull(expr_from_obj(o["o"]), o["neg"])
+    if t == "extract":
+        return E.Extract(o["f"], expr_from_obj(o["o"]))
+    if t == "substr":
+        return E.Substring(expr_from_obj(o["o"]), o["start"], o["len"])
+    if t == "agg":
+        return E.Agg(o["f"], expr_from_obj(o["o"]), o.get("distinct", False))
+    if t == "scalarref":
+        sq = E.ScalarSubquery(None)
+        object.__setattr__(sq, "scalar_id", o["id"])
+        return sq
+    raise InternalError(f"cannot deserialize expr tag {t!r}")
+
+
+# --------------------------------------------------------------------------
+# partitioning / locations
+# --------------------------------------------------------------------------
+
+def partitioning_to_obj(p: Optional[P.Partitioning]):
+    if p is None:
+        return None
+    return {"kind": p.kind, "count": p.count,
+            "exprs": [expr_to_obj(e) for e in p.exprs]}
+
+
+def partitioning_from_obj(o) -> Optional[P.Partitioning]:
+    if o is None:
+        return None
+    return P.Partitioning(o["kind"], o["count"],
+                          tuple(expr_from_obj(e) for e in o["exprs"]))
+
+
+def location_to_obj(l: PartitionLocation) -> dict:
+    return dict(vars(l))
+
+
+def location_from_obj(o: dict) -> PartitionLocation:
+    return PartitionLocation(**o)
+
+
+# --------------------------------------------------------------------------
+# physical plans
+# --------------------------------------------------------------------------
+
+def plan_to_obj(p: P.ExecutionPlan) -> dict:
+    if isinstance(p, P.MemoryScanExec):
+        import io
+
+        import pyarrow as pa
+        import pyarrow.ipc as ipc
+
+        buf = io.BytesIO()
+        with ipc.new_stream(buf, p.table.schema) as w:
+            w.write_table(p.table)
+        return {"t": "memscan", "schema": schema_to_obj(p.schema),
+                "table_b64": base64.b64encode(buf.getvalue()).decode(),
+                "partitions": p.partitions,
+                "filters": [expr_to_obj(f) for f in p.filters]}
+    if isinstance(p, P.ParquetScanExec):
+        return {"t": "parquetscan", "schema": schema_to_obj(p.schema),
+                "files": p.files, "partitions": len(p.groups),
+                "filters": [expr_to_obj(f) for f in p.filters],
+                "table_schema": schema_to_obj(p.table_schema)}
+    if isinstance(p, P.CsvScanExec):
+        return {"t": "csvscan", "schema": schema_to_obj(p.schema),
+                "files": p.files, "partitions": p.output_partition_count(),
+                "filters": [expr_to_obj(f) for f in p.filters],
+                "table_schema": schema_to_obj(p.table_schema),
+                "delimiter": p.delimiter, "has_header": p.has_header}
+    if isinstance(p, O.ProjectionExec):
+        return {"t": "proj", "input": plan_to_obj(p.input),
+                "exprs": [[expr_to_obj(e), n] for e, n in p.exprs],
+                "host": p.host_mode}
+    if isinstance(p, O.RenameExec):
+        return {"t": "rename", "input": plan_to_obj(p.input),
+                "schema": schema_to_obj(p.schema)}
+    if isinstance(p, O.FilterExec):
+        return {"t": "filter", "input": plan_to_obj(p.input),
+                "pred": expr_to_obj(p.predicate), "host": p.host_mode}
+    if isinstance(p, O.HashAggregateExec):
+        return {"t": "agg", "input": plan_to_obj(p.input),
+                "groups": [[expr_to_obj(e), n] for e, n in p.group_exprs],
+                "aggs": [{"func": a.func, "operand": expr_to_obj(a.operand),
+                          "name": a.name} for a in p.aggs],
+                "mode": p.mode}
+    if isinstance(p, O.JoinExec):
+        return {"t": "join", "left": plan_to_obj(p.left),
+                "right": plan_to_obj(p.right),
+                "on": [[expr_to_obj(l), expr_to_obj(r)] for l, r in p.on],
+                "jt": p.join_type, "filter": expr_to_obj(p.filter),
+                "dist": p.dist}
+    if isinstance(p, O.SortExec):
+        return {"t": "sort", "input": plan_to_obj(p.input),
+                "keys": [[expr_to_obj(e), asc] for e, asc in p.keys],
+                "fetch": p.fetch}
+    if isinstance(p, O.LimitExec):
+        return {"t": "limit", "input": plan_to_obj(p.input), "n": p.n}
+    if isinstance(p, O.CoalescePartitionsExec):
+        return {"t": "coalesce", "input": plan_to_obj(p.input)}
+    if isinstance(p, SH.ShuffleWriterExec):
+        return {"t": "shufflewrite", "input": plan_to_obj(p.input),
+                "partitioning": partitioning_to_obj(p.partitioning),
+                "stage_id": p.stage_id}
+    if isinstance(p, SH.ShuffleReaderExec):
+        return {"t": "shuffleread", "stage_id": p.stage_id,
+                "schema": schema_to_obj(p.schema),
+                "partition_count": p.partition_count,
+                "locations": {str(k): [location_to_obj(l) for l in v]
+                              for k, v in p.locations.items()}}
+    if isinstance(p, SH.UnresolvedShuffleExec):
+        return {"t": "unresolvedshuffle", "stage_id": p.stage_id,
+                "schema": schema_to_obj(p.schema),
+                "partition_count": p.output_partition_count()}
+    if isinstance(p, SH.RepartitionExec):
+        return {"t": "repart", "input": plan_to_obj(p.input),
+                "partitioning": partitioning_to_obj(p.partitioning)}
+    raise InternalError(f"cannot serialize plan node {type(p).__name__}")
+
+
+def plan_from_obj(o: dict) -> P.ExecutionPlan:
+    t = o["t"]
+    if t == "memscan":
+        import io
+
+        import pyarrow.ipc as ipc
+
+        table = ipc.open_stream(io.BytesIO(base64.b64decode(o["table_b64"]))).read_all()
+        return P.MemoryScanExec(schema_from_obj(o["schema"]), table,
+                                o["partitions"],
+                                [expr_from_obj(f) for f in o["filters"]])
+    if t == "parquetscan":
+        return P.ParquetScanExec(schema_from_obj(o["schema"]), o["files"],
+                                 o["partitions"],
+                                 [expr_from_obj(f) for f in o["filters"]],
+                                 table_schema=schema_from_obj(o["table_schema"]))
+    if t == "csvscan":
+        return P.CsvScanExec(schema_from_obj(o["schema"]), o["files"],
+                             o["partitions"],
+                             [expr_from_obj(f) for f in o["filters"]],
+                             table_schema=schema_from_obj(o["table_schema"]),
+                             delimiter=o["delimiter"], has_header=o["has_header"])
+    if t == "proj":
+        return O.ProjectionExec(plan_from_obj(o["input"]),
+                                [(expr_from_obj(e), n) for e, n in o["exprs"]],
+                                host_mode=o["host"])
+    if t == "rename":
+        return O.RenameExec(plan_from_obj(o["input"]), schema_from_obj(o["schema"]))
+    if t == "filter":
+        return O.FilterExec(plan_from_obj(o["input"]), expr_from_obj(o["pred"]),
+                            host_mode=o.get("host", False))
+    if t == "agg":
+        return O.HashAggregateExec(
+            plan_from_obj(o["input"]),
+            [(expr_from_obj(e), n) for e, n in o["groups"]],
+            [O.AggSpec(a["func"], expr_from_obj(a["operand"]), a["name"])
+             for a in o["aggs"]],
+            o["mode"])
+    if t == "join":
+        return O.JoinExec(plan_from_obj(o["left"]), plan_from_obj(o["right"]),
+                          [(expr_from_obj(l), expr_from_obj(r)) for l, r in o["on"]],
+                          o["jt"], expr_from_obj(o["filter"]), o["dist"])
+    if t == "sort":
+        return O.SortExec(plan_from_obj(o["input"]),
+                          [(expr_from_obj(e), asc) for e, asc in o["keys"]],
+                          fetch=o["fetch"])
+    if t == "limit":
+        return O.LimitExec(plan_from_obj(o["input"]), o["n"])
+    if t == "coalesce":
+        return O.CoalescePartitionsExec(plan_from_obj(o["input"]))
+    if t == "shufflewrite":
+        return SH.ShuffleWriterExec(plan_from_obj(o["input"]),
+                                    partitioning_from_obj(o["partitioning"]),
+                                    stage_id=o["stage_id"])
+    if t == "shuffleread":
+        return SH.ShuffleReaderExec(
+            o["stage_id"], schema_from_obj(o["schema"]), o["partition_count"],
+            {int(k): [location_from_obj(l) for l in v]
+             for k, v in o["locations"].items()})
+    if t == "unresolvedshuffle":
+        return SH.UnresolvedShuffleExec(o["stage_id"], schema_from_obj(o["schema"]),
+                                        o["partition_count"])
+    if t == "repart":
+        return SH.RepartitionExec(plan_from_obj(o["input"]),
+                                  partitioning_from_obj(o["partitioning"]))
+    raise InternalError(f"cannot deserialize plan tag {t!r}")
+
+
+# --------------------------------------------------------------------------
+# task messages
+# --------------------------------------------------------------------------
+
+def task_to_obj(td: TaskDescription) -> dict:
+    return {"task": vars(td.task), "plan": plan_to_obj(td.plan),
+            "internal_id": td.task_internal_id, "scalars": dict(td.scalars)}
+
+
+def task_from_obj(o: dict) -> TaskDescription:
+    return TaskDescription(TaskId(**o["task"]), plan_from_obj(o["plan"]),
+                           o.get("internal_id", 0), dict(o.get("scalars", {})))
+
+
+def status_to_obj(st: TaskStatus) -> dict:
+    return {
+        "task": vars(st.task), "executor_id": st.executor_id, "state": st.state,
+        "writes": [vars(w) for w in st.shuffle_writes],
+        "failure": vars(st.failure) if st.failure else None,
+        "launch_ms": st.launch_time_ms, "start_ms": st.start_time_ms,
+        "end_ms": st.end_time_ms, "metrics": st.metrics,
+    }
+
+
+def status_from_obj(o: dict) -> TaskStatus:
+    return TaskStatus(
+        TaskId(**o["task"]), o["executor_id"], o["state"],
+        [ShuffleWritePartition(**w) for w in o["writes"]],
+        FailedReason(**o["failure"]) if o.get("failure") else None,
+        o.get("launch_ms", 0), o.get("start_ms", 0), o.get("end_ms", 0),
+        o.get("metrics", {}))

@@ -1,0 +1,184 @@
+"""TPC-H benchmark harness: ``python -m benchmarks.tpch <cmd>``.
+
+Parity: the reference tpch binary (reference benchmarks/src/bin/tpch.rs:
+76-284 — benchmark/convert/loadtest subcommands, per-query iterations,
+JSON results output).
+
+  convert   --scale 1 --output /data/tpch-sf1 [--format parquet|csv]
+  benchmark --path /data/tpch-sf1 --query 1 [--iterations 3] [--engine local|standalone]
+  loadtest  --path ... --concurrency 4 --queries 1,3,6
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List
+
+
+def cmd_convert(args) -> None:
+    import pyarrow.parquet as pq
+
+    from .datagen import generate_tables
+
+    os.makedirs(args.output, exist_ok=True)
+    t0 = time.time()
+    tables = generate_tables(args.scale, seed=args.seed)
+    for name, table in tables.items():
+        if args.format == "parquet":
+            path = os.path.join(args.output, f"{name}.parquet")
+            pq.write_table(table, path, compression=args.compression)
+        else:
+            import pyarrow.csv as pacsv
+
+            path = os.path.join(args.output, f"{name}.csv")
+            pacsv.write_csv(table, path)
+        print(f"wrote {path} ({table.num_rows} rows)", file=sys.stderr)
+    print(json.dumps({"command": "convert", "scale": args.scale,
+                      "seconds": round(time.time() - t0, 2)}))
+
+
+def make_context(args):
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    config = BallistaConfig({
+        "ballista.shuffle.partitions": str(args.shuffle_partitions),
+        "ballista.batch.size": str(args.batch_size),
+    })
+    if args.engine == "standalone":
+        ctx = BallistaContext.standalone(config,
+                                         concurrent_tasks=args.concurrent_tasks)
+    elif args.engine == "remote":
+        host, port = args.scheduler.split(":")
+        ctx = BallistaContext.remote(host, int(port), config)
+    else:
+        ctx = BallistaContext.local(config)
+    register_tables(ctx, args.path)
+    return ctx
+
+
+def register_tables(ctx, path: str) -> None:
+    from benchmarks.schema import TABLES
+
+    for name in TABLES:
+        pq_path = os.path.join(path, f"{name}.parquet")
+        csv_path = os.path.join(path, f"{name}.csv")
+        if os.path.exists(pq_path):
+            ctx.register_parquet(name, pq_path)
+        elif os.path.exists(csv_path):
+            ctx.register_csv(name, csv_path)
+        else:
+            raise SystemExit(f"no data for table {name!r} under {path}")
+
+
+def cmd_benchmark(args) -> None:
+    from .queries import QUERIES
+
+    ctx = make_context(args)
+    queries = [int(q) for q in args.query.split(",")] if args.query else sorted(QUERIES)
+    results: List[Dict] = []
+    for q in queries:
+        times = []
+        rows = 0
+        for it in range(args.iterations):
+            t0 = time.perf_counter()
+            out = ctx.sql(QUERIES[q]).collect()
+            dt = time.perf_counter() - t0
+            rows = sum(b.num_rows for b in out)
+            times.append(dt)
+            print(f"q{q} iteration {it}: {dt*1000:.1f} ms ({rows} rows)",
+                  file=sys.stderr)
+        results.append({"query": q, "iterations": args.iterations,
+                        "min_ms": round(min(times) * 1000, 1),
+                        "avg_ms": round(sum(times) / len(times) * 1000, 1),
+                        "rows": rows})
+    print(json.dumps({"command": "benchmark", "engine": args.engine,
+                      "path": args.path, "results": results}))
+    if hasattr(ctx, "shutdown"):
+        ctx.shutdown()
+
+
+def cmd_loadtest(args) -> None:
+    """Concurrent clients hammering a query set (reference tpch.rs:453-563)."""
+    import threading
+
+    from .queries import QUERIES
+
+    ctx = make_context(args)
+    queries = [int(q) for q in args.queries.split(",")]
+    errors: List[str] = []
+    latencies: List[float] = []
+    lock = threading.Lock()
+
+    def client(i: int):
+        for q in queries:
+            t0 = time.perf_counter()
+            try:
+                ctx.sql(QUERIES[q]).collect()
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"client{i} q{q}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "command": "loadtest", "concurrency": args.concurrency,
+        "queries": queries, "total_queries": len(latencies),
+        "errors": len(errors), "wall_s": round(wall, 2),
+        "avg_latency_ms": round(sum(latencies) / max(1, len(latencies)) * 1000, 1),
+    }))
+    for e in errors[:5]:
+        print(e, file=sys.stderr)
+    if hasattr(ctx, "shutdown"):
+        ctx.shutdown()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="TPC-H benchmark harness")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("convert")
+    c.add_argument("--scale", type=float, default=1.0)
+    c.add_argument("--seed", type=int, default=0)
+    c.add_argument("--output", required=True)
+    c.add_argument("--format", choices=["parquet", "csv"], default="parquet")
+    c.add_argument("--compression", default="zstd")
+
+    def common(p):
+        p.add_argument("--path", required=True)
+        p.add_argument("--engine", choices=["local", "standalone", "remote"],
+                       default="local")
+        p.add_argument("--scheduler", default="127.0.0.1:50050")
+        p.add_argument("--shuffle-partitions", type=int, default=8)
+        p.add_argument("--batch-size", type=int, default=1 << 17)
+        p.add_argument("--concurrent-tasks", type=int, default=4)
+
+    b = sub.add_parser("benchmark")
+    common(b)
+    b.add_argument("--query", default=None, help="comma list; default all 22")
+    b.add_argument("--iterations", type=int, default=3)
+
+    l = sub.add_parser("loadtest")
+    common(l)
+    l.add_argument("--concurrency", type=int, default=4)
+    l.add_argument("--queries", default="1,3,6,12")
+
+    args = ap.parse_args(argv)
+    {"convert": cmd_convert, "benchmark": cmd_benchmark,
+     "loadtest": cmd_loadtest}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

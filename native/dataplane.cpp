@@ -1,0 +1,249 @@
+// Native shuffle data-plane server.
+//
+// Role parity: the reference executor's Arrow Flight service
+// (reference ballista/executor/src/flight_service.rs:82-120 do_get
+// FetchPartition) — the high-bandwidth side of the executor that must not
+// contend with the Python control plane for the GIL.  Speaks the same
+// framing as arrow_ballista_tpu/net/wire.py:
+//
+//     u32 json_len | json | u32 bin_len | bin
+//
+// Handles: fetch_partition {"path": ...} -> file bytes; ping.
+// Path-traversal guard mirrors is_subdirectory
+// (reference executor_server.rs:839-876): realpath must stay under the
+// work dir.
+//
+// Exposed via C ABI for ctypes:
+//   dp_start(work_dir, port) -> listening port (0 on error)
+//   dp_stop()
+//   dp_bytes_served() -> counter for metrics
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <climits>
+#include <cstdlib>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+#include <atomic>
+
+namespace {
+
+std::atomic<int> g_listen_fd{-1};
+std::atomic<bool> g_running{false};
+std::atomic<uint64_t> g_bytes_served{0};
+std::string g_work_dir;
+std::thread g_accept_thread;
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Minimal JSON string-field extractor: finds "key":"value" at the top
+// level and unescapes \\ \" \/ (shuffle paths contain nothing else; the
+// python side writes compact json.dumps output).
+bool json_str_field(const std::string& json, const std::string& key,
+                    std::string* out) {
+  const std::string needle = "\"" + key + "\":\"";
+  size_t pos = json.find(needle);
+  if (pos == std::string::npos) return false;
+  pos += needle.size();
+  std::string val;
+  while (pos < json.size()) {
+    char c = json[pos];
+    if (c == '"') {
+      *out = val;
+      return true;
+    }
+    if (c == '\\' && pos + 1 < json.size()) {
+      char n = json[pos + 1];
+      if (n == '"' || n == '\\' || n == '/') {
+        val.push_back(n);
+        pos += 2;
+        continue;
+      }
+    }
+    val.push_back(c);
+    ++pos;
+  }
+  return false;
+}
+
+void send_response(int fd, const std::string& json, const void* bin,
+                   uint32_t bin_len) {
+  uint32_t hdr[2] = {htonl(static_cast<uint32_t>(json.size())), htonl(bin_len)};
+  write_exact(fd, hdr, sizeof(hdr));
+  write_exact(fd, json.data(), json.size());
+  if (bin_len) write_exact(fd, bin, bin_len);
+}
+
+void send_error(int fd, const std::string& msg) {
+  std::string esc;
+  for (char c : msg) {
+    if (c == '"' || c == '\\') esc.push_back('\\');
+    if (static_cast<unsigned char>(c) >= 0x20) esc.push_back(c);
+  }
+  send_response(fd, "{\"ok\":false,\"error\":\"" + esc + "\"}", nullptr, 0);
+}
+
+bool path_under_work_dir(const std::string& path, std::string* resolved) {
+  char buf[PATH_MAX];
+  if (!realpath(path.c_str(), buf)) return false;
+  *resolved = buf;
+  char wbuf[PATH_MAX];
+  if (!realpath(g_work_dir.c_str(), wbuf)) return false;
+  std::string w(wbuf);
+  return resolved->size() > w.size() && resolved->compare(0, w.size(), w) == 0 &&
+         (*resolved)[w.size()] == '/';
+}
+
+void handle_fetch(int fd, const std::string& json) {
+  std::string path;
+  if (!json_str_field(json, "path", &path)) {
+    send_error(fd, "missing path");
+    return;
+  }
+  std::string resolved;
+  if (!path_under_work_dir(path, &resolved)) {
+    send_error(fd, "path escapes the work dir: " + path);
+    return;
+  }
+  FILE* f = fopen(resolved.c_str(), "rb");
+  if (!f) {
+    send_error(fd, "no such shuffle file: " + path);
+    return;
+  }
+  struct stat st;
+  if (fstat(fileno(f), &st) != 0) {
+    fclose(f);
+    send_error(fd, "stat failed: " + path);
+    return;
+  }
+  uint64_t size = static_cast<uint64_t>(st.st_size);
+  std::string hdr_json =
+      "{\"ok\":true,\"payload\":{\"num_bytes\":" + std::to_string(size) + "}}";
+  uint32_t hdr[2] = {htonl(static_cast<uint32_t>(hdr_json.size())),
+                     htonl(static_cast<uint32_t>(size))};
+  write_exact(fd, hdr, sizeof(hdr));
+  write_exact(fd, hdr_json.data(), hdr_json.size());
+  // zero-copy file -> socket (the Flight-stream analog)
+  off_t off = 0;
+  int src = fileno(f);
+  uint64_t left = size;
+  while (left > 0) {
+    ssize_t sent = sendfile(fd, src, &off, left);
+    if (sent <= 0) break;
+    left -= static_cast<uint64_t>(sent);
+  }
+  fclose(f);
+  g_bytes_served += size - left;
+}
+
+void serve_conn(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint32_t hdr[2];
+    if (!read_exact(fd, hdr, sizeof(hdr))) break;
+    uint32_t jlen = ntohl(hdr[0]), blen = ntohl(hdr[1]);
+    if (jlen > (64u << 20) || blen > (64u << 20)) break;
+    std::string json(jlen, '\0');
+    if (jlen && !read_exact(fd, json.data(), jlen)) break;
+    if (blen) {  // drain unused binary part
+      std::vector<char> sink(blen);
+      if (!read_exact(fd, sink.data(), blen)) break;
+    }
+    std::string method;
+    json_str_field(json, "method", &method);
+    if (method == "fetch_partition") {
+      handle_fetch(fd, json);
+    } else if (method == "ping") {
+      send_response(fd, "{\"ok\":true,\"payload\":{\"native\":true}}", nullptr, 0);
+    } else {
+      send_error(fd, "unknown method on data plane: " + method);
+    }
+  }
+  close(fd);
+}
+
+void accept_loop(int listen_fd) {
+  while (g_running.load()) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int fd = accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &plen);
+    if (fd < 0) {
+      if (!g_running.load()) break;
+      continue;
+    }
+    std::thread(serve_conn, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the bound port (0 on failure).
+int dp_start(const char* work_dir, int port) {
+  if (g_running.load()) return 0;
+  g_work_dir = work_dir;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 128) != 0) {
+    close(fd);
+    return 0;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  g_listen_fd = fd;
+  g_running = true;
+  g_accept_thread = std::thread(accept_loop, fd);
+  return ntohs(addr.sin_port);
+}
+
+void dp_stop() {
+  if (!g_running.exchange(false)) return;
+  int fd = g_listen_fd.exchange(-1);
+  if (fd >= 0) {
+    shutdown(fd, SHUT_RDWR);
+    close(fd);
+  }
+  if (g_accept_thread.joinable()) g_accept_thread.join();
+}
+
+uint64_t dp_bytes_served() { return g_bytes_served.load(); }
+
+}  // extern "C"

@@ -1,0 +1,60 @@
+"""Native data-plane server: build, serve, guard, interop with wire.py."""
+import os
+
+import pytest
+
+from arrow_ballista_tpu import native
+from arrow_ballista_tpu.net import wire
+from arrow_ballista_tpu.net.wire import RemoteError
+
+
+@pytest.fixture(scope="module")
+def dp(tmp_path_factory):
+    lib = native.dataplane()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    work = tmp_path_factory.mktemp("dpwork")
+    (work / "job1" / "1" / "0").mkdir(parents=True)
+    payload = b"arrow-ipc-bytes" * 1000
+    (work / "job1" / "1" / "0" / "data-0.arrow").write_bytes(payload)
+    port = lib.dp_start(str(work).encode(), 0)
+    assert port > 0
+    yield lib, str(work), port, payload
+    lib.dp_stop()
+
+
+def test_native_ping(dp):
+    _, _, port, _ = dp
+    payload, _ = wire.call("127.0.0.1", port, "ping")
+    assert payload.get("native") is True
+
+
+def test_native_fetch(dp):
+    _, work, port, payload = dp
+    path = os.path.join(work, "job1", "1", "0", "data-0.arrow")
+    resp, data = wire.call("127.0.0.1", port, "fetch_partition", {"path": path})
+    assert data == payload
+    assert resp["num_bytes"] == len(payload)
+
+
+def test_native_path_traversal_guard(dp):
+    _, work, port, _ = dp
+    for bad in [os.path.join(work, "..", "etc", "passwd"), "/etc/passwd",
+                work]:  # the work dir itself is not a file under it
+        with pytest.raises(RemoteError):
+            wire.call("127.0.0.1", port, "fetch_partition", {"path": bad})
+
+
+def test_native_missing_file(dp):
+    _, work, port, _ = dp
+    with pytest.raises(RemoteError):
+        wire.call("127.0.0.1", port, "fetch_partition",
+                  {"path": os.path.join(work, "job1", "1", "0", "nope.arrow")})
+
+
+def test_native_bytes_served_counter(dp):
+    lib, work, port, payload = dp
+    before = lib.dp_bytes_served()
+    path = os.path.join(work, "job1", "1", "0", "data-0.arrow")
+    wire.call("127.0.0.1", port, "fetch_partition", {"path": path})
+    assert lib.dp_bytes_served() >= before + len(payload)

@@ -113,43 +113,15 @@ def normalize(df: pd.DataFrame) -> pd.DataFrame:
     return pd.DataFrame(out)
 
 
-def compare(got: pd.DataFrame, want: pd.DataFrame, ordered_cols):
-    got, want = normalize(got), normalize(want)
-    assert got.shape == want.shape, f"shape {got.shape} != {want.shape}\n{got}\n{want}"
-    if not ordered_cols:
-        # no ORDER BY (single-row aggregates in practice) — compare as sets
-        got = got.sort_values(list(got.columns)).reset_index(drop=True)
-        want = want.sort_values(list(want.columns)).reset_index(drop=True)
-    for col in got.columns:
-        g, w = got[col], want[col]
-        if pd.api.types.is_numeric_dtype(g) and pd.api.types.is_numeric_dtype(w):
-            np.testing.assert_allclose(
-                g.to_numpy(dtype=np.float64), w.to_numpy(dtype=np.float64),
-                rtol=1e-6, atol=1e-6, err_msg=f"column {col}")
-        else:
-            assert g.astype(str).tolist() == w.astype(str).tolist(), \
-                f"column {col}:\n{g}\n{w}"
-
-
-def run_query(ctx, oracle, q: int):
-    sql = QUERIES[q]
-    got = ctx.sql(sql).to_pandas()
-    want = pd.read_sql_query(to_sqlite(sql), oracle)
-    has_order = "order by" in sql.lower()
-    # ORDER BY with ties is non-deterministic across engines on non-key
-    # columns; sort both fully to compare content
-    got_s = got.copy()
-    want_s = want.copy()
-    compare(got_s, want_s, ordered_cols=False) if not has_order else \
-        compare_sorted(got_s, want_s)
-
-
-def compare_sorted(got, want):
+def compare_content(got: pd.DataFrame, want: pd.DataFrame):
+    """Multiset equality: both frames fully sorted (ORDER BY ties are
+    nondeterministic across engines, so row order is checked separately by
+    ``check_ordering``)."""
     g, w = normalize(got), normalize(want)
     assert g.shape == w.shape, f"shape {g.shape} != {w.shape}\n{g}\n{w}"
     cols = list(g.columns)
-    g = g.sort_values(cols).reset_index(drop=True)
-    w = w.sort_values(cols).reset_index(drop=True)
+    g = g.sort_values(cols, kind="mergesort").reset_index(drop=True)
+    w = w.sort_values(cols, kind="mergesort").reset_index(drop=True)
     for col in cols:
         gc, wc = g[col], w[col]
         if pd.api.types.is_numeric_dtype(gc) and pd.api.types.is_numeric_dtype(wc):
@@ -159,6 +131,49 @@ def compare_sorted(got, want):
         else:
             assert gc.astype(str).tolist() == wc.astype(str).tolist(), \
                 f"column {col}:\n{gc}\n{wc}"
+
+
+def check_ordering(sql: str, got: pd.DataFrame):
+    """Verify the engine honoured ORDER BY: for every order key that is an
+    output column, rows must be monotone in query order (ties broken by the
+    later keys; a lexicographic stability check over the key prefix)."""
+    from arrow_ballista_tpu.sql import ast as qast
+    from arrow_ballista_tpu.sql.parser import parse_sql
+
+    stmt = parse_sql(sql)
+    if not isinstance(stmt, qast.Select) or not stmt.order_by or len(got) < 2:
+        return
+    keys = []
+    for item in stmt.order_by:
+        e = item.expr
+        if isinstance(e, qast.ColumnRef) and e.table is None and e.name in got.columns:
+            keys.append((e.name, item.ascending))
+        else:
+            return  # expression keys: content check only
+    g = normalize(got[[k for k, _ in keys]])
+    g.columns = [k for k, _ in keys]
+
+    # pairwise lexicographic comparison honoring asc/desc
+    def le(r1, r2):
+        for (k, asc) in keys:
+            v1, v2 = r1[k], r2[k]
+            if v1 == v2:
+                continue
+            return (v1 < v2) if asc else (v1 > v2)
+        return True
+
+    recs = g.to_dict("records")
+    for i in range(len(recs) - 1):
+        assert le(recs[i], recs[i + 1]), \
+            f"ORDER BY violated at row {i}: {recs[i]} !<= {recs[i+1]} for {keys}"
+
+
+def run_query(ctx, oracle, q: int):
+    sql = QUERIES[q]
+    got = ctx.sql(sql).to_pandas()
+    want = pd.read_sql_query(to_sqlite(sql), oracle)
+    compare_content(got.copy(), want.copy())
+    check_ordering(sql, got)
 
 
 @pytest.mark.parametrize("q", sorted(QUERIES))
