@@ -17,9 +17,13 @@ from .sql.planner import Catalog
 from .utils.errors import PlanningError
 
 
-def arrow_schema_to_engine(pa_schema) -> Schema:
+def arrow_schema_to_engine(pa_schema, nullable_by_col=None) -> Schema:
+    """``nullable_by_col`` marks fields whose data actually contains NULLs
+    (from null statistics) — engine nullability means "carries the in-band
+    NULL sentinel", not arrow's everything-nullable default."""
     import pyarrow as pa
 
+    nullable_by_col = nullable_by_col or {}
     fields = []
     for f in pa_schema:
         t = f.type
@@ -45,8 +49,13 @@ def arrow_schema_to_engine(pa_schema) -> Schema:
             dt = DataType("date32")
         else:
             raise PlanningError(f"unsupported arrow type {t} for column {f.name}")
-        fields.append(Field(f.name, dt, f.nullable))
+        fields.append(Field(f.name, dt, bool(nullable_by_col.get(f.name, False))))
     return Schema(fields)
+
+
+def _table_null_stats(table) -> dict:
+    return {name: bool(col.null_count)
+            for name, col in zip(table.column_names, table.columns)}
 
 
 class TableProvider:
@@ -69,7 +78,8 @@ class MemoryTable(TableProvider):
             table = pa.Table.from_pandas(table)
         self.name = name
         self.table = table
-        self.schema = schema or arrow_schema_to_engine(table.schema)
+        self.schema = schema or arrow_schema_to_engine(
+            table.schema, _table_null_stats(table))
 
     def scan(self, projection, filters, target_partitions):
         from .ops.physical import MemoryScanExec
@@ -97,7 +107,30 @@ class ParquetTable(TableProvider):
                 if not files:
                     raise PlanningError(f"no parquet files in {first}")
                 first = files[0]
-            schema = arrow_schema_to_engine(pq.ParquetFile(first).schema_arrow)
+            first_path = self.paths[0]
+            if os.path.isdir(first_path):
+                files = sorted(glob.glob(os.path.join(first_path, "*.parquet")))
+            else:
+                files = list(self.paths)
+            pf = pq.ParquetFile(files[0])
+            # nullability from row-group statistics across EVERY file
+            # (cheap, metadata-only); columns without stats are
+            # conservatively nullable
+            nullable: Dict[str, bool] = {}
+            for fpath in files:
+                meta = pq.ParquetFile(fpath).metadata
+                for ci in range(meta.num_columns):
+                    col_name = meta.schema.column(ci).name
+                    if nullable.get(col_name):
+                        continue
+                    has_nulls = False
+                    for rg in range(meta.num_row_groups):
+                        st = meta.row_group(rg).column(ci).statistics
+                        if st is None or st.null_count is None or st.null_count > 0:
+                            has_nulls = True
+                            break
+                    nullable[col_name] = has_nulls
+            schema = arrow_schema_to_engine(pf.schema_arrow, nullable)
         self.schema = schema
         self._rows: Optional[int] = None
 
@@ -131,7 +164,16 @@ class CsvTable(TableProvider):
                 self.paths[0],
                 parse_options=pacsv.ParseOptions(delimiter=delimiter),
             )
-            schema = arrow_schema_to_engine(table.schema)
+            import os as osmod
+
+            multi = len(self.paths) > 1 or osmod.path.isdir(self.paths[0])
+            if multi:
+                # only the first file was sampled; other files may hold
+                # NULLs, so be conservative
+                nulls = {name: True for name in table.column_names}
+            else:
+                nulls = _table_null_stats(table)
+            schema = arrow_schema_to_engine(table.schema, nulls)
         self.schema = schema
 
     def scan(self, projection, filters, target_partitions):

@@ -96,6 +96,8 @@ class RemoteDataFrame:
     def to_arrow(self):
         import pyarrow as pa
 
+        if self._static is not None:
+            return pa.Table.from_pandas(self._static)
         tables = [b.to_arrow() for b in self.collect() if b.num_rows > 0]
         return pa.concat_tables(tables) if tables else pa.table({})
 
@@ -169,6 +171,9 @@ class BallistaContext:
         self.catalog.register(CsvTable(name, path, schema, delimiter, has_header))
 
     def deregister_table(self, name: str) -> None:
+        if self._remote is not None:
+            self._remote.deregister_table(name)
+            return
         self.catalog.deregister(name)
 
     # --- SQL ------------------------------------------------------------

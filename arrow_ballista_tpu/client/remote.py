@@ -53,6 +53,9 @@ class RemoteCluster:
         payload, _ = self._call("table_schema", {"name": name})
         return serde.schema_from_obj(payload["schema"])
 
+    def deregister_table(self, name: str) -> None:
+        self._call("deregister_table", {"name": name})
+
     # --- query execution -------------------------------------------------
     def execute_sql(self, sql: str, timeout: float = 600.0) -> List[ColumnBatch]:
         payload, _ = self._call("execute_query",

@@ -70,7 +70,8 @@ class Executor:
                 tid.job_id, tid.stage_id, task.plan, self.work_dir)
             ctx = TaskContext(config=self.config, scalars=dict(task.scalars),
                               work_dir=self.work_dir, job_id=tid.job_id,
-                              stage_id=tid.stage_id)
+                              stage_id=tid.stage_id,
+                              executor_id=self.metadata.executor_id)
             start_ms = int(time.time() * 1000)
             writes = stage_exec.execute_query_stage(tid.partition, ctx)
             end_ms = int(time.time() * 1000)

@@ -1,0 +1,180 @@
+"""Mesh-fused operators: whole stage *pairs* as one XLA program.
+
+Where the reference always materializes the exchange (partial-agg tasks ->
+shuffle files -> final-agg tasks; planner.rs:80-165 + shuffle_writer.rs),
+the TPU-native fast path executes
+
+    derive keys/values -> partial agg -> ICI all_to_all -> final agg
+
+as a single compiled program over the jax.sharding.Mesh
+(parallel/distributed.py): XLA overlaps the collective with compute, no
+byte touches the host or disk.  Enabled per-session via
+``ballista.shuffle.mesh``; the planner falls back to the file-shuffle
+stage pair whenever the pattern doesn't fit (SURVEY.md §2.5 "fuse
+co-located stages").
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models import expr as E
+from ..models.batch import ColumnBatch, concat_batches
+from ..models.schema import Field, Schema
+from ..utils.config import AGG_CAPACITY
+from ..utils.errors import CapacityError
+from .expressions import ExprCompiler
+from .operators import AggSpec, HashAggregateExec
+from .physical import ExecutionPlan, Partitioning, TaskContext
+
+
+class MeshAggregateExec(ExecutionPlan):
+    """Fused grouped aggregation over every local device.
+
+    Replaces HashAggregateExec(final) <- Repartition(hash) <-
+    HashAggregateExec(partial) when the mesh path is enabled.  Output is a
+    single partition holding all groups (device d owns the key-hash
+    bucket d; results are concatenated on fetch).
+    """
+
+    def __init__(self, input: ExecutionPlan, group_exprs: List[Tuple[E.Expr, str]],
+                 aggs: List[AggSpec]):
+        self.input = input
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+        in_schema = input.schema
+        fields = [Field(n, e.dtype(in_schema)) for e, n in group_exprs]
+        ref = HashAggregateExec(input, group_exprs, aggs, mode="single")
+        for a in aggs:
+            fields.append(ref.schema.field(a.name))
+        self._schema = Schema(fields)
+        self._compiled = None
+
+    @staticmethod
+    def eligible(group_exprs, aggs, in_schema) -> bool:
+        if not group_exprs:
+            return False  # global aggregates: the plain path is already cheap
+        for a in aggs:
+            if a.func not in ("sum", "count", "min", "max"):
+                return False
+            if a.operand is not None:
+                if isinstance(a.operand, E.Column) and a.operand.name in in_schema \
+                        and in_schema.field(a.operand.name).nullable:
+                    return False  # sentinel-skipping not fused yet
+                try:
+                    if a.operand.dtype(in_schema).is_float:
+                        return False
+                except Exception:  # noqa: BLE001
+                    return False
+        for e, _ in group_exprs:
+            try:
+                if e.dtype(in_schema).is_float:
+                    return False
+            except Exception:  # noqa: BLE001
+                return False
+        return True
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return 1
+
+    def output_partitioning(self):
+        return Partitioning.single()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        from ..parallel.distributed import distributed_filter_aggregate
+        from ..parallel.mesh import make_mesh, row_sharding
+
+        assert partition == 0
+        in_schema = self.input.schema
+        batches = []
+        for p in range(self.input.output_partition_count()):
+            batches.extend(self.input.execute(p, ctx))
+        big = concat_batches(in_schema, batches)
+
+        n_dev = len(jax.devices())
+        mesh = make_mesh(n_dev)
+
+        if self._compiled is None:
+            comp = ExprCompiler(in_schema, "device")
+            key_c = [(comp.compile(e), n) for e, n in self.group_exprs]
+            val_c = []
+            for a in self.aggs:
+                cc = comp.compile(a.operand) if a.operand is not None else None
+                val_c.append((cc, a))
+            self._compiled = (comp, key_c, val_c)
+        comp, key_c, val_c = self._compiled
+        aux = comp.aux_arrays(big.dicts)  # replicated constants in the program
+
+        key_names = [n for _, n in key_c]
+        agg_specs = []
+        for cc, a in val_c:
+            agg_specs.append((a.name, "count" if a.func == "count" else a.func))
+
+        def derive(cols, mask):
+            out = {}
+            for kc, n in key_c:
+                out[n] = kc.fn(cols, aux)
+            for cc, a in val_c:
+                if cc is None or a.func == "count":
+                    out[a.name] = jnp.ones(mask.shape, jnp.int64)
+                else:
+                    v = cc.fn(cols, aux)
+                    out[a.name] = jnp.broadcast_to(v, mask.shape) if v.ndim == 0 else v
+            return out, mask
+
+        # shard rows over the mesh (pad to a multiple of the device count)
+        rows = big.capacity
+        per = -(-rows // n_dev)
+        padded = per * n_dev
+        sharding = row_sharding(mesh)
+
+        def shard(arr, fill=0):
+            if padded != rows:
+                pad = jnp.full((padded - rows,), fill, arr.dtype)
+                arr = jnp.concatenate([arr, pad])
+            return jax.device_put(arr, sharding)
+
+        cols = {k: shard(v) for k, v in big.columns.items()}
+        mask = shard(big.mask, fill=False)
+
+        cap = ctx.config.get(AGG_CAPACITY)
+        # partial states are bounded by the shard size; the final aggregate
+        # is NOT (hash skew can land every group on one device), so its
+        # bound must respond to the config knob
+        partial_cap = max(256, min(cap, padded // n_dev + 1))
+        final_cap = max(256, min(cap, padded + 1))
+        run = distributed_filter_aggregate(
+            mesh, derive, key_names, agg_specs,
+            partial_capacity=partial_cap, final_capacity=final_cap)
+        fk, fv, fmask, overflow = run(cols, mask)
+        if bool(overflow):
+            raise CapacityError(
+                f"mesh aggregation exceeded its group capacity "
+                f"(partial {partial_cap}/device, final {final_cap}/device); "
+                f"raise {AGG_CAPACITY}")
+
+        out_cols: Dict[str, jnp.ndarray] = {}
+        dicts: Dict[str, np.ndarray] = {}
+        for (kc, name), arr in zip(key_c, fk):
+            out_cols[name] = arr
+            if kc.dict_fn is not None:
+                dicts[name] = kc.dict_fn(big.dicts)
+        for (cc, a), arr in zip(val_c, fv):
+            want = self._schema.field(a.name).dtype.np_dtype
+            out_cols[a.name] = arr.astype(want) if arr.dtype != want else arr
+        result = ColumnBatch(self._schema, out_cols, fmask, dicts)
+        self.metrics().add("output_rows", result.num_rows)
+        self.metrics().add("mesh_devices", n_dev)
+        return [result]
+
+    def _label(self):
+        g = ", ".join(n for _, n in self.group_exprs)
+        a = ", ".join(f"{x.func}({x.name})" for x in self.aggs)
+        return f"MeshAggregateExec(fused partial+all_to_all+final): groupBy=[{g}] aggr=[{a}]"

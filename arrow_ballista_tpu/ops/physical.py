@@ -68,6 +68,7 @@ class TaskContext:
     work_dir: str = "/tmp/ballista_tpu"
     job_id: str = ""
     stage_id: int = 0
+    executor_id: str = ""  # identity of the executing node (shuffle locality)
     # shuffle partition locations: (stage_id, partition) -> list of paths/addrs
     shuffle_locations: Dict = dataclasses.field(default_factory=dict)
 
@@ -177,8 +178,15 @@ def table_to_physical(table, schema: Schema):
             a = arr
             if not pa.types.is_date32(a.type if not isinstance(a, pa.ChunkedArray) else a.type):
                 a = a.cast(pa.date32())
-            cols[f.name] = a.cast(pa.int32()).to_numpy(zero_copy_only=False).astype(np.int32)
+            a = a.cast(pa.int32())
+            if a.null_count:
+                a = pc.fill_null(a, int(f.dtype.null_sentinel))
+            cols[f.name] = a.to_numpy(zero_copy_only=False).astype(np.int32)
         elif f.dtype.is_decimal:
+            if arr.null_count:
+                raise ExecutionError(
+                    f"decimal column {f.name} contains NULLs, which have no "
+                    f"in-band representation yet")
             fl = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
             scaled = np.round(fl * (10 ** f.dtype.scale))
             if np.any(np.abs(scaled) > 2**52):
@@ -187,7 +195,19 @@ def table_to_physical(table, schema: Schema):
                 )
             cols[f.name] = scaled.astype(np.int64)
         else:
-            cols[f.name] = arr.to_numpy(zero_copy_only=False).astype(f.dtype.np_dtype)
+            a = arr
+            if a.null_count:
+                # real input NULLs -> the per-dtype in-band sentinel; the
+                # field must be declared nullable for aggregate/IS NULL
+                # semantics to see them (providers set this from null stats)
+                sent = f.dtype.null_sentinel
+                if isinstance(sent, float):
+                    a = a.cast(pa.float64())
+                    vals = a.to_numpy(zero_copy_only=False)  # nulls -> NaN
+                    cols[f.name] = vals.astype(f.dtype.np_dtype)
+                    continue
+                a = pc.fill_null(a, int(sent) if not isinstance(sent, bool) else sent)
+            cols[f.name] = a.to_numpy(zero_copy_only=False).astype(f.dtype.np_dtype)
     return cols, dicts
 
 

@@ -185,13 +185,19 @@ class ShuffleReaderExec(ExecutionPlan):
         for loc in locs:
             if loc.num_rows == 0:
                 continue  # skip empty map outputs
-            if os.path.exists(loc.path):
-                paths.append(loc.path)  # local fast path (shuffle_reader.rs:316)
-            elif loc.port:
-                remote.append(loc)
+            # local fast path (shuffle_reader.rs:316) gated on executor
+            # IDENTITY, not file existence: a same-named path on a different
+            # machine may be a stale leftover.  port==0 means the deployment
+            # has no data plane (in-proc / shared fs), where the path is
+            # authoritative.
+            if loc.executor_id == ctx.executor_id or loc.port == 0:
+                if not os.path.exists(loc.path):
+                    raise FetchFailedError(
+                        loc.executor_id, self.stage_id, loc.map_partition,
+                        f"shuffle file missing: {loc.path}")
+                paths.append(loc.path)
             else:
-                raise FetchFailedError(loc.executor_id, self.stage_id, loc.map_partition,
-                                       f"shuffle file missing: {loc.path}")
+                remote.append(loc)
         with self.metrics().timer("fetch_time"):
             batches = read_ipc_files(paths, self._schema, capacity=ctx.config.batch_size)
             for loc in remote:

@@ -1,0 +1,58 @@
+"""Graphviz export of an ExecutionGraph.
+
+Parity: reference ballista/scheduler/src/state/execution_graph_dot.rs —
+stages as clusters with per-operator nodes, shuffle edges between stages,
+stage state/task-progress in the cluster label.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..ops.shuffle import ShuffleReaderExec, UnresolvedShuffleExec
+from .execution_graph import ExecutionGraph
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"').replace("\n", "\\n")
+
+
+def graph_to_dot(graph: ExecutionGraph) -> str:
+    lines: List[str] = [
+        "digraph G {",
+        '  rankdir=BT;',
+        '  node [shape=box, fontname="monospace", fontsize=10];',
+        f'  label="job {graph.job_id} [{graph.status}]";',
+    ]
+    # operator nodes per stage cluster
+    for sid in sorted(graph.stages):
+        stage = graph.stages[sid]
+        done = sum(1 for t in stage.task_infos if t and t.state == "success")
+        lines.append(f"  subgraph cluster_{sid} {{")
+        lines.append(f'    label="stage {sid} [{stage.state}] '
+                     f'{done}/{stage.partitions} tasks '
+                     f'attempt {stage.stage_attempt}";')
+        plan = stage.resolved_plan or stage.plan
+        counter = [0]
+
+        def walk(node, parent_id=None, sid=sid, counter=counter, out=lines):
+            nid = f"s{sid}_n{counter[0]}"
+            counter[0] += 1
+            out.append(f'    {nid} [label="{_esc(node._label())}"];')
+            if parent_id is not None:
+                out.append(f"    {nid} -> {parent_id};")
+            if not isinstance(node, (ShuffleReaderExec, UnresolvedShuffleExec)):
+                for c in node.children():
+                    walk(c, nid)
+            return nid
+
+        walk(plan)
+        lines.append("  }")
+    # shuffle edges between stages
+    for sid in sorted(graph.stages):
+        for pid in graph.stages[sid].producer_ids:
+            lines.append(f"  cluster_edge_{pid}_{sid} [style=invis, width=0, "
+                         f"label=\"\"];")
+            lines.append(f'  s{pid}_n0 -> s{sid}_n0 [style=dashed, '
+                         f'label="shuffle"];')
+    lines.append("}")
+    return "\n".join(lines)

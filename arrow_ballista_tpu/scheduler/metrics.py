@@ -1,0 +1,117 @@
+"""Scheduler metrics: counters/histograms + prometheus text exposition.
+
+Parity: reference ballista/scheduler/src/metrics/ — the
+``SchedulerMetricsCollector`` trait (mod.rs:10-66) with its Prometheus
+implementation (prometheus.rs:41-176: job_exec_time_seconds,
+planning_time_seconds histograms; submitted/completed/failed/cancelled
+counters; pending_task_queue_size gauge) and the Noop default.  Metric
+names match docs/source/user-guide/metrics.md so reference dashboards
+port over unchanged.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+_BUCKETS = [0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0]
+
+
+class Histogram:
+    def __init__(self, buckets: Optional[List[float]] = None):
+        self.buckets = buckets or _BUCKETS
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        self.n += 1
+        self.total += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class SchedulerMetricsCollector:
+    """Trait (reference metrics/mod.rs:10-66)."""
+
+    def record_submitted(self, job_id: str, queued_at_ms: int, submitted_at_ms: int) -> None: ...
+    def record_completed(self, job_id: str, queued_at_ms: int, completed_at_ms: int) -> None: ...
+    def record_failed(self, job_id: str) -> None: ...
+    def record_cancelled(self, job_id: str) -> None: ...
+    def set_pending_tasks_queue_size(self, value: int) -> None: ...
+    def gather(self) -> str:
+        return ""
+
+
+class NoopMetricsCollector(SchedulerMetricsCollector):
+    pass
+
+
+class InMemoryMetricsCollector(SchedulerMetricsCollector):
+    """Collects + renders prometheus text exposition format."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.cancelled = 0
+        self.pending_tasks = 0
+        self.planning_time = Histogram([0.01, 0.05, 0.1, 0.5, 1.0, 5.0])
+        self.exec_time = Histogram()
+
+    def record_submitted(self, job_id, queued_at_ms, submitted_at_ms):
+        with self._lock:
+            self.submitted += 1
+            self.planning_time.observe(max(0.0, (submitted_at_ms - queued_at_ms) / 1e3))
+
+    def record_completed(self, job_id, queued_at_ms, completed_at_ms):
+        with self._lock:
+            self.completed += 1
+            self.exec_time.observe(max(0.0, (completed_at_ms - queued_at_ms) / 1e3))
+
+    def record_failed(self, job_id):
+        with self._lock:
+            self.failed += 1
+
+    def record_cancelled(self, job_id):
+        with self._lock:
+            self.cancelled += 1
+
+    def set_pending_tasks_queue_size(self, value):
+        with self._lock:
+            self.pending_tasks = value
+
+    def gather(self) -> str:
+        with self._lock:
+            lines = []
+
+            def counter(name, v, help_):
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {v}")
+
+            counter("job_submitted_total", self.submitted, "jobs submitted")
+            counter("job_completed_total", self.completed, "jobs completed")
+            counter("job_failed_total", self.failed, "jobs failed")
+            counter("job_cancelled_total", self.cancelled, "jobs cancelled")
+            lines.append("# HELP pending_task_queue_size pending tasks")
+            lines.append("# TYPE pending_task_queue_size gauge")
+            lines.append(f"pending_task_queue_size {self.pending_tasks}")
+            for name, h, help_ in [
+                ("planning_time_seconds", self.planning_time, "job planning time"),
+                ("job_exec_time_seconds", self.exec_time, "job execution time"),
+            ]:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} histogram")
+                acc = 0
+                for b, c in zip(h.buckets, h.counts):
+                    acc += c
+                    lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {h.n}')
+                lines.append(f"{name}_sum {h.total}")
+                lines.append(f"{name}_count {h.n}")
+            return "\n".join(lines) + "\n"

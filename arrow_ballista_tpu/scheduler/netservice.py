@@ -59,11 +59,19 @@ class NetTaskLauncher(TaskLauncher):
 class SchedulerNetService:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  config: Optional[BallistaConfig] = None,
-                 scheduler_config: Optional[SchedulerConfig] = None):
+                 scheduler_config: Optional[SchedulerConfig] = None,
+                 rest_port: Optional[int] = None,
+                 state_dir: Optional[str] = None):
         self.config = config or BallistaConfig()
         self.catalog = SchemaCatalog()
         launcher = NetTaskLauncher()
-        self.server = SchedulerServer(launcher, scheduler_config)
+        job_backend = None
+        if state_dir:
+            from .persistence import FileJobStateBackend
+
+            job_backend = FileJobStateBackend(state_dir)
+        self.server = SchedulerServer(launcher, scheduler_config,
+                                      job_backend=job_backend)
         launcher.scheduler = self.server
         self.rpc = RpcServer(host, port)
         self.host, self.port = self.rpc.host, self.rpc.port
@@ -88,15 +96,31 @@ class SchedulerNetService:
         r("register_external_table", self._register_external_table)
         r("list_tables", self._list_tables)
         r("table_schema", self._table_schema)
+        r("deregister_table", self._deregister_table)
         r("ping", lambda p, b: ({}, b""))
 
+        self.rest = None
+        if rest_port is not None:
+            from .rest import RestApi
+
+            self.rest = RestApi(self.server, host, rest_port)
+
     def start(self) -> None:
+        import time as _time
+
+        self.server._started_at = int(_time.time())
         self.server.init()
         self.rpc.start()
+        if self.rest is not None:
+            self.rest.start()
+        if self.server.job_backend is not None:
+            self.server.recover_jobs()
 
     def stop(self) -> None:
         self.server.shutdown()
         self.rpc.stop()
+        if self.rest is not None:
+            self.rest.stop()
 
     # --- query handling --------------------------------------------------
     def _execute_query(self, payload: dict, _bin: bytes):
@@ -198,3 +222,7 @@ class SchedulerNetService:
     def _table_schema(self, payload: dict, _bin: bytes):
         schema = self.catalog.table_schema(payload["name"])
         return {"schema": serde.schema_to_obj(schema)}, b""
+
+    def _deregister_table(self, payload: dict, _bin: bytes):
+        self.catalog.deregister(payload["name"])
+        return {}, b""

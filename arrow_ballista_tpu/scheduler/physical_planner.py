@@ -25,7 +25,7 @@ from ..models import logical as L
 from ..ops import operators as O
 from ..ops.physical import ExecutionPlan, Partitioning
 from ..ops.shuffle import RepartitionExec
-from ..utils.config import BROADCAST_THRESHOLD, BallistaConfig
+from ..utils.config import BROADCAST_THRESHOLD, MESH_SHUFFLE, BallistaConfig
 from ..utils.errors import PlanningError
 
 
@@ -150,6 +150,15 @@ class PhysicalPlanner:
         single_input = child.output_partition_count() <= 1
         if single_input:
             return O.HashAggregateExec(child, groups, specs, mode="single")
+
+        # TPU fast path: fuse partial agg -> all_to_all -> final agg into one
+        # XLA program over the local device mesh (ops/mesh_exec.py) instead
+        # of a file-shuffle stage pair
+        if self.config.get(MESH_SHUFFLE):
+            from ..ops.mesh_exec import MeshAggregateExec
+
+            if MeshAggregateExec.eligible(groups, specs, child.schema):
+                return MeshAggregateExec(child, groups, specs)
 
         partial = O.HashAggregateExec(child, groups, specs, mode="partial")
         if groups:

@@ -1,0 +1,156 @@
+"""REST API for the scheduler: cluster state, jobs, stages, dot, metrics.
+
+Parity: reference ballista/scheduler/src/api/ (warp routes under /api,
+api/mod.rs:85-137 + handlers.rs):
+
+    GET  /api/state            cluster summary
+    GET  /api/executors        executor metadata + heartbeats
+    GET  /api/jobs             job list with status + progress
+    GET  /api/job/<id>/stages  per-stage task progress
+    GET  /api/job/<id>/dot     graphviz of the execution graph
+    PATCH /api/job/<id>        cancel (body ignored)
+    GET  /api/metrics          prometheus text exposition
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .graph_dot import graph_to_dot
+from .scheduler import SchedulerServer
+
+
+class RestApi:
+    def __init__(self, server: SchedulerServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: str, ctype="application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    outer._route_get(self)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, json.dumps({"error": str(e)}))
+
+            def do_PATCH(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[0] == "api" and parts[1] == "job":
+                    outer.server.cancel_job(parts[2])
+                    self._send(200, json.dumps({"cancelled": parts[2]}))
+                else:
+                    self._send(404, json.dumps({"error": "not found"}))
+
+        self.server = server
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=f"rest-{self.port}", daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # --- routing ---------------------------------------------------------
+    def _route_get(self, h) -> None:
+        parts = h.path.strip("/").split("/")
+        if parts[:1] != ["api"]:
+            h._send(404, json.dumps({"error": "not found"}))
+            return
+        rest = parts[1:]
+        if rest == ["state"]:
+            h._send(200, json.dumps(self._state()))
+        elif rest == ["executors"]:
+            h._send(200, json.dumps(self._executors()))
+        elif rest == ["jobs"]:
+            h._send(200, json.dumps(self._jobs()))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "stages":
+            h._send(200, json.dumps(self._stages(rest[1])))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "dot":
+            graph = self.server.jobs.get_graph(rest[1])
+            if graph is None:
+                h._send(404, json.dumps({"error": "no such job"}))
+            else:
+                h._send(200, graph_to_dot(graph), ctype="text/vnd.graphviz")
+        elif rest == ["metrics"]:
+            h._send(200, self.server.metrics.gather(), ctype="text/plain")
+        else:
+            h._send(404, json.dumps({"error": "not found"}))
+
+    # --- payloads --------------------------------------------------------
+    def _state(self) -> dict:
+        cluster = self.server.cluster
+        return {
+            "executors": len(cluster.executors()),
+            "alive_executors": len(cluster.alive_executors()),
+            "available_task_slots": cluster.total_available(),
+            "pending_tasks": self.server.pending_task_count(),
+            "started_at": getattr(self.server, "_started_at", 0),
+        }
+
+    def _executors(self) -> list:
+        cluster = self.server.cluster
+        out = []
+        for meta in cluster.executors():
+            hb = cluster._heartbeats.get(meta.executor_id)
+            out.append({
+                "executor_id": meta.executor_id, "host": meta.host,
+                "port": meta.port, "grpc_port": meta.grpc_port,
+                "task_slots": meta.task_slots,
+                "last_seen_s_ago": round(time.time() - hb.timestamp, 1) if hb else None,
+                "status": hb.status if hb else "unknown",
+            })
+        return out
+
+    def _jobs(self) -> list:
+        out = []
+        with self.server.jobs._lock:
+            statuses = dict(self.server.jobs._status)
+        for job_id, st in statuses.items():
+            entry = {"job_id": job_id, "state": st.state, "error": st.error}
+            graph = self.server.jobs.get_graph(job_id)
+            if graph is not None:
+                total = sum(s.partitions for s in graph.stages.values())
+                done = sum(
+                    1 for s in graph.stages.values()
+                    for t in s.task_infos if t and t.state == "success")
+                entry["stages"] = len(graph.stages)
+                entry["tasks_completed"] = done
+                entry["tasks_total"] = total
+            out.append(entry)
+        return out
+
+    def _stages(self, job_id: str) -> list:
+        graph = self.server.jobs.get_graph(job_id)
+        if graph is None:
+            return []
+        out = []
+        for sid in sorted(graph.stages):
+            s = graph.stages[sid]
+            out.append({
+                "stage_id": sid, "state": s.state,
+                "partitions": s.partitions,
+                "completed": sum(1 for t in s.task_infos
+                                 if t and t.state == "success"),
+                "attempt": s.stage_attempt,
+                "producers": s.producer_ids,
+                "consumers": s.output_links,
+                "plan": (s.resolved_plan or s.plan).display(),
+            })
+        return out

@@ -67,6 +67,13 @@ class JobQueued:
 
 
 @dataclasses.dataclass
+class JobPlanned:
+    job_id: str
+    graph: Optional[ExecutionGraph]
+    error: str = ""
+
+
+@dataclasses.dataclass
 class TaskUpdating:
     executor_id: str
     statuses: List[TaskStatus]
@@ -101,11 +108,24 @@ class SchedulerConfig:
 
 class SchedulerServer:
     def __init__(self, launcher: TaskLauncher,
-                 config: Optional[SchedulerConfig] = None):
+                 config: Optional[SchedulerConfig] = None,
+                 metrics: Optional["SchedulerMetricsCollector"] = None,
+                 job_backend=None, scheduler_id: Optional[str] = None):
+        import uuid
+
+        from .metrics import InMemoryMetricsCollector
+
         self.config = config or SchedulerConfig()
         self.cluster = ClusterState(self.config.task_distribution)
         self.jobs = JobState()
         self.launcher = launcher
+        self.metrics = metrics if metrics is not None else InMemoryMetricsCollector()
+        # optional persistence: checkpoint graphs on every transition so a
+        # restarted/sibling scheduler can adopt them (reference JobState
+        # backends + try_acquire_job)
+        self.job_backend = job_backend
+        self.scheduler_id = scheduler_id or f"scheduler-{uuid.uuid4().hex[:8]}"
+        self._queued_at_ms: Dict[str, int] = {}
         self._event_loop = EventLoop("scheduler-events", self._on_event,
                                      self.config.event_buffer_size)
         self._launch_pool = ThreadPoolExecutor(max_workers=8,
@@ -144,6 +164,7 @@ class SchedulerServer:
     def submit_job(self, job_id: str,
                    plan_fn: Callable[[], Tuple[object, Dict[str, object]]]) -> None:
         self.jobs.accept_job(job_id)
+        self._queued_at_ms[job_id] = int(time.time() * 1000)
         self._event_loop.post(JobQueued(job_id, plan_fn))
 
     def update_task_status(self, executor_id: str,
@@ -166,6 +187,8 @@ class SchedulerServer:
     def _on_event(self, event: object) -> None:
         if isinstance(event, JobQueued):
             self._on_job_queued(event)
+        elif isinstance(event, JobPlanned):
+            self._on_job_planned(event)
         elif isinstance(event, TaskUpdating):
             self._on_task_updating(event)
         elif isinstance(event, ExecutorLost):
@@ -178,18 +201,68 @@ class SchedulerServer:
             log.warning("unknown scheduler event %r", event)
 
     def _on_job_queued(self, ev: JobQueued) -> None:
-        try:
-            plan, scalars = ev.plan_fn()
-            graph = ExecutionGraph.build(ev.job_id, plan)
-            graph.scalars = scalars
-            graph.addr_resolver = self._resolve_addr
-        except Exception as e:  # noqa: BLE001 — planning failures fail the job
-            log.exception("planning failed for job %s", ev.job_id)
-            self.jobs.set_status(JobStatus(ev.job_id, "failed",
-                                           error=f"planning error: {e}"))
+        # planning (incl. scalar subquery evaluation) can take seconds —
+        # run it off the event loop so scheduling stays responsive
+        # (reference spawns planning too, query_stage_scheduler.rs:106-148)
+        def plan():
+            try:
+                plan, scalars = ev.plan_fn()
+                graph = ExecutionGraph.build(ev.job_id, plan)
+                graph.scalars = scalars
+                graph.addr_resolver = self._resolve_addr
+                self._event_loop.post(JobPlanned(ev.job_id, graph))
+            except Exception as e:  # noqa: BLE001 — planning failure fails the job
+                log.exception("planning failed for job %s", ev.job_id)
+                self._event_loop.post(JobPlanned(ev.job_id, None,
+                                                 f"planning error: {e}"))
+
+        self._launch_pool.submit(plan)
+
+    def _on_job_planned(self, ev: JobPlanned) -> None:
+        if ev.graph is None:
+            self.jobs.set_status(JobStatus(ev.job_id, "failed", error=ev.error))
+            self.metrics.record_failed(ev.job_id)
+            self._queued_at_ms.pop(ev.job_id, None)
             return
-        self.jobs.submit_job(ev.job_id, graph)
+        self.jobs.submit_job(ev.job_id, ev.graph)
+        self.metrics.record_submitted(ev.job_id,
+                                      self._queued_at_ms.get(ev.job_id, 0),
+                                      int(time.time() * 1000))
+        self._checkpoint(ev.graph)
         self._offer()
+
+    def _checkpoint(self, graph: ExecutionGraph) -> None:
+        if self.job_backend is None:
+            return
+        try:
+            self.job_backend.try_acquire_job(graph.job_id, self.scheduler_id)
+            self.job_backend.save_job(graph)
+        except Exception:  # noqa: BLE001 — persistence is best-effort
+            log.exception("job checkpoint failed for %s", graph.job_id)
+
+    def recover_jobs(self) -> List[str]:
+        """Adopt persisted unfinished jobs (reference try_acquire_job,
+        cluster/mod.rs:347-350).  Call after init() once executors have a
+        chance to re-register."""
+        if self.job_backend is None:
+            return []
+        adopted = []
+        for job_id in self.job_backend.list_jobs():
+            if self.jobs.get_status(job_id) is not None:
+                continue
+            if not self.job_backend.try_acquire_job(job_id, self.scheduler_id):
+                continue
+            graph = self.job_backend.load_job(job_id)
+            if graph is None or graph.status != "running":
+                continue
+            graph.addr_resolver = self._resolve_addr
+            self.jobs.accept_job(job_id)
+            self.jobs.submit_job(job_id, graph)
+            adopted.append(job_id)
+            log.info("adopted persisted job %s", job_id)
+        if adopted:
+            self._event_loop.post(Offer())
+        return adopted
 
     def _on_task_updating(self, ev: TaskUpdating) -> None:
         self.cluster.free_slots(ev.executor_id, len(ev.statuses))
@@ -204,10 +277,16 @@ class SchedulerServer:
                 if kind == "job_successful":
                     self.jobs.set_status(
                         JobStatus(job_id, "successful", locations=payload))
+                    self.metrics.record_completed(
+                        job_id, self._queued_at_ms.pop(job_id, 0),
+                        int(time.time() * 1000))
                 elif kind == "job_failed":
                     self.jobs.set_status(
                         JobStatus(job_id, "failed", error=str(payload)))
+                    self.metrics.record_failed(job_id)
+                    self._queued_at_ms.pop(job_id, None)
                     self._cancel_running(graph)
+            self._checkpoint(graph)
         self._offer()
 
     def _on_executor_lost(self, ev: ExecutorLost) -> None:
@@ -223,6 +302,8 @@ class SchedulerServer:
             return
         graph.cancel()
         self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
+        self.metrics.record_cancelled(ev.job_id)
+        self._queued_at_ms.pop(ev.job_id, None)
         self._cancel_running(graph)
 
     def _cancel_running(self, graph: ExecutionGraph) -> None:
@@ -243,6 +324,7 @@ class SchedulerServer:
         state/mod.rs:195-233 offer_reservation + fill_reservations)."""
         alive = set(self.cluster.alive_executors(self.config.executor_timeout_s))
         pending = self.pending_task_count()
+        self.metrics.set_pending_tasks_queue_size(pending)
         if pending == 0 or not alive:
             return
         reservations = self.cluster.reserve_slots(pending, sorted(alive))

@@ -16,6 +16,11 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="arrow_ballista_tpu scheduler")
     ap.add_argument("--bind-host", default="0.0.0.0")
     ap.add_argument("--bind-port", type=int, default=50050)
+    ap.add_argument("--rest-port", type=int, default=50051,
+                    help="HTTP REST API port (-1 disables)")
+    ap.add_argument("--state-dir", default=None,
+                    help="persist job graphs here for crash recovery / "
+                         "multi-scheduler adoption")
     ap.add_argument("--task-distribution", choices=["bias", "round-robin"],
                     default="bias")
     ap.add_argument("--executor-timeout-s", type=float, default=180.0)
@@ -37,9 +42,12 @@ def main(argv=None) -> None:
             {"ballista.shuffle.partitions": str(args.shuffle_partitions)}),
         scheduler_config=SchedulerConfig(
             task_distribution=args.task_distribution,
-            executor_timeout_s=args.executor_timeout_s))
+            executor_timeout_s=args.executor_timeout_s),
+        rest_port=None if args.rest_port < 0 else args.rest_port,
+        state_dir=args.state_dir)
     svc.start()
-    logging.info("scheduler listening on %s:%s", svc.host, svc.port)
+    logging.info("scheduler listening on %s:%s (rest: %s)", svc.host, svc.port,
+                 svc.rest.port if svc.rest else "disabled")
 
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))

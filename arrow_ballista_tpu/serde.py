@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 from .models import expr as E
 from .models.schema import DataType, Field, Schema
 from .ops import operators as O
+from .ops.mesh_exec import MeshAggregateExec
 from .ops import physical as P
 from .ops import shuffle as SH
 from .ops.shuffle import PartitionLocation, ShuffleWritePartition
@@ -221,6 +222,11 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
         return {"t": "limit", "input": plan_to_obj(p.input), "n": p.n}
     if isinstance(p, O.CoalescePartitionsExec):
         return {"t": "coalesce", "input": plan_to_obj(p.input)}
+    if isinstance(p, MeshAggregateExec):
+        return {"t": "meshagg", "input": plan_to_obj(p.input),
+                "groups": [[expr_to_obj(e), n] for e, n in p.group_exprs],
+                "aggs": [{"func": a.func, "operand": expr_to_obj(a.operand),
+                          "name": a.name} for a in p.aggs]}
     if isinstance(p, SH.ShuffleWriterExec):
         return {"t": "shufflewrite", "input": plan_to_obj(p.input),
                 "partitioning": partitioning_to_obj(p.partitioning),
@@ -291,6 +297,12 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
         return O.LimitExec(plan_from_obj(o["input"]), o["n"])
     if t == "coalesce":
         return O.CoalescePartitionsExec(plan_from_obj(o["input"]))
+    if t == "meshagg":
+        return MeshAggregateExec(
+            plan_from_obj(o["input"]),
+            [(expr_from_obj(e), n) for e, n in o["groups"]],
+            [O.AggSpec(a["func"], expr_from_obj(a["operand"]), a["name"])
+             for a in o["aggs"]])
     if t == "shufflewrite":
         return SH.ShuffleWriterExec(plan_from_obj(o["input"]),
                                     partitioning_from_obj(o["partitioning"]),
@@ -307,6 +319,82 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
         return SH.RepartitionExec(plan_from_obj(o["input"]),
                                   partitioning_from_obj(o["partitioning"]))
     raise InternalError(f"cannot deserialize plan tag {t!r}")
+
+
+# --------------------------------------------------------------------------
+# execution graph (job checkpoint)
+# --------------------------------------------------------------------------
+
+def graph_to_obj(graph) -> dict:
+    """Checkpoint an ExecutionGraph (parity: the reference persists the
+    graph protobuf on every transition, ballista.proto:69-173 +
+    execution_graph.rs:1345-1438).  Running task slots are deliberately
+    NOT persisted (execution_stage.rs:148-152): a recovering scheduler
+    re-issues them."""
+    stages = []
+    for sid in sorted(graph.stages):
+        s = graph.stages[sid]
+        stages.append({
+            "stage_id": sid,
+            "plan": plan_to_obj(s.resolved_plan or s.plan),
+            "resolved": s.resolved_plan is not None,
+            "state": s.state,
+            "stage_attempt": s.stage_attempt,
+            "failures": s.failures,
+            "task_failures": list(s.task_failures),
+            "successes": {
+                str(p): {"executor_id": ex,
+                         "writes": [vars(w) for w in writes]}
+                for p, (ex, writes) in s.outputs.items()},
+        })
+    return {"job_id": graph.job_id, "status": graph.status,
+            "error": graph.error, "scalars": dict(graph.scalars),
+            "stages": stages}
+
+
+def graph_from_obj(o: dict):
+    from .ops.shuffle import ShuffleWritePartition
+    from .scheduler.execution_graph import (
+        RUNNING,
+        SUCCESSFUL,
+        ExecutionGraph,
+        TaskInfo,
+    )
+    from .scheduler.planner import QueryStage, rollback_resolved_shuffles
+
+    qstages = []
+    meta = {}
+    for st in o["stages"]:
+        plan = plan_from_obj(st["plan"])
+        if st["resolved"]:
+            # the persisted plan may carry resolved readers; the graph
+            # constructor expects unresolved leaves for linking
+            plan_resolved = plan
+            plan = rollback_resolved_shuffles(plan_from_obj(st["plan"]))
+        else:
+            plan_resolved = None
+        qstages.append(QueryStage(st["stage_id"], plan))
+        meta[st["stage_id"]] = (st, plan_resolved)
+    graph = ExecutionGraph(o["job_id"], qstages)
+    graph.status = o["status"]
+    graph.error = o.get("error", "")
+    graph.scalars = dict(o.get("scalars", {}))
+    for sid, (st, plan_resolved) in meta.items():
+        stage = graph.stages[sid]
+        stage.state = st["state"]
+        stage.stage_attempt = st["stage_attempt"]
+        stage.failures = st.get("failures", 0)
+        stage.task_failures = list(st["task_failures"])
+        if plan_resolved is not None and stage.state in (RUNNING, SUCCESSFUL):
+            stage.resolved_plan = plan_resolved
+        stage.task_infos = [None] * stage.partitions
+        for p_str, rec in st["successes"].items():
+            p = int(p_str)
+            stage.outputs[p] = (rec["executor_id"],
+                                [ShuffleWritePartition(**w) for w in rec["writes"]])
+            stage.task_infos[p] = TaskInfo(p, rec["executor_id"], "success")
+    graph.revive()
+    return graph
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,72 @@
+"""SQL NULL semantics over the in-band sentinel representation.
+
+Engine nullability = "column may carry the per-dtype NULL sentinel"
+(models/schema.py null_sentinel): set by outer-join fill and by scan
+conversion when input data has real NULLs (providers read null stats).
+"""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.client.context import BallistaContext
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = BallistaContext.local()
+    c.register_table("t", pa.table({
+        "k": pa.array([1, 1, 2, 2, 3], type=pa.int64()),
+        "x": pa.array([10, None, 30, None, None], type=pa.int64()),
+        "f": pa.array([1.5, None, 2.5, None, 3.5], type=pa.float64()),
+        "s": pa.array(["a", None, "c", "d", None]),
+        "d": pa.array([0, None, 2, 3, 4], type=pa.int32()).cast(pa.date32()),
+    }))
+    return c
+
+
+def test_count_skips_nulls(ctx):
+    out = ctx.sql("select count(*) as n, count(x) as nx, count(s) as ns, "
+                  "count(f) as nf, count(d) as nd from t").to_pandas()
+    assert out.n[0] == 5 and out.nx[0] == 2 and out.ns[0] == 3
+    assert out.nf[0] == 3 and out.nd[0] == 4
+
+
+def test_sum_min_max_skip_nulls(ctx):
+    out = ctx.sql("select sum(x) as sx, min(x) as lo, max(x) as hi from t").to_pandas()
+    assert out.sx[0] == 40 and out.lo[0] == 10 and out.hi[0] == 30
+
+
+def test_is_null_filters(ctx):
+    assert ctx.sql("select count(*) as n from t where x is null").to_pandas().n[0] == 3
+    assert ctx.sql("select count(*) as n from t where x is not null").to_pandas().n[0] == 2
+    assert ctx.sql("select count(*) as n from t where s is null").to_pandas().n[0] == 2
+
+
+def test_grouped_null_aggregates(ctx):
+    out = ctx.sql("select k, count(x) as nx, sum(x) as sx from t "
+                  "group by k order by k").to_pandas()
+    assert out.nx.tolist() == [1, 1, 0]
+    assert out.sx.tolist()[:2] == [10, 30]
+
+
+def test_null_column_scan_marked_nullable(ctx):
+    schema = ctx.catalog.table_schema("t")
+    assert schema.field("x").nullable and schema.field("f").nullable
+    assert not schema.field("k").nullable
+
+
+def test_parquet_null_stats(tmp_path):
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "n.parquet")
+    pq.write_table(pa.table({
+        "a": pa.array([1, None, 3], type=pa.int64()),
+        "b": pa.array([1, 2, 3], type=pa.int64()),
+    }), path)
+    c = BallistaContext.local()
+    c.register_parquet("n", path)
+    schema = c.catalog.table_schema("n")
+    assert schema.field("a").nullable and not schema.field("b").nullable
+    out = c.sql("select count(a) as na, count(b) as nb from n").to_pandas()
+    assert out.na[0] == 2 and out.nb[0] == 3

@@ -1,0 +1,86 @@
+"""Observability: REST API, prometheus metrics, dot export."""
+import json
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    sched = SchedulerNetService("127.0.0.1", 0, rest_port=0)
+    sched.start()
+    ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                        work_dir=str(tmp_path_factory.mktemp("obs")),
+                        executor_id="obs-exec")
+    ex.start()
+    ctx = BallistaContext.remote("127.0.0.1", sched.port)
+    ctx.register_table("t", pa.table({
+        "g": pa.array(np.arange(1000) % 7, type=pa.int64()),
+        "v": pa.array(np.arange(1000), type=pa.int64()),
+    }))
+    yield sched, ex, ctx
+    ex.stop(notify=False)
+    sched.stop()
+
+
+def _get(sched, path, as_json=True):
+    url = f"http://127.0.0.1:{sched.rest.port}{path}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+    return json.loads(body) if as_json else body
+
+
+def test_rest_state_and_executors(stack):
+    sched, ex, ctx = stack
+    state = _get(sched, "/api/state")
+    assert state["executors"] == 1 and state["alive_executors"] == 1
+    executors = _get(sched, "/api/executors")
+    assert executors[0]["executor_id"] == "obs-exec"
+    assert executors[0]["status"] == "active"
+
+
+def test_rest_jobs_stages_dot_metrics(stack):
+    sched, ex, ctx = stack
+    out = ctx.sql("select g, sum(v) as s from t group by g order by g").to_pandas()
+    assert len(out) == 7
+
+    jobs = _get(sched, "/api/jobs")
+    done = [j for j in jobs if j["state"] == "successful"]
+    assert done, jobs
+    job_id = done[0]["job_id"]
+    assert done[0]["tasks_completed"] == done[0]["tasks_total"] > 0
+
+    stages = _get(sched, f"/api/job/{job_id}/stages")
+    assert len(stages) >= 2
+    assert all(s["state"] == "successful" for s in stages)
+    assert "ShuffleWriterExec" in stages[0]["plan"]
+
+    dot = _get(sched, f"/api/job/{job_id}/dot", as_json=False)
+    assert dot.startswith("digraph") and "shuffle" in dot
+
+    metrics = _get(sched, "/api/metrics", as_json=False)
+    assert "job_submitted_total" in metrics
+    assert "job_exec_time_seconds_count" in metrics
+    submitted = [l for l in metrics.splitlines()
+                 if l.startswith("job_submitted_total")][0]
+    assert int(submitted.split()[-1]) >= 1
+
+
+def test_rest_cancel_patch(stack):
+    sched, ex, ctx = stack
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{sched.rest.port}/api/job/nonexistent",
+        method="PATCH")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        body = json.loads(r.read().decode())
+    assert body["cancelled"] == "nonexistent"

@@ -1,0 +1,99 @@
+"""Job checkpoint/resume: graph serde round-trip + scheduler adoption.
+
+Parity: SURVEY.md §5 checkpoint/resume — the reference persists the
+ExecutionGraph protobuf on every transition so another scheduler can
+decode and resume; shuffle files are the data checkpoints.  Completed
+stages must NOT re-run after recovery.
+"""
+import time
+
+import pytest
+
+from arrow_ballista_tpu import serde
+from arrow_ballista_tpu.scheduler.execution_graph import (
+    RUNNING,
+    SUCCESSFUL,
+    ExecutionGraph,
+)
+from arrow_ballista_tpu.scheduler.persistence import FileJobStateBackend
+from arrow_ballista_tpu.scheduler.scheduler import SchedulerConfig, SchedulerServer
+from arrow_ballista_tpu.scheduler.types import ExecutorMetadata
+
+from .test_scheduler import VirtualTaskLauncher, fake_success, physical_plan
+
+
+def half_run_graph():
+    """Stage 1 complete, stage 2 started (one in-flight task)."""
+    graph = ExecutionGraph.build("jobx", physical_plan(partitions=3))
+    while graph.stages[1].pending_partitions():
+        t = graph.pop_next_task("exec-A")
+        graph.update_task_status([fake_success(t, "exec-A")])
+    t2 = graph.pop_next_task("exec-A")
+    assert t2 is not None and t2.task.stage_id == 2
+    return graph
+
+
+def test_graph_serde_roundtrip_preserves_progress():
+    graph = half_run_graph()
+    obj = serde.graph_to_obj(graph)
+    back = serde.graph_from_obj(obj)
+    assert back.job_id == "jobx" and back.status == "running"
+    assert back.stages[1].state == SUCCESSFUL
+    assert back.stages[1].outputs.keys() == graph.stages[1].outputs.keys()
+    assert back.stages[2].state == RUNNING
+    # in-flight task slots are NOT persisted -> re-issued after recovery
+    assert all(t is None or t.state == "success"
+               for t in back.stages[2].task_infos)
+    # the recovered graph drains to completion without touching stage 1
+    from .test_scheduler import drain
+
+    stage1_tasks = []
+
+    def hook(task):
+        if task.task.stage_id == 1:
+            stage1_tasks.append(task)
+        return None
+
+    drain(back, "exec-B", hook=hook)
+    assert back.status == "successful"
+    assert not stage1_tasks, "completed stage 1 must not re-run"
+
+
+def test_file_backend_save_load_acquire(tmp_path):
+    backend = FileJobStateBackend(str(tmp_path))
+    graph = half_run_graph()
+    backend.save_job(graph)
+    assert backend.list_jobs() == ["jobx"]
+    loaded = backend.load_job("jobx")
+    assert loaded.stages[1].state == SUCCESSFUL
+
+    assert backend.try_acquire_job("jobx", "sched-1")
+    assert backend.try_acquire_job("jobx", "sched-1"), "re-acquire by owner"
+    assert not backend.try_acquire_job("jobx", "sched-2"), "held by sched-1"
+    # stale lock takeover
+    assert backend.try_acquire_job("jobx", "sched-2", stale_after_s=0.0)
+
+    backend.remove_job("jobx")
+    assert backend.list_jobs() == []
+
+
+def test_scheduler_adopts_persisted_job(tmp_path):
+    backend = FileJobStateBackend(str(tmp_path))
+    graph = half_run_graph()
+    backend.save_job(graph)
+
+    launcher = VirtualTaskLauncher()
+    server = SchedulerServer(launcher, SchedulerConfig(), job_backend=backend,
+                             scheduler_id="sched-new")
+    launcher.scheduler = server
+    server.init(start_reaper=False)
+    server.register_executor(ExecutorMetadata("exec-B", task_slots=4))
+    adopted = server.recover_jobs()
+    assert adopted == ["jobx"]
+    status = server.wait_for_job("jobx", 30)
+    assert status.state == "successful"
+    # stage 1 already complete: only stage 2+ tasks may launch
+    assert all(t.task.stage_id != 1 for _, t in launcher.launched)
+    # terminal state checkpointed
+    assert backend.load_job("jobx").status == "successful"
+    server.shutdown()

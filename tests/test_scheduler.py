@@ -174,8 +174,13 @@ def test_job_queued_until_executor_registers():
     launcher.scheduler = server
     server.init(start_reaper=False)
     server.submit_job("job1", lambda: (physical_plan(), {}))
-    # no executors: job must stay running with pending tasks
-    server._event_loop.drain()
+    # no executors: job must stay running with pending tasks once planned
+    # (planning is async — poll for the graph)
+    import time as _t
+
+    deadline = _t.monotonic() + 10
+    while server.pending_task_count() == 0 and _t.monotonic() < deadline:
+        _t.sleep(0.01)
     assert server.get_job_status("job1").state == "running"
     assert server.pending_task_count() > 0
     server.register_executor(ExecutorMetadata("exec-0", task_slots=4))
@@ -277,7 +282,11 @@ def test_job_cancel():
     server.init(start_reaper=False)
     server.register_executor(ExecutorMetadata("exec-0", task_slots=4))
     server.submit_job("job1", lambda: (physical_plan(), {}))
-    server._event_loop.drain()
+    import time as _t
+
+    deadline = _t.monotonic() + 10
+    while launcher.count == 0 and _t.monotonic() < deadline:
+        _t.sleep(0.01)
     assert launcher.count > 0, "tasks must have been launched (and dropped)"
     server.cancel_job("job1")
     status = server.wait_for_job("job1", 10)
