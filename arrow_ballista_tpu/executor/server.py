@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import shutil
 import threading
 import time
@@ -48,6 +49,13 @@ class SchedulerClient:
                   {"executor_id": executor_id,
                    "statuses": [serde.status_to_obj(s) for s in statuses]})
 
+    def poll_work(self, executor_id: str, num_free_slots: int,
+                  statuses: List[TaskStatus]):
+        payload, _ = wire.call(self.host, self.port, "poll_work", {
+            "executor_id": executor_id, "num_free_slots": num_free_slots,
+            "statuses": [serde.status_to_obj(s) for s in statuses]})
+        return [serde.task_from_obj(t) for t in payload["tasks"]]
+
     def executor_stopped(self, executor_id: str, reason: str = "") -> None:
         wire.call(self.host, self.port, "executor_stopped",
                   {"executor_id": executor_id, "reason": reason})
@@ -59,7 +67,10 @@ class ExecutorServer:
                  work_dir: Optional[str] = None, concurrent_tasks: int = 4,
                  executor_id: Optional[str] = None,
                  config: Optional[BallistaConfig] = None,
-                 external_host: Optional[str] = None):
+                 external_host: Optional[str] = None,
+                 policy: str = "push",
+                 job_data_ttl_s: float = 3600.0,
+                 janitor_interval_s: float = 300.0):
         import socket as socketmod
         import tempfile
         import uuid
@@ -95,8 +106,15 @@ class ExecutorServer:
         self.executor = Executor(self.metadata, self.work_dir, config,
                                  concurrent_tasks=concurrent_tasks)
         self.scheduler = SchedulerClient(scheduler_host, scheduler_port)
+        assert policy in ("push", "pull")
+        self.policy = policy
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        self._poll_thread: Optional[threading.Thread] = None
+        self._status_queue: "queue.Queue[TaskStatus]" = queue.Queue()
+        self.job_data_ttl_s = job_data_ttl_s
+        self.janitor_interval_s = janitor_interval_s
+        self._janitor_thread: Optional[threading.Thread] = None
 
         self.rpc.register("launch_multi_task", self._launch_multi_task)
         self.rpc.register("cancel_tasks", self._cancel_tasks)
@@ -113,6 +131,65 @@ class ExecutorServer:
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            name="executor-heartbeat", daemon=True)
         self._hb_thread.start()
+        if self.policy == "pull":
+            self._poll_thread = threading.Thread(target=self._poll_loop,
+                                                 name="executor-poll", daemon=True)
+            self._poll_thread.start()
+        self._janitor_thread = threading.Thread(target=self._janitor_loop,
+                                                name="shuffle-janitor",
+                                                daemon=True)
+        self._janitor_thread.start()
+
+    def _janitor_loop(self) -> None:
+        """Shuffle-data TTL janitor (reference clean_shuffle_data_loop,
+        executor_process.rs:245-273): delete job dirs untouched for longer
+        than the TTL."""
+        while not self._stop.wait(self.janitor_interval_s):
+            try:
+                now = time.time()
+                for entry in os.scandir(self.work_dir):
+                    if not entry.is_dir():
+                        continue
+                    newest = entry.stat().st_mtime
+                    for root, _dirs, files in os.walk(entry.path):
+                        for fn in files:
+                            try:
+                                newest = max(newest, os.stat(
+                                    os.path.join(root, fn)).st_mtime)
+                            except OSError:
+                                pass
+                    if now - newest > self.job_data_ttl_s:
+                        log.info("janitor removing stale job data %s", entry.path)
+                        shutil.rmtree(entry.path, ignore_errors=True)
+            except Exception:  # noqa: BLE001 — janitor must survive
+                log.exception("shuffle janitor iteration failed")
+
+    def _poll_loop(self) -> None:
+        """Pull-mode work loop (reference execution_loop.rs:49-133):
+        report drained statuses, ask for as many tasks as there are free
+        slots, idle-sleep 100 ms when nothing came back."""
+        while not self._stop.is_set():
+            statuses: List[TaskStatus] = []
+            while True:
+                try:
+                    statuses.append(self._status_queue.get_nowait())
+                except queue.Empty:
+                    break
+            free = self.metadata.task_slots - self.executor.active_tasks()
+            try:
+                tasks = self.scheduler.poll_work(self.metadata.executor_id,
+                                                 max(0, free), statuses)
+            except Exception:  # noqa: BLE001 — scheduler briefly unreachable
+                log.warning("poll_work failed", exc_info=True)
+                # re-queue unreported statuses for the next poll
+                for st in statuses:
+                    self._status_queue.put(st)
+                self._stop.wait(1.0)
+                continue
+            for task in tasks:
+                self.executor.submit_task(task, self._status_queue.put)
+            if not tasks and not statuses:
+                self._stop.wait(0.1)
 
     def stop(self, notify: bool = True) -> None:
         self._stop.set()

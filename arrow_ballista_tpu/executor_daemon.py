@@ -24,6 +24,8 @@ def main(argv=None) -> None:
     ap.add_argument("--work-dir", default=None)
     ap.add_argument("--concurrent-tasks", type=int, default=4)
     ap.add_argument("--connect-timeout-s", type=float, default=30.0)
+    ap.add_argument("--scheduling-policy", choices=["push", "pull"],
+                    default="push")
     ap.add_argument("--log-level", default="INFO")
     args = ap.parse_args(argv)
 
@@ -48,7 +50,7 @@ def main(argv=None) -> None:
     server = ExecutorServer(
         args.scheduler_host, args.scheduler_port, args.bind_host,
         args.bind_port, args.work_dir, args.concurrent_tasks,
-        external_host=args.external_host)
+        external_host=args.external_host, policy=args.scheduling_policy)
     server.start()
     logging.info("executor %s on %s:%s (work_dir %s)",
                  server.metadata.executor_id, server.rpc.host, server.rpc.port,

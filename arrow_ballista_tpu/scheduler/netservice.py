@@ -91,6 +91,7 @@ class SchedulerNetService:
         r("register_executor", self._register_executor)
         r("heartbeat", self._heartbeat)
         r("update_task_status", self._update_task_status)
+        r("poll_work", self._poll_work)
         r("executor_stopped", self._executor_stopped)
         r("register_table", self._register_table)
         r("register_external_table", self._register_external_table)
@@ -187,6 +188,12 @@ class SchedulerNetService:
         statuses = [serde.status_from_obj(s) for s in payload["statuses"]]
         self.server.update_task_status(payload["executor_id"], statuses)
         return {}, b""
+
+    def _poll_work(self, payload: dict, _bin: bytes):
+        statuses = [serde.status_from_obj(s) for s in payload.get("statuses", [])]
+        tasks = self.server.poll_work(payload["executor_id"],
+                                      payload.get("num_free_slots", 0), statuses)
+        return {"tasks": [serde.task_to_obj(t) for t in tasks]}, b""
 
     def _executor_stopped(self, payload: dict, _bin: bytes):
         self.server.executor_stopped(payload["executor_id"],

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import queue
 import random
 import string
 import threading
@@ -95,15 +96,30 @@ class Offer:
     """Try to hand out tasks (reference ReservationOffering)."""
 
 
+@dataclasses.dataclass
+class PollWork:
+    """Pull-mode work request (reference SchedulerGrpc.poll_work,
+    grpc.rs:57-136): absorb statuses, then fill the executor's free slots.
+    The reply travels back through ``reply`` (filled on the event loop)."""
+
+    executor_id: str
+    num_free_slots: int
+    statuses: List[TaskStatus]
+    reply: "queue.Queue"
+
+
 class SchedulerConfig:
     def __init__(self, task_distribution: str = "bias",
                  executor_timeout_s: float = 180.0,
                  reaper_interval_s: float = 15.0,
-                 event_buffer_size: int = 10000):
+                 event_buffer_size: int = 10000,
+                 policy: str = "push"):
+        assert policy in ("push", "pull")  # reference TaskSchedulingPolicy
         self.task_distribution = task_distribution
         self.executor_timeout_s = executor_timeout_s
         self.reaper_interval_s = reaper_interval_s
         self.event_buffer_size = event_buffer_size
+        self.policy = policy
 
 
 class SchedulerServer:
@@ -197,6 +213,8 @@ class SchedulerServer:
             self._on_job_cancel(event)
         elif isinstance(event, Offer):
             self._offer()
+        elif isinstance(event, PollWork):
+            self._on_poll_work(event)
         else:
             log.warning("unknown scheduler event %r", event)
 
@@ -266,27 +284,7 @@ class SchedulerServer:
 
     def _on_task_updating(self, ev: TaskUpdating) -> None:
         self.cluster.free_slots(ev.executor_id, len(ev.statuses))
-        by_job: Dict[str, List[TaskStatus]] = {}
-        for st in ev.statuses:
-            by_job.setdefault(st.task.job_id, []).append(st)
-        for job_id, sts in by_job.items():
-            graph = self.jobs.get_graph(job_id)
-            if graph is None:
-                continue
-            for kind, payload in graph.update_task_status(sts):
-                if kind == "job_successful":
-                    self.jobs.set_status(
-                        JobStatus(job_id, "successful", locations=payload))
-                    self.metrics.record_completed(
-                        job_id, self._queued_at_ms.pop(job_id, 0),
-                        int(time.time() * 1000))
-                elif kind == "job_failed":
-                    self.jobs.set_status(
-                        JobStatus(job_id, "failed", error=str(payload)))
-                    self.metrics.record_failed(job_id)
-                    self._queued_at_ms.pop(job_id, None)
-                    self._cancel_running(graph)
-            self._checkpoint(graph)
+        self._absorb_statuses(ev.executor_id, ev.statuses)
         self._offer()
 
     def _on_executor_lost(self, ev: ExecutorLost) -> None:
@@ -314,6 +312,63 @@ class SchedulerServer:
             except Exception:  # noqa: BLE001
                 log.exception("cancel_tasks failed for %s", eid)
 
+    def poll_work(self, executor_id: str, num_free_slots: int,
+                  statuses: List[TaskStatus],
+                  timeout: float = 10.0) -> List[TaskDescription]:
+        """Pull-mode entry (blocking): returns up to num_free_slots tasks."""
+        reply: "queue.Queue" = queue.Queue(maxsize=1)
+        self._event_loop.post(PollWork(executor_id, num_free_slots,
+                                       statuses, reply))
+        try:
+            return reply.get(timeout=timeout)
+        except queue.Empty:
+            return []
+
+    def _on_poll_work(self, ev: PollWork) -> None:
+        tasks: List[TaskDescription] = []
+        try:
+            self.heartbeat(ExecutorHeartbeat(ev.executor_id))
+            if ev.statuses:
+                self._absorb_statuses(ev.executor_id, ev.statuses)
+            graphs = self.jobs.active_graphs()
+            while len(tasks) < ev.num_free_slots:
+                task = None
+                for graph in graphs:
+                    task = graph.pop_next_task(ev.executor_id)
+                    if task is not None:
+                        break
+                if task is None:
+                    break
+                tasks.append(task)
+        finally:
+            ev.reply.put(tasks)
+
+    def _absorb_statuses(self, executor_id: str,
+                         statuses: List[TaskStatus]) -> None:
+        """Shared status intake (used by push TaskUpdating and pull
+        PollWork)."""
+        by_job: Dict[str, List[TaskStatus]] = {}
+        for st in statuses:
+            by_job.setdefault(st.task.job_id, []).append(st)
+        for job_id, sts in by_job.items():
+            graph = self.jobs.get_graph(job_id)
+            if graph is None:
+                continue
+            for kind, payload in graph.update_task_status(sts):
+                if kind == "job_successful":
+                    self.jobs.set_status(
+                        JobStatus(job_id, "successful", locations=payload))
+                    self.metrics.record_completed(
+                        job_id, self._queued_at_ms.pop(job_id, 0),
+                        int(time.time() * 1000))
+                elif kind == "job_failed":
+                    self.jobs.set_status(
+                        JobStatus(job_id, "failed", error=str(payload)))
+                    self.metrics.record_failed(job_id)
+                    self._queued_at_ms.pop(job_id, None)
+                    self._cancel_running(graph)
+            self._checkpoint(graph)
+
     def _resolve_addr(self, executor_id: str):
         meta = self.cluster.get_executor(executor_id)
         return (meta.host, meta.port) if meta is not None else ("", 0)
@@ -322,9 +377,11 @@ class SchedulerServer:
     def _offer(self) -> None:
         """Reserve free slots and fill them with tasks (reference
         state/mod.rs:195-233 offer_reservation + fill_reservations)."""
-        alive = set(self.cluster.alive_executors(self.config.executor_timeout_s))
         pending = self.pending_task_count()
         self.metrics.set_pending_tasks_queue_size(pending)
+        if self.config.policy != "push":
+            return  # pull mode: executors come to us via poll_work
+        alive = set(self.cluster.alive_executors(self.config.executor_timeout_s))
         if pending == 0 or not alive:
             return
         reservations = self.cluster.reserve_slots(pending, sorted(alive))

@@ -23,6 +23,8 @@ def main(argv=None) -> None:
                          "multi-scheduler adoption")
     ap.add_argument("--task-distribution", choices=["bias", "round-robin"],
                     default="bias")
+    ap.add_argument("--scheduling-policy", choices=["push", "pull"],
+                    default="push")
     ap.add_argument("--executor-timeout-s", type=float, default=180.0)
     ap.add_argument("--shuffle-partitions", type=int, default=16)
     ap.add_argument("--log-level", default="INFO")
@@ -42,7 +44,8 @@ def main(argv=None) -> None:
             {"ballista.shuffle.partitions": str(args.shuffle_partitions)}),
         scheduler_config=SchedulerConfig(
             task_distribution=args.task_distribution,
-            executor_timeout_s=args.executor_timeout_s),
+            executor_timeout_s=args.executor_timeout_s,
+            policy=args.scheduling_policy),
         rest_port=None if args.rest_port < 0 else args.rest_port,
         state_dir=args.state_dir)
     svc.start()

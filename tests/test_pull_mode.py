@@ -1,0 +1,56 @@
+"""Pull-mode scheduling: executors poll for work (reference PollWork,
+grpc.rs:57-136 + execution_loop.rs poll loop)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+
+@pytest.fixture(scope="module")
+def pull_cluster(tmp_path_factory):
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+    from arrow_ballista_tpu.scheduler.scheduler import SchedulerConfig
+
+    sched = SchedulerNetService(
+        "127.0.0.1", 0,
+        config=BallistaConfig({"ballista.shuffle.partitions": "4"}),
+        scheduler_config=SchedulerConfig(policy="pull"))
+    sched.start()
+    executors = []
+    for i in range(2):
+        ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                            work_dir=str(tmp_path_factory.mktemp(f"pull{i}")),
+                            executor_id=f"pull-exec-{i}", policy="pull")
+        ex.start()
+        executors.append(ex)
+    yield sched, executors
+    for ex in executors:
+        ex.stop(notify=False)
+    sched.stop()
+
+
+def test_pull_mode_query(pull_cluster):
+    sched, executors = pull_cluster
+    ctx = BallistaContext.remote("127.0.0.1", sched.port)
+    rng = np.random.default_rng(5)
+    n = 8000
+    ctx.register_table("t", pa.table({
+        "k": pa.array(rng.integers(0, 9, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+    }))
+    got = ctx.sql("select k, sum(v) as s, count(*) as c from t "
+                  "group by k order by k").to_pandas()
+    assert len(got) == 9
+    assert int(got.c.sum()) == n
+
+
+def test_pull_mode_consecutive_jobs(pull_cluster):
+    sched, _ = pull_cluster
+    ctx = BallistaContext.remote("127.0.0.1", sched.port)
+    ctx.register_table("u", pa.table({"x": pa.array(range(100), type=pa.int64())}))
+    for _ in range(3):
+        out = ctx.sql("select sum(x) as s from u").to_pandas()
+        assert int(out.s[0]) == 4950
