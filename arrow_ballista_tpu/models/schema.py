@@ -177,6 +177,24 @@ class Schema:
     def merge(self, other: "Schema") -> "Schema":
         return Schema(list(self.fields) + list(other.fields))
 
+    def to_arrow_empty(self):
+        """An empty pyarrow table with this schema's logical arrow types
+        (used by scans whose every row group was pruned)."""
+        import pyarrow as pa
+
+        mapping = {
+            "int32": pa.int32(), "int64": pa.int64(), "float32": pa.float32(),
+            "float64": pa.float64(), "bool": pa.bool_(), "date32": pa.date32(),
+            "string": pa.string(),
+        }
+        arrays, fields = [], []
+        for f in self.fields:
+            t = (pa.decimal128(38, f.dtype.scale) if f.dtype.is_decimal
+                 else mapping[f.dtype.kind])
+            arrays.append(pa.array([], type=t))
+            fields.append(pa.field(f.name, t, nullable=f.nullable))
+        return pa.table(arrays, schema=pa.schema(fields))
+
     def __eq__(self, other):
         return isinstance(other, Schema) and self.fields == other.fields
 

@@ -296,14 +296,108 @@ class MemoryScanExec(ScanExec):
         return f"MemoryScanExec: {self.table.num_rows} rows, {self.partitions} partitions"
 
 
+def _simple_predicates(filters: Sequence[E.Expr], schema: Schema):
+    """Extract ``column <op> literal`` conjuncts usable against parquet
+    row-group statistics.  Returns [(col_name, op, value, dtype)] with the
+    literal converted to the column's **physical** value domain — the same
+    one the executed predicate compares in (dates as epoch days, decimals
+    as scaled ints via the same rounding as ExprCompiler._lit_physical) —
+    so pruning can never disagree with execution."""
+    from .expressions import ExprCompiler, fold_constants
+
+    conv = ExprCompiler(schema, "host")
+    out = []
+    for f in filters:
+        for c in E.conjuncts(f):
+            c = fold_constants(c)
+            if not (isinstance(c, E.BinOp) and c.op in ("=", "<", "<=", ">", ">=")):
+                continue
+            col, lit, op = None, None, c.op
+            if isinstance(c.left, E.Column) and isinstance(c.right, E.Lit):
+                col, lit = c.left, c.right
+            elif isinstance(c.right, E.Column) and isinstance(c.left, E.Lit):
+                col, lit = c.right, c.left
+                op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}[op]
+            if col is None or col.name not in schema:
+                continue
+            v = lit.value
+            if isinstance(v, bool) or v is None:
+                continue
+            dt = schema.field(col.name).dtype
+            if not isinstance(v, str):
+                try:
+                    v = conv._lit_physical(lit, dt)
+                except Exception:
+                    continue
+            out.append((col.name, op, v, dt))
+    return out
+
+
+def _stats_refute(stats, op: str, value, dt: DataType) -> bool:
+    """True iff row-group stats prove no row can satisfy ``col op value``.
+    ``value`` is in the column's physical domain (see _simple_predicates);
+    stats min/max are converted into that same domain before comparing."""
+    if stats is None or not stats.has_min_max:
+        return False
+    lo, hi = stats.min, stats.max
+    try:
+        if isinstance(value, str):
+            if not isinstance(lo, (str, bytes)):
+                return False
+            if isinstance(lo, bytes):
+                lo, hi = lo.decode("utf-8", "replace"), hi.decode("utf-8", "replace")
+        else:
+            import datetime
+            import decimal as pydec
+
+            def phys(x):
+                # datetime.datetime must be checked before datetime.date
+                # (it's a subclass); both map to epoch days
+                if isinstance(x, datetime.datetime):
+                    return (x.date() - datetime.date(1970, 1, 1)).days
+                if isinstance(x, datetime.date):
+                    return (x - datetime.date(1970, 1, 1)).days
+                if dt.is_decimal:
+                    if isinstance(x, pydec.Decimal):
+                        return int(x.scaleb(dt.scale))  # exact
+                    return float(x) * (10 ** dt.scale)
+                if isinstance(x, (int, float, pydec.Decimal)):
+                    return float(x)
+                raise TypeError(f"unusable stats value {x!r}")
+
+            lo, hi = phys(lo), phys(hi)
+        if op == "=":
+            return value < lo or value > hi
+        if op == "<":
+            return lo >= value
+        if op == "<=":
+            return lo > value
+        if op == ">":
+            return hi <= value
+        if op == ">=":
+            return hi < value
+    except (TypeError, ValueError, ArithmeticError):
+        return False
+    return False
+
+
 class ParquetScanExec(ScanExec):
-    """Parquet scan; one partition = a group of files (row-group granularity
-    refinement later).  Applies simple predicates as parquet read filters
-    for row-group pruning, then re-applies everything on device."""
+    """Parquet scan at **row-group granularity**: the partition unit is a
+    (file, row_group) pair, balanced across ``target_partitions`` by row
+    count, so a single large file still scans in parallel (the reference
+    gets file-level parallelism from DataFusion's ParquetExec; row groups
+    are the TPU-friendly unit because each becomes one padded device batch).
+
+    Pushdown: simple ``col <op> literal`` conjuncts are checked against
+    row-group min/max statistics at plan time — refuted row groups are never
+    read.  All predicates are re-applied on device afterwards (pruning is
+    only ever an over-approximation)."""
 
     def __init__(self, schema: Schema, paths: List[str], target_partitions: int,
                  filters: Sequence[E.Expr] = (), table_schema: Optional[Schema] = None):
         super().__init__(schema, filters)
+        import pyarrow.parquet as pq
+
         self.table_schema = table_schema or schema
         files = []
         for p in paths:
@@ -314,8 +408,44 @@ class ParquetScanExec(ScanExec):
         if not files:
             raise ExecutionError(f"no parquet files found in {paths}")
         self.files = files
-        k = max(1, min(target_partitions, len(files)))
-        self.groups = [files[i::k] for i in range(k)]
+
+        preds = _simple_predicates(self.filters, self.table_schema)
+        units: List[Tuple[str, int, int]] = []  # (file, row_group, rows)
+        self.pruned_row_groups = 0
+        for f in files:
+            meta = pq.ParquetFile(f).metadata
+            name_to_idx = {meta.schema.column(i).name: i
+                           for i in range(meta.num_columns)}
+            for rg in range(meta.num_row_groups):
+                g = meta.row_group(rg)
+                refuted = False
+                for col, op, v, dt in preds:
+                    ci = name_to_idx.get(col)
+                    if ci is None:
+                        continue
+                    if _stats_refute(g.column(ci).statistics, op, v, dt):
+                        refuted = True
+                        break
+                if refuted:
+                    self.pruned_row_groups += 1
+                else:
+                    units.append((f, rg, g.num_rows))
+        self._total_rows = sum(u[2] for u in units)
+        if not units:  # everything pruned: keep one empty partition
+            self.groups: List[List[Tuple[str, int, int]]] = [[]]
+        else:
+            # greedy row-count balancing into k partitions
+            k = max(1, min(target_partitions, len(units)))
+            heaps = [(0, i) for i in range(k)]
+            groups: List[List[Tuple[str, int, int]]] = [[] for _ in range(k)]
+            import heapq
+
+            heapq.heapify(heaps)
+            for u in sorted(units, key=lambda u: -u[2]):
+                rows, i = heapq.heappop(heaps)
+                groups[i].append(u)
+                heapq.heappush(heaps, (rows + u[2], i))
+            self.groups = [g for g in groups if g]
 
     def output_partition_count(self) -> int:
         return len(self.groups)
@@ -324,18 +454,28 @@ class ParquetScanExec(ScanExec):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        tables = [
-            pq.read_table(f, columns=self._schema.names()) for f in self.groups[partition]
-        ]
+        units = self.groups[partition]
+        if not units:
+            return self._schema.to_arrow_empty()
+        tables = []
+        by_file: Dict[str, List[int]] = {}
+        for f, rg, _ in units:
+            by_file.setdefault(f, []).append(rg)
+        for f, rgs in by_file.items():
+            tables.append(
+                pq.ParquetFile(f).read_row_groups(sorted(rgs),
+                                                  columns=self._schema.names())
+            )
         return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
 
     def row_count_estimate(self) -> int:
-        import pyarrow.parquet as pq
-
-        return sum(pq.ParquetFile(f).metadata.num_rows for f in self.files)
+        return self._total_rows
 
     def _label(self):
-        return f"ParquetScanExec: {len(self.files)} files, {len(self.groups)} partitions"
+        pruned = f", {self.pruned_row_groups} row-groups pruned" if self.pruned_row_groups else ""
+        n_units = sum(len(g) for g in self.groups)
+        return (f"ParquetScanExec: {len(self.files)} files, {n_units} row-groups, "
+                f"{len(self.groups)} partitions{pruned}")
 
 
 class CsvScanExec(ScanExec):

@@ -1,38 +1,91 @@
-"""Headline benchmark: TPC-H q1 pipeline throughput on one chip.
+"""Headline benchmark: TPC-H through the engine on one chip.
 
-Runs the flagship fused query step (filter -> derived columns -> grouped
-aggregate, the TPC-H q1 execution shape) over synthetic lineitem-shaped
-data resident in HBM, and reports rows/sec.
+Two layers, both reported:
 
-Baseline: the reference's README chart puts Ballista 0.11 at ~3.1 s for
-q1 at SF10 (~59.99M lineitem rows) on a 24-core single-node executor
-(reference README.md:52-60, BASELINE.md) => ~19.35M rows/s.
-``vs_baseline`` = our rows/s divided by that.
+- **engine**: TPC-H q1 + q6 at SF1 run END-TO-END through
+  ``BallistaContext.standalone`` — parquet scan -> device pipeline ->
+  shuffle -> final aggregate -> collect.  The headline metric is engine
+  rows/s on q1 (lineitem rows / wall-clock), matching how the reference's
+  README chart is computed (reference README.md:52-60: q1 SF10 in ~3.1 s on
+  a 24-core executor => ~19.35M rows/s, see BASELINE.md).
+- **kernel**: the fused q1 pipeline (filter -> derived columns -> grouped
+  aggregate) over HBM-resident arrays, isolating device throughput from IO.
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+Robustness (round-1 failure mode: the experimental "axon" TPU plugin can
+fail or hang at backend init): the parent process never imports jax.  It
+launches a worker subprocess per attempt — TPU with retries, then a
+CPU-forced fallback — with a hard timeout, and re-prints the worker's final
+JSON line.  Exactly ONE JSON line lands on stdout:
+  {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N, ...}
 """
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
+REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_ROWS_PER_S = 59_986_052 / 3.1  # reference q1 SF10 wall-clock
+SCALE = float(os.environ.get("BENCH_SCALE", "1"))
+QUERIES = os.environ.get("BENCH_QUERIES", "1,6")
+DATA_DIR = os.environ.get(
+    "BENCH_DATA", os.path.join(REPO, ".bench_data", f"tpch-sf{SCALE:g}")
+)
+KERNEL_ROWS = int(os.environ.get("BENCH_KERNEL_ROWS", str(8_000_000)))
 
-ROWS = 8_000_000
-ITERS = 5
+
+def _cpu_env(n_devices: int = 1) -> dict:
+    # single definition of "CPU-forced, TPU-plugin-free" lives next to the
+    # other driver entry point
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _scrubbed_cpu_env
+
+    return _scrubbed_cpu_env(n_devices)
 
 
-def main() -> None:
+def ensure_data() -> None:
+    marker = os.path.join(DATA_DIR, "lineitem.parquet")
+    if os.path.exists(marker):
+        return
+    os.makedirs(DATA_DIR, exist_ok=True)
+    print(f"[bench] generating TPC-H SF{SCALE:g} under {DATA_DIR}", file=sys.stderr)
+    subprocess.run(
+        [sys.executable, "-m", "benchmarks.tpch", "convert",
+         "--scale", str(SCALE), "--output", DATA_DIR],
+        cwd=REPO, env=_cpu_env(), check=True, timeout=1800,
+        stdout=sys.stderr,
+    )
+
+
+# --------------------------------------------------------------------------
+# worker (runs in a subprocess; the only place jax is imported)
+# --------------------------------------------------------------------------
+
+
+def _worker(platform: str) -> None:
+    import numpy as np
+    import jax
+
+    # int64 columns (fixed-point decimals, keys) need x64; the device path
+    # never produces f64 arrays (divisions are host-finalize), so this is
+    # TPU-safe
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"[worker] backend up: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    detail: dict = {"platform": dev.platform, "device": str(dev.device_kind)}
+
+    # --- kernel microbench ---------------------------------------------
+    sys.path.insert(0, REPO)
     from __graft_entry__ import _q1_augment, _q1_example, _q1_filter, _Q1_AGGS, _Q1_KEYS
     from arrow_ballista_tpu.ops import kernels as K
 
-    cols_np, mask_np = _q1_example(ROWS, seed=7)
+    cols_np, mask_np = _q1_example(KERNEL_ROWS, seed=7)
     cols = {k: jax.device_put(jnp.asarray(v)) for k, v in cols_np.items()}
     mask = jax.device_put(jnp.asarray(mask_np))
 
@@ -44,25 +97,126 @@ def main() -> None:
         vals = [(cols[v], how) for v, how in _Q1_AGGS]
         return K.grouped_aggregate(keys, vals, mask, 16)
 
-    # warmup / compile
-    out = step(cols, mask)
+    out = step(cols, mask)  # compile + warmup
     jax.block_until_ready(out[1])
-
     times = []
-    for _ in range(ITERS):
+    for _ in range(5):
         t0 = time.perf_counter()
         out = step(cols, mask)
         jax.block_until_ready(out[1])
         times.append(time.perf_counter() - t0)
+    kernel_rows_s = KERNEL_ROWS / float(np.median(times))
+    detail["kernel_q1_rows_per_sec"] = round(kernel_rows_s, 1)
+    print(f"[worker] kernel q1: {kernel_rows_s/1e6:.1f}M rows/s", file=sys.stderr)
+    del cols, mask, out
 
-    elapsed = float(np.median(times))
-    rows_per_s = ROWS / elapsed
-    print(json.dumps({
-        "metric": "tpch_q1_pipeline_rows_per_sec",
-        "value": round(rows_per_s, 1),
+    # --- engine bench: TPC-H through BallistaContext --------------------
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+    from benchmarks.queries import QUERIES as SQL
+    from benchmarks.tpch import register_tables
+
+    config = BallistaConfig({
+        "ballista.shuffle.partitions": "8",
+        "ballista.batch.size": str(1 << 20),
+    })
+    ctx = BallistaContext.standalone(config, concurrent_tasks=4)
+    register_tables(ctx, DATA_DIR)
+    lineitem_rows = ctx.catalog.provider("lineitem").row_count()
+    detail["lineitem_rows"] = lineitem_rows
+
+    engine: dict = {}
+    for q in [int(x) for x in QUERIES.split(",")]:
+        per = []
+        for it in range(2):
+            t0 = time.perf_counter()
+            res = ctx.sql(SQL[q]).collect()
+            nrows = sum(b.num_rows for b in res)
+            per.append(time.perf_counter() - t0)
+            print(f"[worker] q{q} iter{it}: {per[-1]*1000:.0f} ms ({nrows} rows)",
+                  file=sys.stderr)
+        engine[f"q{q}_ms"] = round(min(per) * 1000, 1)
+    ctx.shutdown()
+    detail["engine"] = engine
+
+    q1_s = engine.get("q1_ms", 0.0) / 1000.0
+    value = lineitem_rows / q1_s if q1_s else 0.0
+    result = {
+        "metric": f"tpch_q1_sf{SCALE:g}_engine_rows_per_sec",
+        "value": round(value, 1),
         "unit": "rows/s",
-        "vs_baseline": round(rows_per_s / BASELINE_ROWS_PER_S, 3),
-    }))
+        "vs_baseline": round(value / BASELINE_ROWS_PER_S, 4),
+        **detail,
+    }
+    print(json.dumps(result))
+
+
+# --------------------------------------------------------------------------
+# parent orchestration
+# --------------------------------------------------------------------------
+
+
+def _attempt(platform: str, timeout: int):
+    env = dict(os.environ) if platform == "tpu" else _cpu_env()
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--platform", platform],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] {platform} attempt timed out after {timeout}s", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        print(f"[bench] {platform} attempt failed rc={proc.returncode} "
+              f"after {time.time()-t0:.0f}s", file=sys.stderr)
+        tail = (proc.stdout + proc.stderr)[-1500:]
+        print(f"[bench] tail: {tail}", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"[bench] {platform} attempt produced no JSON", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--platform", default="auto")
+    args = ap.parse_args()
+
+    if args.worker:
+        _worker(args.platform)
+        return
+
+    ensure_data()
+
+    plan = []
+    if args.platform in ("auto", "tpu"):
+        # TPU backend init is transiently Unavailable when the device-grant
+        # tunnel is recovering: retry fresh subprocesses with backoff
+        plan += [("tpu", 2400), ("tpu", 2400)]
+    if args.platform in ("auto", "cpu"):
+        plan += [("cpu", 2400)]
+
+    result = None
+    for i, (platform, timeout) in enumerate(plan):
+        if i > 0:
+            time.sleep(20)
+        result = _attempt(platform, timeout)
+        if result is not None:
+            break
+    if result is None:
+        result = {"metric": "tpch_q1_engine_rows_per_sec", "value": 0.0,
+                  "unit": "rows/s", "vs_baseline": 0.0, "error": "all attempts failed"}
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -55,10 +55,23 @@ WORDS = [
 
 
 def _comments(rng: np.random.Generator, n: int, lo=4, hi=10) -> np.ndarray:
-    lengths = rng.integers(lo, hi, n)
-    words = rng.choice(WORDS, size=(n, hi))
-    return np.array([" ".join(words[i, : lengths[i]]) for i in range(n)], dtype=object)
+    """Random word-join comments.  For large n, samples from a pre-built pool
+    of 64k distinct comments instead of joining n python strings — value
+    distributions (LIKE-match frequencies for q13/q16) are preserved, and SF1
+    generation drops from minutes to seconds."""
+    pool_n = min(n, 1 << 16)
+    lengths = rng.integers(lo, hi, pool_n)
+    words = rng.choice(WORDS, size=(pool_n, hi))
+    pool = np.array([" ".join(words[i, : lengths[i]]) for i in range(pool_n)], dtype=object)
+    if pool_n == n:
+        return pool
+    return pool[rng.integers(0, pool_n, n)]
 
+
+
+def _tagged(prefix: str, keys: np.ndarray) -> np.ndarray:
+    """Vectorized 'Prefix#000000123'-style id strings."""
+    return np.char.add(prefix, np.char.zfill(keys.astype("U9"), 9)).astype(object)
 
 def _money(rng, n, lo, hi):
     # decimal(,2) as float dollars (writers convert to decimal128)
@@ -78,11 +91,17 @@ def generate_tables(scale: float, seed: int = 0) -> Dict[str, "object"]:
 
     tables: Dict[str, pa.Table] = {}
 
-    from decimal import Decimal
-
     def dec(arr):
+        # Vectorized decimal128(15,2) construction: the unscaled value is the
+        # cent count; decimal128 is a 16-byte little-endian two's-complement
+        # integer, built here as (low=cents, high=sign-extension) int64 pairs.
         cents = np.round(np.asarray(arr, dtype=np.float64) * 100).astype(np.int64)
-        return pa.array([Decimal(int(c)).scaleb(-2) for c in cents], type=pa.decimal128(15, 2))
+        raw = np.empty((len(cents), 2), dtype="<i8")
+        raw[:, 0] = cents
+        raw[:, 1] = cents >> 63
+        return pa.Array.from_buffers(
+            pa.decimal128(15, 2), len(cents), [None, pa.py_buffer(raw.tobytes())]
+        )
 
     def date32(days):
         return pa.array(np.asarray(days, dtype=np.int32), type=pa.int32()).cast(pa.date32())
@@ -109,7 +128,7 @@ def generate_tables(scale: float, seed: int = 0) -> Dict[str, "object"]:
     supp_comment = np.where(marks < 0.005, "Customer Complaints " + supp_comment, supp_comment)
     tables["supplier"] = pa.table({
         "s_suppkey": pa.array(s_key),
-        "s_name": pa.array([f"Supplier#{k:09d}" for k in s_key]),
+        "s_name": pa.array(_tagged("Supplier#", s_key)),
         "s_address": pa.array(_comments(rng, n_supp, 2, 4)),
         "s_nationkey": pa.array(s_nation),
         "s_phone": pa.array([f"{10 + int(nk)}-{rng.integers(100,1000)}-{rng.integers(100,1000)}-{rng.integers(1000,10000)}" for nk in s_nation]),
@@ -155,7 +174,7 @@ def generate_tables(scale: float, seed: int = 0) -> Dict[str, "object"]:
     c_nation = rng.integers(0, 25, n_cust).astype(np.int64)
     tables["customer"] = pa.table({
         "c_custkey": pa.array(c_key),
-        "c_name": pa.array([f"Customer#{k:09d}" for k in c_key]),
+        "c_name": pa.array(_tagged("Customer#", c_key)),
         "c_address": pa.array(_comments(rng, n_cust, 2, 4)),
         "c_nationkey": pa.array(c_nation),
         "c_phone": pa.array([f"{10 + int(nk)}-{a}-{b}-{c}" for nk, a, b, c in zip(
@@ -179,7 +198,7 @@ def generate_tables(scale: float, seed: int = 0) -> Dict[str, "object"]:
         "o_totalprice": dec(_money(rng, n_ord, 800.0, 500_000.0)),
         "o_orderdate": date32(o_date),
         "o_orderpriority": pa.array(rng.choice(PRIORITIES, n_ord)),
-        "o_clerk": pa.array([f"Clerk#{k:09d}" for k in rng.integers(1, max(2, n_supp), n_ord)]),
+        "o_clerk": pa.array(_tagged("Clerk#", rng.integers(1, max(2, n_supp), n_ord))),
         "o_shippriority": pa.array(np.zeros(n_ord, dtype=np.int32)),
         "o_comment": pa.array(_comments(rng, n_ord, 3, 8)),
     })

@@ -29,7 +29,10 @@ def cmd_convert(args) -> None:
     for name, table in tables.items():
         if args.format == "parquet":
             path = os.path.join(args.output, f"{name}.parquet")
-            pq.write_table(table, path, compression=args.compression)
+            # bounded row groups give the row-group-granular ParquetScanExec
+            # its scan parallelism even for single-file tables
+            pq.write_table(table, path, compression=args.compression,
+                           row_group_size=args.row_group_size)
         else:
             import pyarrow.csv as pacsv
 
@@ -155,6 +158,7 @@ def main(argv=None) -> None:
     c.add_argument("--output", required=True)
     c.add_argument("--format", choices=["parquet", "csv"], default="parquet")
     c.add_argument("--compression", default="zstd")
+    c.add_argument("--row-group-size", type=int, default=1 << 19)
 
     def common(p):
         p.add_argument("--path", required=True)

@@ -6,6 +6,10 @@ Env vars must be set before jax imports anywhere.
 """
 import os
 
+# The image's site hook registers an experimental TPU PJRT plugin ("axon")
+# in every python process when PALLAS_AXON_POOL_IPS is set; its tunnel can
+# hang for minutes.  Blank it so tests never touch the TPU path.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: tests always run on the CPU mesh
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
