@@ -132,6 +132,8 @@ class BallistaContext:
         if self._standalone is not None:
             self._standalone.shutdown()
             self._standalone = None
+        if self._remote is not None:
+            self._remote.close()
         self._remote = None
 
     @staticmethod

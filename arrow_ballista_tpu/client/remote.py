@@ -24,9 +24,27 @@ class RemoteCluster:
     def __init__(self, host: str, port: int, config: Optional[BallistaConfig] = None):
         self.host, self.port = host, port
         self.config = config or BallistaConfig()
+        # one scheduler session per client context: private table namespace
+        # + this client's config (reference: ExecuteQuery with no query
+        # creates the server-side session, context.rs:80-140)
+        payload, _ = wire.call(host, port, "create_session",
+                               {"settings": dict(self.config._settings)})
+        self.session_id = payload["session_id"]
+
+    def close(self) -> None:
+        if self.session_id is not None:
+            try:
+                wire.call(self.host, self.port, "remove_session",
+                          {"session_id": self.session_id})
+            except Exception:  # noqa: BLE001 — scheduler may be gone
+                pass
+            self.session_id = None
 
     def _call(self, method: str, payload: dict = None, binary: bytes = b""):
-        return wire.call(self.host, self.port, method, payload or {}, binary)
+        payload = dict(payload or {})
+        if self.session_id is not None:
+            payload.setdefault("session_id", self.session_id)
+        return wire.call(self.host, self.port, method, payload, binary)
 
     # --- catalog ---------------------------------------------------------
     def register_table(self, name: str, table) -> None:

@@ -39,9 +39,12 @@ class SchedulerClient:
     def register_executor(self, meta: ExecutorMetadata) -> None:
         wire.call(self.host, self.port, "register_executor", {"meta": vars(meta)})
 
-    def heartbeat(self, executor_id: str, status: str = "active") -> None:
-        wire.call(self.host, self.port, "heartbeat",
-                  {"executor_id": executor_id, "status": status})
+    def heartbeat(self, executor_id: str, status: str = "active",
+                  meta: Optional[ExecutorMetadata] = None) -> None:
+        payload = {"executor_id": executor_id, "status": status}
+        if meta is not None:
+            payload["meta"] = vars(meta)
+        wire.call(self.host, self.port, "heartbeat", payload)
 
     def update_task_status(self, executor_id: str,
                            statuses: List[TaskStatus]) -> None:
@@ -91,11 +94,16 @@ class ExecutorServer:
         # to the Python RPC handler.
         self._native_dp = None
         data_port = self.rpc.port
+        # shared-secret auth + bounded fan-in (reference issues bearer tokens
+        # at Flight handshake, flight_service.rs:136-157, and bounds fetch
+        # concurrency with a 50-permit semaphore, shuffle_reader.rs:123)
+        self._dp_token = os.environ.get("BALLISTA_DATA_PLANE_TOKEN", "")
         from .. import native as native_mod
 
         lib = native_mod.dataplane()
         if lib is not None:
-            p = lib.dp_start(self.work_dir.encode(), 0)
+            p = lib.dp_start(self.work_dir.encode(), 0,
+                             self._dp_token.encode(), 64)
             if p > 0:
                 self._native_dp = lib
                 data_port = p
@@ -109,8 +117,10 @@ class ExecutorServer:
         assert policy in ("push", "pull")
         self.policy = policy
         self._stop = threading.Event()
+        self._draining = False
         self._hb_thread: Optional[threading.Thread] = None
         self._poll_thread: Optional[threading.Thread] = None
+        self._reporter_thread: Optional[threading.Thread] = None
         self._status_queue: "queue.Queue[TaskStatus]" = queue.Queue()
         self.job_data_ttl_s = job_data_ttl_s
         self.janitor_interval_s = janitor_interval_s
@@ -135,6 +145,10 @@ class ExecutorServer:
             self._poll_thread = threading.Thread(target=self._poll_loop,
                                                  name="executor-poll", daemon=True)
             self._poll_thread.start()
+        else:
+            self._reporter_thread = threading.Thread(
+                target=self._reporter_loop, name="status-reporter", daemon=True)
+            self._reporter_thread.start()
         self._janitor_thread = threading.Thread(target=self._janitor_loop,
                                                 name="shuffle-janitor",
                                                 daemon=True)
@@ -175,7 +189,9 @@ class ExecutorServer:
                     statuses.append(self._status_queue.get_nowait())
                 except queue.Empty:
                     break
-            free = self.metadata.task_slots - self.executor.active_tasks()
+            # draining: keep polling to drain statuses, but take no new work
+            free = 0 if self._draining else \
+                self.metadata.task_slots - self.executor.active_tasks()
             try:
                 tasks = self.scheduler.poll_work(self.metadata.executor_id,
                                                  max(0, free), statuses)
@@ -190,6 +206,28 @@ class ExecutorServer:
                 self.executor.submit_task(task, self._status_queue.put)
             if not tasks and not statuses:
                 self._stop.wait(0.1)
+
+    def drain_and_stop(self, grace_s: float = 30.0) -> None:
+        """Graceful shutdown (reference executor_process.rs:309-320):
+        Terminating heartbeat -> scheduler stops assigning -> wait for
+        in-flight tasks (bounded by ``grace_s``) -> notify -> exit.
+        Pull mode additionally stops asking for new work (the poll loop
+        keeps running to drain statuses)."""
+        self._draining = True
+        try:
+            self.scheduler.heartbeat(self.metadata.executor_id,
+                                     status="terminating", meta=self.metadata)
+        except Exception:  # noqa: BLE001 — scheduler may already be gone
+            pass
+        deadline = time.monotonic() + grace_s
+        while self.executor.active_tasks() > 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        # give the status reporter one last chance to flush results
+        for _ in range(20):
+            if self._status_queue.empty():
+                break
+            time.sleep(0.1)
+        self.stop(notify=True)
 
     def stop(self, notify: bool = True) -> None:
         self._stop.set()
@@ -207,7 +245,10 @@ class ExecutorServer:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(HEARTBEAT_INTERVAL_S):
             try:
-                self.scheduler.heartbeat(self.metadata.executor_id)
+                # metadata rides along so a restarted scheduler re-registers
+                # us (reference heart_beat_from_executor, grpc.rs:174-241)
+                self.scheduler.heartbeat(self.metadata.executor_id,
+                                         meta=self.metadata)
             except Exception:  # noqa: BLE001 — retried next interval
                 log.warning("heartbeat to scheduler failed", exc_info=True)
 
@@ -219,10 +260,41 @@ class ExecutorServer:
         return {"accepted": len(tasks)}, b""
 
     def _report_status(self, status: TaskStatus) -> None:
-        try:
-            self.scheduler.update_task_status(self.metadata.executor_id, [status])
-        except Exception:  # noqa: BLE001
-            log.exception("status report to scheduler failed")
+        # push mode routes through the batching reporter loop so a transient
+        # scheduler-connection failure can never lose a TaskStatus (the
+        # reference batches + retries the same way, executor_server.rs
+        # TaskRunnerPool reporter loop; pull mode re-queues in _poll_loop)
+        self._status_queue.put(status)
+
+    def _reporter_loop(self) -> None:
+        pending: List[TaskStatus] = []
+        while not self._stop.is_set():
+            try:
+                pending.append(self._status_queue.get(timeout=0.2))
+            except queue.Empty:
+                pass
+            while True:
+                try:
+                    pending.append(self._status_queue.get_nowait())
+                except queue.Empty:
+                    break
+            if not pending:
+                continue
+            try:
+                self.scheduler.update_task_status(self.metadata.executor_id,
+                                                  list(pending))
+                pending.clear()
+            except Exception:  # noqa: BLE001 — keep and retry next round
+                log.warning("status report failed (%d pending, will retry)",
+                            len(pending), exc_info=True)
+                self._stop.wait(1.0)
+        # final best-effort flush on shutdown
+        if pending:
+            try:
+                self.scheduler.update_task_status(self.metadata.executor_id,
+                                                  list(pending))
+            except Exception:  # noqa: BLE001
+                pass
 
     def _cancel_tasks(self, payload: dict, _bin: bytes):
         self.executor.cancel_job_tasks(payload["job_id"])
@@ -234,6 +306,8 @@ class ExecutorServer:
         return os.path.commonpath([base, target]) == base
 
     def _fetch_partition(self, payload: dict, _bin: bytes):
+        if self._dp_token and payload.get("token", "") != self._dp_token:
+            raise ExecutionError("data plane auth failed")
         path = payload["path"]
         if not self._is_under_work_dir(path):
             raise ExecutionError(f"path {path!r} escapes the work dir")

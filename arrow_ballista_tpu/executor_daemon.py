@@ -62,7 +62,7 @@ def main(argv=None) -> None:
     while not stop:
         time.sleep(0.5)
     logging.info("executor draining %d tasks", server.executor.active_tasks())
-    server.stop()
+    server.drain_and_stop()
 
 
 if __name__ == "__main__":

@@ -56,6 +56,19 @@ def round_capacity(n: int, minimum: int = 1024) -> int:
     return cap
 
 
+def _null_mask(f, arr: np.ndarray):
+    """Boolean mask of NULL (in-band sentinel) positions for a nullable
+    non-string field; None when the field can't hold NULLs.  This is the
+    decode half of the sentinel discipline — the reference's Arrow validity
+    bitmaps exist only at materialization boundaries here."""
+    if not f.nullable or f.dtype.is_string:
+        return None
+    sent = f.dtype.null_sentinel
+    if isinstance(sent, float) and sent != sent:  # NaN
+        return np.isnan(arr)
+    return arr == sent
+
+
 class ColumnBatch:
     def __init__(
         self,
@@ -164,6 +177,7 @@ class ColumnBatch:
         arrays, fields = [], []
         for f in self.schema:
             arr = data[f.name]
+            null_mask = _null_mask(f, arr)  # in-band sentinels -> arrow nulls
             if f.dtype.is_string:
                 dic = self.dicts.get(f.name)
                 if dic is None or len(dic) == 0:
@@ -177,19 +191,20 @@ class ColumnBatch:
                 )
                 fields.append(pa.field(f.name, pa_arr.type))
             elif f.dtype.kind == "date32":
-                pa_arr = pa.array(arr, type=pa.date32())
+                pa_arr = pa.array(arr, type=pa.date32(), mask=null_mask)
                 fields.append(pa.field(f.name, pa.date32()))
             elif f.dtype.is_decimal:
                 import decimal as pydec
 
                 t = pa.decimal128(38, f.dtype.scale)
                 scale_exp = -f.dtype.scale
-                pa_arr = pa.array(
-                    [pydec.Decimal(int(v)).scaleb(scale_exp) for v in arr], type=t
-                )
+                vals = [pydec.Decimal(int(v)).scaleb(scale_exp) for v in arr]
+                if null_mask is not None:
+                    vals = [None if m else v for v, m in zip(vals, null_mask)]
+                pa_arr = pa.array(vals, type=t)
                 fields.append(pa.field(f.name, t))
             else:
-                pa_arr = pa.array(arr)
+                pa_arr = pa.array(arr, mask=null_mask)
                 fields.append(pa.field(f.name, pa_arr.type))
             arrays.append(pa_arr)
         return pa.table(arrays, schema=pa.schema(fields))
@@ -210,11 +225,26 @@ class ColumnBatch:
                     vals = dic[np.clip(arr, 0, len(dic) - 1)]
                     out[f.name] = np.where((arr >= 0) & (arr < len(dic)), vals, None)
             elif f.dtype.is_decimal:
-                out[f.name] = arr.astype(np.float64) / (10.0 ** f.dtype.scale)
+                vals = arr.astype(np.float64) / (10.0 ** f.dtype.scale)
+                m = _null_mask(f, arr)
+                if m is not None:
+                    vals = np.where(m, np.nan, vals)
+                out[f.name] = vals
             elif f.dtype.kind == "date32":
-                out[f.name] = arr.astype("datetime64[D]")
+                vals = arr.astype("datetime64[D]")
+                m = _null_mask(f, arr)
+                if m is not None:
+                    vals = vals.copy()
+                    vals[m] = np.datetime64("NaT")
+                out[f.name] = vals
             else:
-                out[f.name] = arr
+                m = _null_mask(f, arr)
+                if m is not None and m.any() and arr.dtype.kind in ("i", "u"):
+                    # pandas convention: nullable ints materialize as float64
+                    # with NaN holes
+                    out[f.name] = np.where(m, np.nan, arr.astype(np.float64))
+                else:
+                    out[f.name] = arr
         return pd.DataFrame(out)
 
     def __repr__(self):

@@ -54,7 +54,8 @@ def dataplane() -> Optional[ctypes.CDLL]:
             _dataplane_failed = True
             return None
         lib = ctypes.CDLL(so)
-        lib.dp_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dp_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                 ctypes.c_char_p, ctypes.c_int]
         lib.dp_start.restype = ctypes.c_int
         lib.dp_stop.argtypes = []
         lib.dp_stop.restype = None

@@ -28,10 +28,16 @@ def fetch_partition_batches(host: str, port: int, path: str, schema: Schema,
 
     from ..models.ipc import physical_table_to_batches
 
+    import os
+
+    req = {"path": path}
+    token = os.environ.get("BALLISTA_DATA_PLANE_TOKEN", "")
+    if token:
+        req["token"] = token
     err: Exception = RuntimeError("unreachable")
     for attempt in range(retries):
         try:
-            _, data = wire.call(host, port, "fetch_partition", {"path": path})
+            _, data = wire.call(host, port, "fetch_partition", req)
             table = ipc.open_file(io.BytesIO(data)).read_all()
             return physical_table_to_batches(table, schema, capacity=capacity)
         except Exception as e:  # noqa: BLE001 — caller maps to its taxonomy

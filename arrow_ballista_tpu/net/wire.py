@@ -4,13 +4,16 @@ The reference uses tonic gRPC (control plane) + Arrow Flight (data plane)
 over HTTP/2 (reference ballista/core/src/utils.rs:434-461 tuned endpoints,
 client.rs Flight streams).  Here both planes share one framing:
 
-    frame := u32 json_len | json bytes | u32 bin_len | bin bytes
+    frame := u32 json_len | u64 bin_len | json bytes | bin bytes
 
 Control messages put everything in the JSON part; the data plane returns
-Arrow IPC file bytes in the binary part (no base64 overhead).  Requests
-carry a ``method`` field; responses carry ``ok`` plus either payload or
-``error``.  TCP_NODELAY is set on every socket (same reason the reference
-does: small control frames must not wait on Nagle).
+Arrow IPC file bytes in the binary part (no base64 overhead).  The binary
+length is 64-bit so multi-GiB shuffle partitions stream without truncation
+(the reference's Flight streams are unbounded; a u32 here silently
+corrupted >4 GiB files).  Requests carry a ``method`` field; responses
+carry ``ok`` plus either payload or ``error``.  TCP_NODELAY is set on
+every socket (same reason the reference does: small control frames must
+not wait on Nagle).
 """
 from __future__ import annotations
 
@@ -19,8 +22,9 @@ import socket
 import struct
 from typing import Optional, Tuple
 
-_HDR = struct.Struct("!II")
-MAX_FRAME = 1 << 30  # 1 GiB guard
+_HDR = struct.Struct("!IQ")
+MAX_FRAME = 1 << 30  # 1 GiB guard for the JSON part
+MAX_BIN = 1 << 40  # 1 TiB guard for the binary part
 
 
 def send_frame(sock: socket.socket, obj: dict, binary: bytes = b"") -> None:
@@ -43,7 +47,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
     hdr = _recv_exact(sock, _HDR.size)
     jlen, blen = _HDR.unpack(hdr)
-    if jlen > MAX_FRAME or blen > MAX_FRAME:
+    if jlen > MAX_FRAME or blen > MAX_BIN:
         raise ConnectionError(f"oversized frame ({jlen}/{blen})")
     obj = json.loads(_recv_exact(sock, jlen)) if jlen else {}
     binary = _recv_exact(sock, blen) if blen else b""

@@ -297,6 +297,15 @@ class ExprCompiler:
 
         if isinstance(e, E.Not):
             oc = self._c(e.operand)
+            # NOT over a NULL comparison is still NULL -> false in WHERE:
+            # re-apply the validity term outside the negation (the inner
+            # compile already made the NULL case false, which ~ would flip)
+            if isinstance(e.operand, (E.InList,)) or (
+                isinstance(e.operand, E.BinOp) and e.operand.op in E.BinOp.COMPARISONS
+            ):
+                valid = self.validity_fn(self.nullable_refs(e.operand))
+                if valid is not None:
+                    return Compiled(lambda c, a: ~oc.fn(c, a) & valid(c, a), BOOL)
             return Compiled(lambda c, a: ~oc.fn(c, a), BOOL)
 
         if isinstance(e, E.Negate):
@@ -344,12 +353,18 @@ class ExprCompiler:
                 )
             vals = [self._lit_physical(E.Lit(v), oc.dtype) for v in e.values]
 
+            valid = self.validity_fn(self.nullable_refs(e.operand))
+
             def inlist_fn(c, a):
                 x = oc.fn(c, a)
                 m = xp.zeros(x.shape, dtype=bool)
                 for v in vals:
                     m = m | (x == v)
-                return ~m if e.negated else m
+                m = ~m if e.negated else m
+                # NULL IN (...) and NULL NOT IN (...) are both NULL -> false
+                if valid is not None:
+                    m = m & valid(c, a)
+                return m
 
             return Compiled(inlist_fn, BOOL)
 
@@ -445,8 +460,138 @@ class ExprCompiler:
             return Compiled(lambda cc, a, v=v, t=npdt: xp.asarray(v, dtype=t), to, lit_value=c.lit_value)
         return Compiled(self._coerce(c.fn, c.dtype, to), to, c.dict_fn if to.is_string else None)
 
+    # --- NULL validity --------------------------------------------------
+    def nullable_refs(self, e: E.Expr) -> list:
+        """Nullable non-string column refs of ``e`` (strings carry NULL as
+        code -1 and every string predicate path already excludes it)."""
+        return sorted(
+            n for n in e.column_refs()
+            if n in self.schema
+            and self.schema.field(n).nullable
+            and not self.schema.field(n).dtype.is_string
+        )
+
+    def validity_fn(self, names) -> Optional[Callable]:
+        """(cols, aux) -> bool mask, True where every named column is
+        non-NULL (sentinel-free).  None when nothing is nullable."""
+        if not names:
+            return None
+        xp = self.xp
+        terms = []
+        for n in names:
+            sent = self.schema.field(n).dtype.null_sentinel
+            if isinstance(sent, float) and sent != sent:  # NaN
+                terms.append(lambda c, a, n=n: ~xp.isnan(c[n]))
+            else:
+                terms.append(lambda c, a, n=n, s=sent: c[n] != s)
+
+        def valid(c, a):
+            m = terms[0](c, a)
+            for t in terms[1:]:
+                m = m & t(c, a)
+            return m
+
+        return valid
+
+    # --- three-valued predicate compilation ------------------------------
+    def compile_pred(self, expr: E.Expr) -> Compiled:
+        """Compile a WHERE/HAVING/join predicate under SQL three-valued
+        logic, collapsed to its TRUE-mask (rows kept).  Kleene composition:
+        the collapsed value at every node is exactly "this subtree is TRUE",
+        and a parallel validity ("not NULL") stream makes NOT correct over
+        arbitrary boolean combinations — ``NOT (x < 50 or x > 100)`` with
+        NULL x is NULL, not TRUE.  (The reference gets this from Arrow
+        validity bitmaps flowing through DataFusion's kernels.)"""
+        coll, _valid = self._pred3(fold_constants(expr))
+        return Compiled(coll, BOOL)
+
+    def _pred3(self, e: E.Expr):
+        """Returns (true_mask_fn, valid_fn).  valid_fn None means
+        never-NULL."""
+        xp = self.xp
+        if isinstance(e, E.BinOp) and e.op in E.BinOp.BOOLEANS:
+            lc, lv = self._pred3(e.left)
+            rc, rv = self._pred3(e.right)
+            if e.op == "and":
+                coll = lambda c, a: lc(c, a) & rc(c, a)  # noqa: E731
+                if lv is None and rv is None:
+                    valid = None
+                else:
+                    # Kleene AND: valid iff both valid, or either is
+                    # (validly) FALSE — FALSE dominates NULL
+                    def valid(c, a, lc=lc, rc=rc, lv=lv, rv=rv):
+                        l_ok = lv(c, a) if lv is not None else True
+                        r_ok = rv(c, a) if rv is not None else True
+                        return (l_ok & r_ok) | (l_ok & ~lc(c, a)) | (r_ok & ~rc(c, a))
+            else:
+                coll = lambda c, a: lc(c, a) | rc(c, a)  # noqa: E731
+                if lv is None and rv is None:
+                    valid = None
+                else:
+                    # Kleene OR: TRUE dominates NULL
+                    def valid(c, a, lc=lc, rc=rc, lv=lv, rv=rv):
+                        l_ok = lv(c, a) if lv is not None else True
+                        r_ok = rv(c, a) if rv is not None else True
+                        return (l_ok & r_ok) | lc(c, a) | rc(c, a)
+            return coll, valid
+        if isinstance(e, E.Not):
+            oc, ov = self._pred3(e.operand)
+            if ov is None:
+                return (lambda c, a: ~oc(c, a)), None
+            # NOT NULL is NULL: TRUE-mask = valid AND (validly) not-TRUE
+            return (lambda c, a: ov(c, a) & ~oc(c, a)), ov
+        if isinstance(e, E.IsNull):
+            # IS [NOT] NULL is itself never NULL
+            return self._c(e).fn, None
+        # leaves (comparisons, IN, LIKE, boolean columns): _c already
+        # collapses NULL -> FALSE; validity covers every nullable ref
+        coll = self._c(e).fn
+        valid = self._leaf_validity(e)
+        return coll, valid
+
+    def _leaf_validity(self, e: E.Expr):
+        """Validity over every nullable column a leaf predicate references,
+        including nullable *string* columns (NULL string = code -1)."""
+        terms = []
+        xp = self.xp
+        for n in sorted(e.column_refs()):
+            if n not in self.schema or not self.schema.field(n).nullable:
+                continue
+            f = self.schema.field(n)
+            if f.dtype.is_string:
+                terms.append(lambda c, a, n=n: c[n] >= 0)
+            else:
+                sent = f.dtype.null_sentinel
+                if isinstance(sent, float) and sent != sent:
+                    terms.append(lambda c, a, n=n: ~xp.isnan(c[n]))
+                else:
+                    terms.append(lambda c, a, n=n, s=sent: c[n] != s)
+        if not terms:
+            return None
+
+        def valid(c, a):
+            m = terms[0](c, a)
+            for t in terms[1:]:
+                m = m & t(c, a)
+            return m
+
+        return valid
+
     # --- comparisons ----------------------------------------------------
     def _compile_comparison(self, e: E.BinOp) -> Compiled:
+        """SQL comparison: NULL operands compare as false (the WHERE-clause
+        collapse of three-valued logic) — the result is ANDed with a
+        validity term over every nullable column referenced (in-band
+        sentinels are otherwise ordinary values; reference semantics come
+        from Arrow validity bitmaps, which this engine replaces with
+        sentinels + masks)."""
+        c = self._compile_comparison_raw(e)
+        valid = self.validity_fn(self.nullable_refs(e))
+        if valid is None:
+            return c
+        return Compiled(lambda cols, a: c.fn(cols, a) & valid(cols, a), BOOL)
+
+    def _compile_comparison_raw(self, e: E.BinOp) -> Compiled:
         xp = self.xp
         sch = self.schema
         lt = e.left.dtype(sch)

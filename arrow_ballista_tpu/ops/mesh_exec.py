@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from ..models import expr as E
 from ..models.batch import ColumnBatch, concat_batches
 from ..models.schema import Field, Schema
-from ..utils.config import AGG_CAPACITY
+from ..utils.config import AGG_CAPACITY, JOIN_OUTPUT_FACTOR
 from ..utils.errors import CapacityError
 from .expressions import ExprCompiler
 from .operators import AggSpec, HashAggregateExec
@@ -178,3 +178,176 @@ class MeshAggregateExec(ExecutionPlan):
         g = ", ".join(n for _, n in self.group_exprs)
         a = ", ".join(f"{x.func}({x.name})" for x in self.aggs)
         return f"MeshAggregateExec(fused partial+all_to_all+final): groupBy=[{g}] aggr=[{a}]"
+
+
+class MeshJoinExec(ExecutionPlan):
+    """Fused partitioned equi-join over every local device.
+
+    Replaces JoinExec(partitioned) <- Repartition(hash) x2 when the mesh
+    path is enabled: both sides all_to_all by key bucket, then a per-device
+    sorted-build/searchsorted-probe join — ONE XLA program where the
+    reference materializes two shuffles and a reduce stage (exchange rules
+    planner.rs:133-152; SURVEY.md §2.5 TP row).  Results are identical to
+    the file-shuffle JoinExec path — verified by tests/test_mesh_exec.py.
+    """
+
+    def __init__(self, left: ExecutionPlan, right: ExecutionPlan,
+                 on: List[Tuple[E.Expr, E.Expr]], join_type: str = "inner"):
+        assert join_type in ("inner", "left", "semi", "anti")
+        self.left = left
+        self.right = right
+        self.on = on
+        self.join_type = join_type
+        if join_type in ("semi", "anti"):
+            self._schema = left.schema
+        elif join_type == "left":
+            self._schema = Schema(
+                list(left.schema)
+                + [Field(f.name, f.dtype, nullable=True) for f in right.schema])
+        else:
+            self._schema = left.schema.merge(right.schema)
+        self._compiled = None
+
+    @staticmethod
+    def eligible(on, join_type, filter, lsch, rsch) -> bool:
+        if join_type not in ("inner", "left", "semi", "anti"):
+            return False
+        if filter is not None:
+            return False  # pair filters not fused yet
+        for le, re_ in on:
+            for e, sch in ((le, lsch), (re_, rsch)):
+                try:
+                    dt = e.dtype(sch)
+                except Exception:  # noqa: BLE001
+                    return False
+                if dt.is_float:
+                    return False
+        return True
+
+    def children(self):
+        return [self.left, self.right]
+
+    def output_partition_count(self):
+        return 1
+
+    def output_partitioning(self):
+        return Partitioning.single()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        from ..parallel.distributed import distributed_hash_join
+        from ..parallel.mesh import make_mesh, row_sharding
+
+        assert partition == 0
+        lsch, rsch = self.left.schema, self.right.schema
+        probe = concat_batches(lsch, [b for p in range(self.left.output_partition_count())
+                                      for b in self.left.execute(p, ctx)]).shrink()
+        build = concat_batches(rsch, [b for p in range(self.right.output_partition_count())
+                                      for b in self.right.execute(p, ctx)]).shrink()
+
+        n_dev = len(jax.devices())
+        mesh = make_mesh(n_dev)
+
+        if self._compiled is None:
+            lcomp = ExprCompiler(lsch, "device")
+            rcomp = ExprCompiler(rsch, "device")
+            lkeys = [lcomp.compile_key(le) for le, _ in self.on]
+            rkeys = [rcomp.compile_key(re_) for _, re_ in self.on]
+            self._compiled = (lcomp, rcomp, lkeys, rkeys)
+        lcomp, rcomp, lkeys, rkeys = self._compiled
+        laux = lcomp.aux_arrays(probe.dicts)
+        raux = rcomp.aux_arrays(build.dicts)
+
+        sflags = [c.dtype.is_string for c in lkeys]
+
+        def with_keys(cols, mask, keys_c, aux):
+            out = dict(cols)
+            for i, kc in enumerate(keys_c):
+                k = kc.fn(cols, aux)
+                out[f"__jk{i}"] = (jnp.broadcast_to(k, mask.shape)
+                                   if k.ndim == 0 else k)
+            return out
+
+        pcols = with_keys(probe.columns, probe.mask, lkeys, laux)
+        bcols = with_keys(build.columns, build.mask, rkeys, raux)
+
+        # NULL join keys never match (SQL): drop NULL-key build rows always;
+        # drop NULL-key probe rows too for inner/semi (left/anti must keep
+        # them — they surface as unmatched).  String-key NULLs are excluded
+        # in-join via the NULL_KEY_SENTINEL; this covers nullable numerics.
+        def key_valid(comp, exprs, cols, mask, aux):
+            m = mask
+            for e in exprs:
+                vf = comp.validity_fn(comp.nullable_refs(e))
+                if vf is not None:
+                    m = m & vf(cols, aux)
+            return m
+
+        bmask_in = key_valid(rcomp, [re_ for _, re_ in self.on],
+                             build.columns, build.mask, raux)
+        pmask_in = probe.mask
+        if self.join_type in ("inner", "semi"):
+            pmask_in = key_valid(lcomp, [le for le, _ in self.on],
+                                 probe.columns, probe.mask, laux)
+
+        # shard rows over the mesh (pad to a multiple of the device count)
+        sharding = row_sharding(mesh)
+
+        def shard_side(cols, mask):
+            rows = mask.shape[0]
+            per = -(-rows // n_dev)
+            padded = per * n_dev
+
+            def pad(arr, fill=0):
+                if padded != rows:
+                    arr = jnp.concatenate(
+                        [arr, jnp.full((padded - rows,), fill, arr.dtype)])
+                return jax.device_put(arr, sharding)
+
+            return ({k: pad(v) for k, v in cols.items()},
+                    pad(mask, fill=False), padded)
+
+        dp, dpm, p_rows = shard_side(pcols, pmask_in)
+        db, dbm, b_rows = shard_side(bcols, bmask_in)
+
+        out_factor = ctx.config.get(JOIN_OUTPUT_FACTOR)
+        # per-device shuffle capacity: worst case every row of a side hashes
+        # to one bucket of one device's send buffer; factor 2 covers skew,
+        # overflow re-runs at the true bound
+        shuf_cap = max(64, 2 * max(p_rows, b_rows) // n_dev)
+        # per-device output bound: a device can receive up to n_dev bucket
+        # blocks of shuf_cap rows; fan-out beyond out_factor per probe row
+        # triggers the overflow-retry doubling below
+        out_cap = max(64, out_factor * shuf_cap)
+        rfill = {f.name: f.dtype.null_sentinel for f in rsch}
+
+        attempts = 0
+        while True:
+            run = distributed_hash_join(
+                mesh, len(self.on), list(lsch.names()), list(rsch.names()),
+                self.join_type, shuf_cap, out_cap, rfill,
+                string_key_flags=sflags,
+                null_key_sentinel=int(ExprCompiler.NULL_KEY_SENTINEL))
+            out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
+            if not bool(overflow):
+                break
+            attempts += 1
+            if attempts > 3:
+                raise CapacityError(
+                    "mesh join overflowed its shuffle/output capacity "
+                    f"(shuffle {shuf_cap}, out {out_cap}) after retries")
+            shuf_cap *= 2
+            out_cap *= 2
+            self.metrics().add("capacity_recompiles", 1)
+
+        dicts = dict(probe.dicts)
+        if self.join_type in ("inner", "left"):
+            dicts.update(build.dicts)
+        result = ColumnBatch(self._schema, dict(out_cols), out_mask, dicts)
+        self.metrics().add("output_rows", result.num_rows)
+        self.metrics().add("mesh_devices", n_dev)
+        return [result]
+
+    def _label(self):
+        on = ", ".join(f"{l} = {r}" for l, r in self.on)
+        return (f"MeshJoinExec({self.join_type}, fused all_to_all both sides): "
+                f"on=[{on}]")

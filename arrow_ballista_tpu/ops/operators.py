@@ -44,6 +44,27 @@ def _substitute_scalars(e: E.Expr, scalars: Dict[str, object]) -> E.Expr:
     return _map_children(e, lambda c: _substitute_scalars(c, scalars))
 
 
+def _null_transparent(e: E.Expr) -> bool:
+    """True when NULL inputs imply a NULL output (plain columns, arithmetic,
+    casts).  IS NULL and CASE can *launder* NULLs into real values, so
+    sentinel re-assertion must not run over them."""
+    if isinstance(e, (E.IsNull, E.Case)):
+        return False
+    return all(_null_transparent(c) for c in e.children())
+
+
+def _expr_nullable(e: E.Expr, schema: Schema) -> bool:
+    """Whether an expression's output can be NULL: any referenced column is
+    nullable.  Boolean outputs are excluded (predicates compile to two-valued
+    logic; NULL comparisons are already false)."""
+    dt = e.dtype(schema)
+    if dt.kind == "bool":
+        return False
+    return any(
+        n in schema and schema.field(n).nullable for n in e.column_refs()
+    )
+
+
 class ProjectionExec(ExecutionPlan):
     """Computes output columns; ``host_mode`` runs in numpy float64 (used for
     tiny post-aggregation projections containing division)."""
@@ -54,7 +75,10 @@ class ProjectionExec(ExecutionPlan):
         self.exprs = exprs
         self.host_mode = host_mode
         in_schema = input.schema
-        self._schema = Schema(Field(n, e.dtype(in_schema)) for e, n in exprs)
+        self._schema = Schema(
+            Field(n, e.dtype(in_schema), _expr_nullable(e, in_schema))
+            for e, n in exprs
+        )
         self._compiled = None
 
     def children(self):
@@ -68,7 +92,26 @@ class ProjectionExec(ExecutionPlan):
 
     def _compile(self, scalars):
         comp = ExprCompiler(self.input.schema, "host" if self.host_mode else "device")
-        compiled = [(comp.compile(_substitute_scalars(e, scalars)), n) for e, n in self.exprs]
+        xp = np if self.host_mode else jnp
+        compiled = []
+        for e, n in self.exprs:
+            c = comp.compile(_substitute_scalars(e, scalars))
+            # NULL propagation: an expression over a NULL input is NULL, so
+            # non-bool, non-string outputs re-assert the *output* dtype's
+            # sentinel wherever any nullable input column holds its sentinel
+            # (arithmetic on in-band sentinels otherwise yields garbage)
+            out_f = self._schema.field(n)
+            if out_f.nullable and _null_transparent(e) \
+                    and not c.dtype.is_string and c.dtype.kind != "bool":
+                valid = comp.validity_fn(comp.nullable_refs(e))
+                if valid is not None:
+                    sent = xp.asarray(out_f.dtype.null_sentinel,
+                                      dtype=out_f.dtype.np_dtype)
+                    c = Compiled(
+                        lambda cols, a, f=c.fn, v=valid, s=sent: xp.where(
+                            v(cols, a), f(cols, a), s),
+                        c.dtype, c.dict_fn, c.lit_value)
+            compiled.append((c, n))
         if not self.host_mode:
             fns = [(c.fn, n) for c, n in compiled]
 
@@ -176,7 +219,7 @@ class FilterExec(ExecutionPlan):
         if self._compiled is None:
             comp = ExprCompiler(self.input.schema,
                                 "host" if self.host_mode else "device")
-            pred = comp.compile(_substitute_scalars(self.predicate, ctx.scalars))
+            pred = comp.compile_pred(_substitute_scalars(self.predicate, ctx.scalars))
             if pred.dtype != BOOL:
                 raise InternalError("filter predicate must be boolean")
             if self.host_mode:
@@ -238,11 +281,24 @@ class HashAggregateExec(ExecutionPlan):
         self.aggs = aggs
         self.mode = mode
         in_schema = input.schema
-        fields = [Field(n, e.dtype(in_schema)) for e, n in group_exprs]
+        fields = [Field(n, e.dtype(in_schema), _expr_nullable(e, in_schema))
+                  for e, n in group_exprs]
         for a in self.aggs:
-            fields.append(Field(a.name, self._agg_dtype(a, in_schema)))
+            fields.append(Field(a.name, self._agg_dtype(a, in_schema),
+                                self._agg_nullable(a, in_schema)))
         self._schema = Schema(fields)
         self._compiled = None
+
+    def _agg_nullable(self, a: AggSpec, in_schema: Schema) -> bool:
+        """SQL: sum/min/max yield NULL for an all-NULL group (nullable
+        operand) and for a global aggregate over empty input; count never
+        does."""
+        if a.func == "count":
+            return False
+        if self.mode == "final":
+            return in_schema.field(a.name).nullable
+        op_nullable = a.operand is not None and _expr_nullable(a.operand, in_schema)
+        return op_nullable or not self.group_exprs
 
     def _agg_dtype(self, a: AggSpec, in_schema: Schema) -> DataType:
         if self.mode == "final":
@@ -285,26 +341,46 @@ class HashAggregateExec(ExecutionPlan):
                     operand = a.operand if a.operand is not None else None
                     how = a.func
                 cc = comp.compile(_substitute_scalars(operand, ctx.scalars)) if operand is not None else None
-                # SQL NULL semantics: aggregates skip NULL inputs.  Nullable
-                # operands (outer-join columns) carry the in-band sentinel.
-                sent = None
-                if (self.mode != "final" and isinstance(operand, E.Column)
-                        and operand.name in in_schema
-                        and in_schema.field(operand.name).nullable):
-                    sent = in_schema.field(operand.name).dtype.null_sentinel
-                agg_c.append((cc, how, a.name, sent))
+                # SQL NULL semantics: aggregates skip NULL inputs.  The
+                # check is VALUE-based — the computed operand equals its
+                # dtype's in-band sentinel — so CASE/IS NULL expressions
+                # that launder NULLs into real values still count (a
+                # ref-based check would wrongly skip those rows).
+                null_check = None
+                if cc is not None and operand is not None:
+                    refs_nullable = any(
+                        n in in_schema and in_schema.field(n).nullable
+                        for n in operand.column_refs())
+                    if cc.dtype.is_string:
+                        if refs_nullable:
+                            null_check = "string"
+                    elif refs_nullable:
+                        null_check = cc.dtype.null_sentinel
+                agg_c.append((cc, how, a.name, null_check))
+            # nullable sum/min/max also aggregate a hidden per-group valid
+            # count, so an all-NULL group can be restored to NULL afterwards
+            tracked = [i for i, (cc, how, _, nc) in enumerate(agg_c)
+                       if nc is not None and how in ("sum", "min", "max")]
+
+            def _valid_of(v, null_check):
+                if null_check == "string":
+                    return v >= 0
+                if isinstance(null_check, float) and null_check != null_check:
+                    return ~jnp.isnan(v)
+                return v != jnp.asarray(null_check, dtype=v.dtype)
 
             def agg_fn(cols, mask, aux, out_cap):
                 keys = [c.fn(cols, aux) for c, _ in group_c]
                 vals = []
-                for cc, how, _, sent in agg_c:
+                valids = {}
+                for i, (cc, how, _, null_check) in enumerate(agg_c):
                     if cc is None:  # count(*)
                         vals.append((jnp.zeros(mask.shape, jnp.int64), K.AGG_COUNT))
                         continue
                     v = cc.fn(cols, aux)
-                    if sent is not None:
-                        valid = jnp.isnan(v) == False if isinstance(sent, float) and sent != sent \
-                            else v != sent  # noqa: E712 — jnp elementwise
+                    if null_check is not None:
+                        valid = _valid_of(v, null_check)
+                        valids[i] = valid
                         if how == "count":
                             vals.append((valid.astype(jnp.int64), K.AGG_SUM))
                             continue
@@ -315,19 +391,33 @@ class HashAggregateExec(ExecutionPlan):
                         elif how == "max":
                             v = jnp.where(valid, v, K._min_ident(v.dtype))
                     vals.append((v, how))
+                for i in tracked:
+                    vals.append((valids[i].astype(jnp.int64), K.AGG_SUM))
                 return K.grouped_aggregate(keys, vals, mask, out_cap)
 
-            self._compiled = (comp, group_c, agg_c, jax.jit(agg_fn, static_argnums=(3,)))
+            self._compiled = (comp, group_c, agg_c, tracked,
+                              jax.jit(agg_fn, static_argnums=(3,)))
 
-        comp, group_c, agg_c, jfn = self._compiled
+        comp, group_c, agg_c, tracked, jfn = self._compiled
+        # adaptive capacity: AGG_CAPACITY is the *initial* guess; on overflow
+        # retry at the next power-of-two (bounded by the input capacity —
+        # groups can never exceed live rows).  Mirrors the join's bucketed
+        # recompilation; static shapes stay static per bucket.
         out_cap = min(cfg_cap, big.capacity)
         with self.metrics().timer("agg_time"):
             aux = comp.aux_arrays(big.dicts)
-            out_keys, out_vals, out_mask, overflow = jfn(big.columns, big.mask, aux, out_cap)
-        if bool(overflow):
-            raise CapacityError(
-                f"aggregation exceeded {out_cap} groups; raise {AGG_CAPACITY}"
-            )
+            while True:
+                out_keys, out_vals, out_mask, overflow = jfn(
+                    big.columns, big.mask, aux, out_cap)
+                if not bool(overflow):
+                    break
+                if out_cap >= big.capacity:
+                    raise CapacityError(
+                        f"aggregation overflowed {out_cap} groups with "
+                        f"{big.capacity}-row input; this should be impossible"
+                    )
+                out_cap = min(out_cap * 2, big.capacity)
+                self.metrics().add("capacity_recompiles", 1)
 
         cols: Dict[str, jnp.ndarray] = {}
         dicts: Dict[str, np.ndarray] = {}
@@ -335,18 +425,30 @@ class HashAggregateExec(ExecutionPlan):
             cols[name] = arr
             if cc.dict_fn is not None:
                 dicts[name] = cc.dict_fn(big.dicts)
-        for (cc, how, name, _), arr in zip(agg_c, out_vals):
+        main_vals = out_vals[: len(agg_c)]
+        for (cc, how, name, _), arr in zip(agg_c, main_vals):
             cols[name] = arr
+        # all-NULL groups: restore NULL (output sentinel) where the hidden
+        # valid count is zero
+        for i, cnt in zip(tracked, out_vals[len(agg_c) :]):
+            name = agg_c[i][2]
+            f = self._schema.field(name)
+            sent = jnp.asarray(f.dtype.null_sentinel, dtype=f.dtype.np_dtype)
+            cols[name] = jnp.where(cnt > 0, cols[name], sent)
 
         result = ColumnBatch(self._schema, cols, out_mask, dicts)
 
         # SQL semantics: a global aggregate ('single'/'final' with no keys)
-        # over empty input yields one row (count=0, sums empty)
+        # over empty input yields one row: count = 0, sum/min/max = NULL
         if not self.group_exprs and self.mode in ("single", "final") and result.num_rows == 0:
             data = {}
             for a in self.aggs:
                 f = self._schema.field(a.name)
-                data[a.name] = np.zeros(1, dtype=f.dtype.np_dtype)
+                if f.nullable:
+                    data[a.name] = np.asarray([f.dtype.null_sentinel],
+                                              dtype=f.dtype.np_dtype)
+                else:
+                    data[a.name] = np.zeros(1, dtype=f.dtype.np_dtype)
             result = ColumnBatch.from_numpy(self._schema, data, dicts={})
         self.metrics().add("output_rows", result.num_rows)
         return [result]
@@ -422,11 +524,15 @@ class JoinExec(ExecutionPlan):
             rcomp = ExprCompiler(rsch, "device")
             lkeys = [lcomp.compile_key(le) for le, _ in self.on]
             rkeys = [rcomp.compile_key(re_) for _, re_ in self.on]
+            # NULL join keys never match (string keys handle this via the
+            # NULL_KEY_SENTINEL below; numeric nullable keys via validity)
+            lkey_valid = [lcomp.validity_fn(lcomp.nullable_refs(le)) for le, _ in self.on]
+            rkey_valid = [rcomp.validity_fn(rcomp.nullable_refs(re_)) for _, re_ in self.on]
             fcomp = fpred = None
             if self.filter is not None:
                 merged = lsch.merge(rsch)
                 fcomp = ExprCompiler(merged, "device")
-                fpred = fcomp.compile(_substitute_scalars(self.filter, ctx.scalars))
+                fpred = fcomp.compile_pred(_substitute_scalars(self.filter, ctx.scalars))
 
             jt = self.join_type
             lnames = [f.name for f in lsch]
@@ -444,11 +550,15 @@ class JoinExec(ExecutionPlan):
                 # string keys are value-hashes: exclude the NULL sentinel so
                 # NULL never equals NULL (SQL semantics)
                 ok = pair_valid & bmask[bidx]
-                for (a, b), ck in zip(zip(pk, bk), lkeys):
+                for i, ((a, b), ck) in enumerate(zip(zip(pk, bk), lkeys)):
                     ok = ok & (a[pi] == b[bidx])
                     if ck.dtype.is_string:
                         sent = ExprCompiler.NULL_KEY_SENTINEL
                         ok = ok & (a[pi] != sent)
+                    if lkey_valid[i] is not None:
+                        ok = ok & lkey_valid[i](pcols, laux)[pi]
+                    if rkey_valid[i] is not None:
+                        ok = ok & rkey_valid[i](bcols, raux)[bidx]
                 if fpred is not None:
                     pair_cols = {n: pcols[n][pi] for n in lnames}
                     pair_cols.update({n: bcols[n][bidx] for n in rnames})

@@ -263,7 +263,7 @@ class ScanExec(ExecutionPlan):
         # compile the conjunction once (per scan instance)
         if self._filter_fn is None:
             comp = ExprCompiler(self._schema, "device")
-            pred = comp.compile(E.and_all(self.filters))
+            pred = comp.compile_pred(E.and_all(self.filters))
             self._filter_compiler = comp
             self._filter_fn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
         out = []

@@ -200,10 +200,35 @@ class ShuffleReaderExec(ExecutionPlan):
                 remote.append(loc)
         with self.metrics().timer("fetch_time"):
             batches = read_ipc_files(paths, self._schema, capacity=ctx.config.batch_size)
-            for loc in remote:
-                batches.extend(self._fetch_remote(loc, ctx))
+            batches.extend(self._fetch_remote_all(remote, ctx))
         self.metrics().add("output_rows", sum(b.num_rows for b in batches))
         return batches
+
+    MAX_CONCURRENT_FETCHES = 50  # reference semaphore size, shuffle_reader.rs:123
+
+    def _fetch_remote_all(self, remote: List[PartitionLocation],
+                          ctx: TaskContext) -> List[ColumnBatch]:
+        """Bounded-concurrency remote fetch (reference send_fetch_partitions:
+        <=50 concurrent Flight fetches, locations shuffled so simultaneous
+        readers don't all hammer the same executor, shuffle_reader.rs:123,
+        267-318)."""
+        if not remote:
+            return []
+        if len(remote) == 1:
+            return self._fetch_remote(remote[0], ctx)
+        import random
+        from concurrent.futures import ThreadPoolExecutor
+
+        order = list(remote)
+        random.shuffle(order)
+        out: List[ColumnBatch] = []
+        with ThreadPoolExecutor(
+            max_workers=min(self.MAX_CONCURRENT_FETCHES, len(order)),
+            thread_name_prefix="shuffle-fetch",
+        ) as pool:
+            for got in pool.map(lambda loc: self._fetch_remote(loc, ctx), order):
+                out.extend(got)
+        return out
 
     def _fetch_remote(self, loc: PartitionLocation, ctx: TaskContext) -> List[ColumnBatch]:
         from ..net.dataplane import fetch_partition_batches

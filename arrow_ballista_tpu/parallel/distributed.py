@@ -99,6 +99,108 @@ def distributed_filter_aggregate(
     return run
 
 
+def distributed_hash_join(
+    mesh: Mesh,
+    n_keys: int,
+    probe_names: Sequence[str],
+    build_names: Sequence[str],
+    join_type: str,
+    shuffle_capacity: int,
+    out_capacity: int,
+    build_fill: Dict[str, object],
+    string_key_flags: Sequence[bool] = (),
+    null_key_sentinel: int = 0,
+    axis: str = PART_AXIS,
+):
+    """Fused partitioned hash join over the ICI mesh: key-bucket all_to_all
+    of BOTH sides, then per-device sorted-build/searchsorted-probe join —
+    one XLA program replacing the reference's two shuffle stage pairs +
+    reduce tasks (reference planner.rs:133-152 inserts hash RepartitionExec
+    under each join side; exchange inventory SURVEY.md §2.5).
+
+    Input cols carry join keys as ``__jk{i}`` (already compiled: numeric
+    pass-through or stable string hashes, ops/expressions.compile_key) plus
+    payload columns.  ``join_type``: inner | left | semi | anti.
+
+    Returns ``run((pcols, pmask), (bcols, bmask)) -> (out_cols, out_mask,
+    overflow)`` with outputs sharded over the mesh, ``out_capacity`` rows
+    per device (inner/left add probe capacity for unmatched-row append).
+    """
+    n = mesh_axis_size(mesh, axis)
+    key_names = [f"__jk{i}" for i in range(n_keys)]
+    sflags = list(string_key_flags) or [False] * n_keys
+
+    def per_shard(pcols, pmask, bcols, bmask):
+        pk = [pcols[k] for k in key_names]
+        bk = [bcols[k] for k in key_names]
+        # ship rows to their key-hash bucket owner (both sides agree)
+        pdest = K.bucket_of(pk, n)
+        bdest = K.bucket_of(bk, n)
+        p_recv, p_rmask, ovf_p = shuffle_rows(pcols, pdest, pmask, axis, n,
+                                              shuffle_capacity)
+        b_recv, b_rmask, ovf_b = shuffle_rows(bcols, bdest, bmask, axis, n,
+                                              shuffle_capacity)
+        rpk = [p_recv[k] for k in key_names]
+        rbk = [b_recv[k] for k in key_names]
+
+        bh_sorted, border, _ = K.build_side_sort(rbk, b_rmask)
+        ph = K.hash64(rpk)
+        pi, bp, pair_valid, total = K.probe_join(ph, p_rmask, bh_sorted,
+                                                 out_capacity)
+        bidx = border[bp]
+        ok = pair_valid & b_rmask[bidx]
+        for i, (a, b) in enumerate(zip(rpk, rbk)):
+            ok = ok & (a[pi] == b[bidx])
+            if sflags[i]:
+                ok = ok & (a[pi] != jnp.asarray(null_key_sentinel,
+                                                dtype=a.dtype))
+        ovf_j = total > out_capacity
+
+        if join_type in ("semi", "anti"):
+            hit = K.segment_any(ok, pi, p_rmask.shape[0])
+            out_mask = p_rmask & (hit if join_type == "semi" else ~hit)
+            out_cols = {m: p_recv[m] for m in probe_names}
+        else:
+            out_cols = {m: p_recv[m][pi] for m in probe_names}
+            out_cols.update({m: b_recv[m][bidx] for m in build_names})
+            out_mask = ok
+            if join_type == "left":
+                hit = K.segment_any(ok, pi, p_rmask.shape[0])
+                miss = p_rmask & ~hit
+                out_cols = {
+                    m: jnp.concatenate([
+                        out_cols[m],
+                        p_recv[m] if m in probe_names else jnp.full(
+                            p_rmask.shape[0], build_fill[m], out_cols[m].dtype),
+                    ])
+                    for m in out_cols
+                }
+                out_mask = jnp.concatenate([out_mask, miss])
+        overflow = lax.psum(
+            (ovf_p[0] | ovf_b[0] | ovf_j).astype(jnp.int32), axis) > 0
+        return out_cols, out_mask, overflow
+
+    row = P(axis)
+    compiled: Dict[Tuple, object] = {}
+
+    def run(probe, build):
+        pcols, pmask = probe
+        bcols, bmask = build
+        sig = (tuple(sorted(pcols)), tuple(sorted(bcols)))
+        fn = compiled.get(sig)
+        if fn is None:
+            in_specs = ({m: row for m in pcols}, row, {m: row for m in bcols}, row)
+            out_names = (list(probe_names) if join_type in ("semi", "anti")
+                         else list(probe_names) + list(build_names))
+            out_specs = ({m: row for m in out_names}, row, P())
+            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs))
+            compiled[sig] = fn
+        return fn(pcols, pmask, bcols, bmask)
+
+    return run
+
+
 def distributed_grouped_aggregate(
     mesh: Mesh,
     key_names: Sequence[str],

@@ -60,6 +60,18 @@ class ClusterState:
         with self._lock:
             self._heartbeats[hb.executor_id] = hb
 
+    def touch_heartbeat(self, executor_id: str) -> None:
+        """Refresh the timestamp WITHOUT clobbering the status — poll_work
+        arrivals must not flip a terminating executor back to active."""
+        import time as _time
+
+        with self._lock:
+            hb = self._heartbeats.get(executor_id)
+            if hb is not None:
+                hb.timestamp = _time.time()
+            else:
+                self._heartbeats[executor_id] = ExecutorHeartbeat(executor_id)
+
     def executors(self) -> List[ExecutorMetadata]:
         with self._lock:
             return list(self._executors.values())
@@ -77,11 +89,16 @@ class ClusterState:
 
     def expired_executors(self, timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S
                           ) -> List[str]:
+        """'terminating' executors are NOT expired while they still
+        heartbeat: they get the drain grace period (reference honors
+        Terminating with a termination grace, executor_manager.rs /
+        executor_process.rs:309-320) — only 'dead' status or heartbeat
+        timeout expires an executor."""
         now = time.time()
         with self._lock:
             return [eid for eid in self._executors
                     if (hb := self._heartbeats.get(eid)) is not None
-                    and (hb.status != "active" or now - hb.timestamp > timeout_s)]
+                    and (hb.status == "dead" or now - hb.timestamp > timeout_s)]
 
     # --- slots -----------------------------------------------------------
     def reserve_slots(self, n: int, executors: Optional[List[str]] = None
@@ -156,6 +173,10 @@ class JobState:
         with self._lock:
             self._graphs[job_id] = graph
             self._status[job_id] = JobStatus(job_id, "running")
+
+    def job_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._graphs)
 
     def get_graph(self, job_id: str) -> Optional[ExecutionGraph]:
         with self._lock:

@@ -1,0 +1,419 @@
+"""Pluggable cluster state: a keyspace'd KV store with transactions + locks.
+
+Parity: the reference makes scheduler state pluggable over a
+``KeyValueStore`` trait (get/scan/put/apply_txn/lock/watch/delete,
+reference ballista/scheduler/src/cluster/storage/mod.rs:30-147) with sled
+(embedded) and etcd drivers (cluster/storage/sled.rs:34-395,
+etcd.rs:37-346), and implements ClusterState/JobState over it
+(cluster/kv.rs:63-110).  That's what makes the scheduler HA: two
+schedulers share executor slots atomically and adopt each other's jobs.
+
+Here the trait is ``KeyValueStore`` with two embedded drivers:
+
+- ``MemoryKv`` — in-process (tests, standalone mode; sled's try_new_temporary
+  analog);
+- ``SqliteKv`` — file-backed, **multi-process safe**: transactions run as
+  ``BEGIN IMMEDIATE`` so concurrent schedulers on a shared filesystem get
+  real atomicity (the embedded-store role sled plays for the reference).
+
+``KvJobStateBackend`` (job checkpoints + ownership locks) and
+``KvClusterState`` (executors, heartbeats, atomic slot reservations) build
+on the trait, so every backend gains HA semantics through one conformance
+suite (tests/test_kv.py; reference cluster/test/mod.rs:218-446).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import serde
+from .execution_graph import ExecutionGraph
+
+
+# --------------------------------------------------------------------------
+# the trait
+# --------------------------------------------------------------------------
+
+
+class TxnGuardFailed(Exception):
+    """A transaction's compare guard did not hold; nothing was applied."""
+
+
+class KeyValueStore:
+    """Keyspace'd KV with atomic transactions and owner locks.
+
+    Keys are (keyspace, key) string pairs.  ``txn`` applies a batch of
+    put/delete ops atomically, optionally guarded by compare conditions
+    (key must currently equal an expected value, None = absent)."""
+
+    def get(self, space: str, key: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def scan(self, space: str) -> List[Tuple[str, str]]:
+        raise NotImplementedError
+
+    def put(self, space: str, key: str, value: str) -> None:
+        self.txn([("put", space, key, value)])
+
+    def delete(self, space: str, key: str) -> None:
+        self.txn([("del", space, key, None)])
+
+    def txn(self, ops: List[Tuple[str, str, str, Optional[str]]],
+            guards: Optional[List[Tuple[str, str, Optional[str]]]] = None) -> None:
+        """ops: ('put'|'del', space, key, value).  guards: (space, key,
+        expected_value_or_None).  Raises TxnGuardFailed when a guard fails."""
+        raise NotImplementedError
+
+    def lock(self, space: str, key: str, owner: str, ttl_s: float) -> bool:
+        """Acquire an owner lock with a TTL lease.  Re-acquire by the same
+        owner refreshes the lease.  Expired locks are taken over atomically
+        (exactly one contender wins)."""
+        now = time.time()
+        val = self.get(space, key)
+        holder = json.loads(val) if val else None
+        if holder is not None and holder.get("owner") != owner \
+                and now - holder.get("ts", 0) <= ttl_s:
+            return False
+        new = json.dumps({"owner": owner, "ts": now})
+        try:
+            self.txn([("put", space, key, new)], guards=[(space, key, val)])
+            return True
+        except TxnGuardFailed:
+            return False
+
+    def unlock(self, space: str, key: str, owner: str) -> None:
+        val = self.get(space, key)
+        if val and json.loads(val).get("owner") == owner:
+            try:
+                self.txn([("del", space, key, None)], guards=[(space, key, val)])
+            except TxnGuardFailed:
+                pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryKv(KeyValueStore):
+    def __init__(self):
+        self._data: Dict[Tuple[str, str], str] = {}
+        self._lock = threading.RLock()
+
+    def get(self, space, key):
+        with self._lock:
+            return self._data.get((space, key))
+
+    def scan(self, space):
+        with self._lock:
+            return sorted((k, v) for (s, k), v in self._data.items() if s == space)
+
+    def txn(self, ops, guards=None):
+        with self._lock:
+            for space, key, expected in guards or []:
+                if self._data.get((space, key)) != expected:
+                    raise TxnGuardFailed(f"{space}/{key}")
+            for op, space, key, value in ops:
+                if op == "put":
+                    self._data[(space, key)] = value
+                else:
+                    self._data.pop((space, key), None)
+
+
+class SqliteKv(KeyValueStore):
+    """File-backed store safe across processes (WAL + BEGIN IMMEDIATE)."""
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+        self.path = path
+        self._local = threading.local()
+        self._sqlite3 = sqlite3
+        conn = self._conn()
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("CREATE TABLE IF NOT EXISTS kv ("
+                     "space TEXT NOT NULL, key TEXT NOT NULL, value TEXT, "
+                     "PRIMARY KEY (space, key))")
+        conn.commit()
+
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._sqlite3.connect(self.path, timeout=30.0,
+                                         isolation_level=None)
+            conn.execute("PRAGMA busy_timeout=30000")
+            self._local.conn = conn
+        return conn
+
+    def get(self, space, key):
+        cur = self._conn().execute(
+            "SELECT value FROM kv WHERE space=? AND key=?", (space, key))
+        row = cur.fetchone()
+        return row[0] if row else None
+
+    def scan(self, space):
+        cur = self._conn().execute(
+            "SELECT key, value FROM kv WHERE space=? ORDER BY key", (space,))
+        return list(cur.fetchall())
+
+    def txn(self, ops, guards=None):
+        conn = self._conn()
+        conn.execute("BEGIN IMMEDIATE")  # write lock: guards+ops are atomic
+        try:
+            for space, key, expected in guards or []:
+                cur = conn.execute(
+                    "SELECT value FROM kv WHERE space=? AND key=?", (space, key))
+                row = cur.fetchone()
+                current = row[0] if row else None
+                if current != expected:
+                    raise TxnGuardFailed(f"{space}/{key}")
+            for op, space, key, value in ops:
+                if op == "put":
+                    conn.execute(
+                        "INSERT INTO kv (space, key, value) VALUES (?,?,?) "
+                        "ON CONFLICT (space, key) DO UPDATE SET value=excluded.value",
+                        (space, key, value))
+                else:
+                    conn.execute("DELETE FROM kv WHERE space=? AND key=?",
+                                 (space, key))
+            conn.execute("COMMIT")
+        except BaseException:
+            conn.execute("ROLLBACK")
+            raise
+
+    def close(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+def open_store(url: str) -> KeyValueStore:
+    """Backend factory (reference BallistaCluster::new_from_config,
+    cluster/mod.rs:76-192): 'memory://', 'sqlite:///path/state.db', or a
+    bare filesystem path (sqlite)."""
+    if url == "memory://" or url == "memory":
+        return MemoryKv()
+    if url.startswith("sqlite://"):
+        return SqliteKv(url[len("sqlite://"):].lstrip("/") if url.startswith("sqlite:///")
+                        else url[len("sqlite://"):])
+    return SqliteKv(url)
+
+
+# --------------------------------------------------------------------------
+# JobState over the trait
+# --------------------------------------------------------------------------
+
+JOBS = "jobs"
+JOB_LOCKS = "job_locks"
+EXECUTORS = "executors"
+HEARTBEATS = "heartbeats"
+SLOTS = "slots"
+SESSIONS = "sessions"
+
+
+class KvJobStateBackend:
+    """Drop-in for FileJobStateBackend over any KeyValueStore (reference
+    KeyValueState's JobState half, cluster/kv.rs save_job/get_job +
+    try_acquire_job, cluster/mod.rs:347-350)."""
+
+    def __init__(self, store: KeyValueStore):
+        self.store = store
+
+    def save_job(self, graph: ExecutionGraph) -> None:
+        self.store.put(JOBS, graph.job_id,
+                       json.dumps(serde.graph_to_obj(graph),
+                                  separators=(",", ":")))
+
+    def load_job(self, job_id: str) -> Optional[ExecutionGraph]:
+        val = self.store.get(JOBS, job_id)
+        return serde.graph_from_obj(json.loads(val)) if val else None
+
+    def list_jobs(self) -> List[str]:
+        return [k for k, _ in self.store.scan(JOBS)]
+
+    def remove_job(self, job_id: str) -> None:
+        self.store.txn([("del", JOBS, job_id, None),
+                        ("del", JOB_LOCKS, job_id, None)])
+
+    def try_acquire_job(self, job_id: str, owner: str,
+                        stale_after_s: float = 60.0) -> bool:
+        return self.store.lock(JOB_LOCKS, job_id, owner, stale_after_s)
+
+    def renew_lock(self, job_id: str, owner: str) -> None:
+        self.store.lock(JOB_LOCKS, job_id, owner, ttl_s=0x7FFFFFFF)
+
+
+# --------------------------------------------------------------------------
+# ClusterState over the trait (multi-scheduler slot sharing)
+# --------------------------------------------------------------------------
+
+
+class KvClusterState:
+    """Executor pool + atomic slot accounting over a shared KV store, so
+    N schedulers see one cluster (reference KeyValueState's ClusterState
+    half: Keyspace::{Slots, Executors, Heartbeats}, cluster/kv.rs:63-110;
+    reservation atomicity stressed by test_fuzz_reservations,
+    cluster/test/mod.rs:218-313).
+
+    Matches the in-memory ClusterState surface used by SchedulerServer
+    (scheduler/cluster.py)."""
+
+    def __init__(self, store: KeyValueStore, task_distribution: str = "bias"):
+        from .cluster import ExecutorHeartbeat, ExecutorMetadata  # noqa: F401
+
+        self.store = store
+        self.task_distribution = task_distribution
+
+    # --- executors -------------------------------------------------------
+    def register_executor(self, meta) -> None:
+        from .types import ExecutorHeartbeat
+
+        self.store.txn([
+            ("put", EXECUTORS, meta.executor_id,
+             json.dumps(vars(meta), separators=(",", ":"))),
+            ("put", SLOTS, meta.executor_id, str(meta.task_slots)),
+            ("put", HEARTBEATS, meta.executor_id,
+             json.dumps({"ts": time.time(), "status": "active"})),
+        ])
+
+    def remove_executor(self, executor_id: str) -> None:
+        self.store.txn([
+            ("del", EXECUTORS, executor_id, None),
+            ("del", SLOTS, executor_id, None),
+            ("put", HEARTBEATS, executor_id,
+             json.dumps({"ts": time.time(), "status": "dead"})),
+        ])
+
+    def save_heartbeat(self, hb) -> None:
+        self.store.put(HEARTBEATS, hb.executor_id,
+                       json.dumps({"ts": hb.timestamp, "status": hb.status}))
+
+    def touch_heartbeat(self, executor_id: str) -> None:
+        """Timestamp-only refresh preserving the status (see
+        cluster.ClusterState.touch_heartbeat)."""
+        val = self.store.get(HEARTBEATS, executor_id)
+        status = json.loads(val)["status"] if val else "active"
+        self.store.put(HEARTBEATS, executor_id,
+                       json.dumps({"ts": time.time(), "status": status}))
+
+    def executors(self):
+        from .types import ExecutorMetadata
+
+        return [ExecutorMetadata(**json.loads(v))
+                for _, v in self.store.scan(EXECUTORS)]
+
+    def get_executor(self, executor_id: str):
+        from .types import ExecutorMetadata
+
+        val = self.store.get(EXECUTORS, executor_id)
+        return ExecutorMetadata(**json.loads(val)) if val else None
+
+    def alive_executors(self, timeout_s: float = 60.0) -> List[str]:
+        now = time.time()
+        known = {k for k, _ in self.store.scan(EXECUTORS)}
+        out = []
+        for eid, v in self.store.scan(HEARTBEATS):
+            hb = json.loads(v)
+            if eid in known and hb["status"] == "active" \
+                    and now - hb["ts"] <= timeout_s:
+                out.append(eid)
+        return out
+
+    def expired_executors(self, timeout_s: float = 180.0) -> List[str]:
+        now = time.time()
+        known = {k for k, _ in self.store.scan(EXECUTORS)}
+        out = []
+        for eid, v in self.store.scan(HEARTBEATS):
+            hb = json.loads(v)
+            if eid in known and (hb["status"] == "dead"
+                                 or now - hb["ts"] > timeout_s):
+                out.append(eid)
+        return out
+
+    # --- slots -----------------------------------------------------------
+    def reserve_slots(self, n: int, executors: Optional[List[str]] = None):
+        """Atomic multi-executor slot grab: read free counts, then commit
+        the decrements guarded on every read value — a concurrent reserver
+        forces a retry, so no slot is ever double-booked (reference
+        reserve_slots txn, cluster/kv.rs + storage/mod.rs apply_txn)."""
+        from .types import ExecutorReservation
+
+        for _ in range(16):  # optimistic retries under contention
+            snapshot = {k: v for k, v in self.store.scan(SLOTS)}
+            if executors is not None:
+                snapshot = {k: v for k, v in snapshot.items() if k in executors}
+            order = sorted(snapshot, key=lambda k: -int(snapshot[k])) \
+                if self.task_distribution == "bias" else sorted(snapshot)
+            picks: List[str] = []
+            remaining = n
+            if self.task_distribution == "bias":
+                for eid in order:
+                    take = min(int(snapshot[eid]), remaining)
+                    picks.extend([eid] * take)
+                    remaining -= take
+                    if remaining == 0:
+                        break
+            else:  # round robin
+                free = {k: int(v) for k, v in snapshot.items()}
+                while remaining > 0:
+                    progressed = False
+                    for eid in order:
+                        if remaining == 0:
+                            break
+                        if free.get(eid, 0) > 0:
+                            free[eid] -= 1
+                            picks.append(eid)
+                            remaining -= 1
+                            progressed = True
+                    if not progressed:
+                        break
+            if not picks:
+                return []
+            taken: Dict[str, int] = {}
+            for eid in picks:
+                taken[eid] = taken.get(eid, 0) + 1
+            try:
+                self.store.txn(
+                    [("put", SLOTS, eid, str(int(snapshot[eid]) - c))
+                     for eid, c in taken.items()],
+                    guards=[(SLOTS, eid, snapshot[eid]) for eid in taken],
+                )
+                return [ExecutorReservation(eid) for eid in picks]
+            except TxnGuardFailed:
+                continue  # raced another scheduler; re-read and retry
+        return []
+
+    def cancel_reservations(self, reservations) -> None:
+        counts: Dict[str, int] = {}
+        for r in reservations:
+            counts[r.executor_id] = counts.get(r.executor_id, 0) + 1
+        self.free_slots_many(counts)
+
+    def free_slots(self, executor_id: str, n: int) -> None:
+        if n > 0:
+            self.free_slots_many({executor_id: n})
+
+    def free_slots_many(self, counts: Dict[str, int]) -> None:
+        for _ in range(16):
+            guards, ops = [], []
+            for eid, c in counts.items():
+                cur = self.store.get(SLOTS, eid)
+                if cur is None:
+                    continue  # executor gone
+                meta = self.get_executor(eid)
+                cap = meta.task_slots if meta else int(cur) + c
+                guards.append((SLOTS, eid, cur))
+                ops.append(("put", SLOTS, eid, str(min(int(cur) + c, cap))))
+            if not ops:
+                return
+            try:
+                self.store.txn(ops, guards=guards)
+                return
+            except TxnGuardFailed:
+                continue
+
+    def available_slots(self) -> int:
+        return sum(int(v) for _, v in self.store.scan(SLOTS))

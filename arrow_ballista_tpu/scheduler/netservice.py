@@ -61,17 +61,29 @@ class SchedulerNetService:
                  config: Optional[BallistaConfig] = None,
                  scheduler_config: Optional[SchedulerConfig] = None,
                  rest_port: Optional[int] = None,
-                 state_dir: Optional[str] = None):
+                 state_dir: Optional[str] = None,
+                 cluster_url: Optional[str] = None):
         self.config = config or BallistaConfig()
         self.catalog = SchemaCatalog()
         launcher = NetTaskLauncher()
         job_backend = None
-        if state_dir:
+        cluster_state = None
+        if cluster_url:
+            # shared KV backend: job checkpoints AND slot accounting go
+            # through one store so sibling schedulers cooperate (kv.py)
+            from .kv import KvClusterState, KvJobStateBackend, open_store
+
+            sc = scheduler_config or SchedulerConfig()
+            store = open_store(cluster_url)
+            job_backend = KvJobStateBackend(store)
+            cluster_state = KvClusterState(store, sc.task_distribution)
+        elif state_dir:
             from .persistence import FileJobStateBackend
 
             job_backend = FileJobStateBackend(state_dir)
         self.server = SchedulerServer(launcher, scheduler_config,
-                                      job_backend=job_backend)
+                                      job_backend=job_backend,
+                                      cluster_state=cluster_state)
         launcher.scheduler = self.server
         self.rpc = RpcServer(host, port)
         self.host, self.port = self.rpc.host, self.rpc.port
@@ -83,8 +95,19 @@ class SchedulerNetService:
         self._final_schemas: "OrderedDict[str, Schema]" = OrderedDict()
         self._max_schemas = 1024
         self._lock = threading.Lock()
+        self._default_prepared: Dict[str, tuple] = {}
+
+        # per-session isolation (reference session_manager.rs:27-57; the
+        # Flight-SQL-analog surface below opens one session per client)
+        from .session import SessionManager
+
+        self.sessions = SessionManager(self.config, self.catalog)
 
         r = self.rpc.register
+        r("create_session", self._create_session)
+        r("update_session", self._update_session)
+        r("remove_session", self._remove_session)
+        r("prepare", self._prepare)
         r("execute_query", self._execute_query)
         r("get_job_status", self._get_job_status)
         r("cancel_job", self._cancel_job)
@@ -123,11 +146,67 @@ class SchedulerNetService:
         if self.rest is not None:
             self.rest.stop()
 
+    # --- sessions (the Flight SQL handshake analog) -----------------------
+    def _session_ctx(self, payload: dict):
+        """Resolve (catalog, config) for a request: its session's when a
+        session_id is given, the shared defaults otherwise; per-request
+        config overrides apply on top either way."""
+        session = self.sessions.get(payload.get("session_id"))
+        base_catalog = session.catalog if session else self.catalog
+        base_settings = (session.config if session else self.config)._settings
+        overrides = payload.get("config", {})
+        config = BallistaConfig({**base_settings, **overrides}) \
+            if overrides or session else self.config
+        return session, base_catalog, config
+
+    def _create_session(self, payload: dict, _bin: bytes):
+        s = self.sessions.create_session(payload.get("settings"))
+        return {"session_id": s.id,
+                "settings": dict(s.config._settings)}, b""
+
+    def _update_session(self, payload: dict, _bin: bytes):
+        s = self.sessions.update_session(payload["session_id"],
+                                         payload.get("settings", {}))
+        return {"settings": dict(s.config._settings)}, b""
+
+    def _remove_session(self, payload: dict, _bin: bytes):
+        self.sessions.remove_session(payload["session_id"])
+        return {}, b""
+
+    def _prepare(self, payload: dict, _bin: bytes):
+        """Prepared statement: validate + plan once, return the result
+        schema (reference FlightSqlServiceImpl prepared statements,
+        flight_sql.rs:483-560).  Execute later via execute_query with
+        {"statement_id": ...}."""
+        import uuid as uuidmod
+
+        from ..sql.optimizer import optimize
+        from ..sql.parser import parse_sql
+        from ..sql.planner import SqlToRel
+
+        session, catalog, _config = self._session_ctx(payload)
+        sql = payload["sql"]
+        logical = optimize(SqlToRel(catalog).plan(parse_sql(sql)))
+        stmt_id = f"stmt-{uuidmod.uuid4().hex[:12]}"
+        holder = session.prepared if session else self._default_prepared
+        holder[stmt_id] = (sql, logical.schema)
+        while len(holder) > 256:
+            holder.pop(next(iter(holder)))
+        return {"statement_id": stmt_id,
+                "schema": serde.schema_to_obj(logical.schema)}, b""
+
     # --- query handling --------------------------------------------------
     def _execute_query(self, payload: dict, _bin: bytes):
-        sql = payload["sql"]
-        session_config = BallistaConfig({**self.config._settings,
-                                         **payload.get("config", {})})
+        session, catalog, session_config = self._session_ctx(payload)
+        if "statement_id" in payload:
+            holder = session.prepared if session else self._default_prepared
+            entry = holder.get(payload["statement_id"])
+            if entry is None:
+                raise PlanningError(
+                    f"unknown prepared statement {payload['statement_id']!r}")
+            sql = entry[0]
+        else:
+            sql = payload["sql"]
         job_id = random_job_id()
 
         def plan_fn():
@@ -138,8 +217,8 @@ class SchedulerNetService:
             from ..sql.planner import SqlToRel
             from .physical_planner import PhysicalPlanner
 
-            logical = optimize(SqlToRel(self.catalog).plan(parse_sql(sql)))
-            planned = PhysicalPlanner(self.catalog, session_config).plan_query(logical)
+            logical = optimize(SqlToRel(catalog).plan(parse_sql(sql)))
+            planned = PhysicalPlanner(catalog, session_config).plan_query(logical)
             ctx = TaskContext(config=session_config, job_id=f"{job_id}-scalars")
             scalars: Dict[str, object] = {}
             for sid, splan in planned.scalars:
@@ -180,8 +259,10 @@ class SchedulerNetService:
         return {}, b""
 
     def _heartbeat(self, payload: dict, _bin: bytes):
+        meta = payload.get("meta")
         self.server.heartbeat(ExecutorHeartbeat(
-            payload["executor_id"], status=payload.get("status", "active")))
+            payload["executor_id"], status=payload.get("status", "active"),
+            metadata=ExecutorMetadata(**meta) if meta else None))
         return {}, b""
 
     def _update_task_status(self, payload: dict, _bin: bytes):
@@ -200,23 +281,25 @@ class SchedulerNetService:
                                      payload.get("reason", ""))
         return {}, b""
 
-    # --- catalog ---------------------------------------------------------
+    # --- catalog (session-scoped when a session_id is supplied) -----------
     def _register_table(self, payload: dict, binary: bytes):
         import io
 
         import pyarrow.ipc as ipc
 
+        _session, catalog, _ = self._session_ctx(payload)
         table = ipc.open_stream(io.BytesIO(binary)).read_all()
-        self.catalog.register(MemoryTable(payload["name"], table))
+        catalog.register(MemoryTable(payload["name"], table))
         return {}, b""
 
     def _register_external_table(self, payload: dict, _bin: bytes):
+        _session, catalog, _ = self._session_ctx(payload)
         name, fmt, path = payload["name"], payload["format"], payload["path"]
         schema = serde.schema_from_obj(payload["schema"]) if payload.get("schema") else None
         if fmt == "parquet":
-            self.catalog.register(ParquetTable(name, path, schema))
+            catalog.register(ParquetTable(name, path, schema))
         elif fmt == "csv":
-            self.catalog.register(CsvTable(
+            catalog.register(CsvTable(
                 name, path, schema, payload.get("delimiter", ","),
                 payload.get("has_header", True)))
         else:
@@ -224,12 +307,15 @@ class SchedulerNetService:
         return {}, b""
 
     def _list_tables(self, payload: dict, _bin: bytes):
-        return {"tables": self.catalog.table_names()}, b""
+        _session, catalog, _ = self._session_ctx(payload)
+        return {"tables": catalog.table_names()}, b""
 
     def _table_schema(self, payload: dict, _bin: bytes):
-        schema = self.catalog.table_schema(payload["name"])
+        _session, catalog, _ = self._session_ctx(payload)
+        schema = catalog.table_schema(payload["name"])
         return {"schema": serde.schema_to_obj(schema)}, b""
 
     def _deregister_table(self, payload: dict, _bin: bytes):
-        self.catalog.deregister(payload["name"])
+        _session, catalog, _ = self._session_ctx(payload)
+        catalog.deregister(payload["name"])
         return {}, b""

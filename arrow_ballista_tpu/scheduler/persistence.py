@@ -77,13 +77,56 @@ class FileJobStateBackend:
                 if holder.get("owner") == owner:
                     return True
                 if time.time() - holder.get("ts", 0) > stale_after_s:
-                    os.replace(lock + "", lock)  # no-op barrier
-                    with open(lock, "w") as f:
-                        json.dump({"owner": owner, "ts": time.time()}, f)
-                    return True
+                    return self._break_stale_lock(lock, owner, stale_after_s)
             except (OSError, ValueError):
                 pass
             return False
+
+    def _break_stale_lock(self, lock: str, owner: str,
+                          stale_after_s: float) -> bool:
+        """Atomic stale-lock takeover: an O_EXCL ``.takeover`` sentinel
+        elects exactly one winner; the winner re-verifies staleness inside
+        the critical section (a racer that slipped in between the caller's
+        check and here would have refreshed the lock) and atomically
+        replaces the lock via tmp+rename.  Losers return False and retry
+        on a later cycle."""
+        takeover = lock + ".takeover"
+        try:
+            st = os.stat(takeover)
+            if time.time() - st.st_mtime > stale_after_s:
+                # takeover sentinel itself abandoned (winner died mid-swap)
+                try:
+                    os.remove(takeover)
+                except OSError:
+                    pass
+            return False  # someone is (or was) mid-takeover; try next cycle
+        except FileNotFoundError:
+            pass
+        try:
+            fd = os.open(takeover, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        os.close(fd)
+        try:
+            # critical section: re-verify the lock is still stale
+            try:
+                with open(lock) as f:
+                    holder = json.load(f)
+                if holder.get("owner") != owner and \
+                        time.time() - holder.get("ts", 0) <= stale_after_s:
+                    return False  # refreshed by a racer before we won
+            except (OSError, ValueError):
+                pass
+            tmp = lock + ".new"
+            with open(tmp, "w") as f:
+                json.dump({"owner": owner, "ts": time.time()}, f)
+            os.replace(tmp, lock)
+            return True
+        finally:
+            try:
+                os.remove(takeover)
+            except OSError:
+                pass
 
     def renew_lock(self, job_id: str, owner: str) -> None:
         lock = os.path.join(self.state_dir, f"{job_id}.lock")

@@ -200,6 +200,15 @@ class PhysicalPlanner:
             right_bc = self._to_single_partition(right)
             return O.JoinExec(left, right_bc, on, node.join_type, filt, dist="broadcast")
 
+        # TPU fast path: fuse both hash repartitions + the join into one XLA
+        # program over the local device mesh (ops/mesh_exec.py MeshJoinExec)
+        if self.config.get(MESH_SHUFFLE):
+            from ..ops.mesh_exec import MeshJoinExec
+
+            if MeshJoinExec.eligible(on, node.join_type, filt,
+                                     left.schema, right.schema):
+                return MeshJoinExec(left, right, on, node.join_type)
+
         p = self.config.shuffle_partitions
         lkeys = tuple(l for l, _ in on)
         rkeys = tuple(r for _, r in on)

@@ -126,13 +126,16 @@ class SchedulerServer:
     def __init__(self, launcher: TaskLauncher,
                  config: Optional[SchedulerConfig] = None,
                  metrics: Optional["SchedulerMetricsCollector"] = None,
-                 job_backend=None, scheduler_id: Optional[str] = None):
+                 job_backend=None, scheduler_id: Optional[str] = None,
+                 cluster_state=None):
         import uuid
 
         from .metrics import InMemoryMetricsCollector
 
         self.config = config or SchedulerConfig()
-        self.cluster = ClusterState(self.config.task_distribution)
+        # pluggable: in-memory (single scheduler) or KV-backed (N schedulers
+        # sharing one cluster, scheduler/kv.py KvClusterState)
+        self.cluster = cluster_state or ClusterState(self.config.task_distribution)
         self.jobs = JobState()
         self.launcher = launcher
         self.metrics = metrics if metrics is not None else InMemoryMetricsCollector()
@@ -172,7 +175,17 @@ class SchedulerServer:
         known = self.cluster.get_executor(hb.executor_id) is not None
         self.cluster.save_heartbeat(hb)
         if not known:
-            log.info("heartbeat from unknown executor %s", hb.executor_id)
+            if hb.metadata is not None:
+                # auto re-register: heals push-mode executors after a
+                # scheduler restart (reference grpc.rs:174-241)
+                log.info("re-registering unknown heartbeater %s", hb.executor_id)
+                self.register_executor(hb.metadata)
+                # registration installs a fresh 'active' heartbeat; re-apply
+                # the REPORTED status so a terminating executor stays
+                # unschedulable through its re-registration
+                self.cluster.save_heartbeat(hb)
+            else:
+                log.info("heartbeat from unknown executor %s", hb.executor_id)
 
     def executor_stopped(self, executor_id: str, reason: str = "") -> None:
         self._event_loop.post(ExecutorLost(executor_id, reason))
@@ -327,7 +340,9 @@ class SchedulerServer:
     def _on_poll_work(self, ev: PollWork) -> None:
         tasks: List[TaskDescription] = []
         try:
-            self.heartbeat(ExecutorHeartbeat(ev.executor_id))
+            # timestamp-only refresh: a poll from a draining executor must
+            # not flip its 'terminating' status back to active
+            self.cluster.touch_heartbeat(ev.executor_id)
             if ev.statuses:
                 self._absorb_statuses(ev.executor_id, ev.statuses)
             graphs = self.jobs.active_graphs()
@@ -354,20 +369,29 @@ class SchedulerServer:
             graph = self.jobs.get_graph(job_id)
             if graph is None:
                 continue
+            checkpointed = False
             for kind, payload in graph.update_task_status(sts):
                 if kind == "job_successful":
+                    # terminal state must be durable BEFORE waiters wake:
+                    # set_status releases wait_for_job, and a restarted
+                    # scheduler must never see a completed job as running
+                    self._checkpoint(graph)
+                    checkpointed = True
                     self.jobs.set_status(
                         JobStatus(job_id, "successful", locations=payload))
                     self.metrics.record_completed(
                         job_id, self._queued_at_ms.pop(job_id, 0),
                         int(time.time() * 1000))
                 elif kind == "job_failed":
+                    self._checkpoint(graph)
+                    checkpointed = True
                     self.jobs.set_status(
                         JobStatus(job_id, "failed", error=str(payload)))
                     self.metrics.record_failed(job_id)
                     self._queued_at_ms.pop(job_id, None)
                     self._cancel_running(graph)
-            self._checkpoint(graph)
+            if not checkpointed:
+                self._checkpoint(graph)
 
     def _resolve_addr(self, executor_id: str):
         meta = self.cluster.get_executor(executor_id)

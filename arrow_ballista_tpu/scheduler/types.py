@@ -95,6 +95,9 @@ class ExecutorHeartbeat:
     executor_id: str
     timestamp: float = dataclasses.field(default_factory=time.time)
     status: str = "active"  # 'active' | 'dead' | 'terminating'
+    # carried so a restarted scheduler can auto re-register unknown
+    # heartbeaters (reference heart_beat_from_executor, grpc.rs:174-241)
+    metadata: Optional[ExecutorMetadata] = None
 
 
 @dataclasses.dataclass

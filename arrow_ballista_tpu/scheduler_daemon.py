@@ -21,6 +21,10 @@ def main(argv=None) -> None:
     ap.add_argument("--state-dir", default=None,
                     help="persist job graphs here for crash recovery / "
                          "multi-scheduler adoption")
+    ap.add_argument("--cluster-backend", default=None, metavar="URL",
+                    help="shared cluster-state store for HA multi-scheduler "
+                         "deployments: memory:// or sqlite:///path/state.db "
+                         "(reference: sled/etcd cluster backends)")
     ap.add_argument("--task-distribution", choices=["bias", "round-robin"],
                     default="bias")
     ap.add_argument("--scheduling-policy", choices=["push", "pull"],
@@ -47,7 +51,8 @@ def main(argv=None) -> None:
             executor_timeout_s=args.executor_timeout_s,
             policy=args.scheduling_policy),
         rest_port=None if args.rest_port < 0 else args.rest_port,
-        state_dir=args.state_dir)
+        state_dir=args.state_dir,
+        cluster_url=args.cluster_backend)
     svc.start()
     logging.info("scheduler listening on %s:%s (rest: %s)", svc.host, svc.port,
                  svc.rest.port if svc.rest else "disabled")

@@ -2,28 +2,34 @@
 //
 // Role parity: the reference executor's Arrow Flight service
 // (reference ballista/executor/src/flight_service.rs:82-120 do_get
-// FetchPartition) — the high-bandwidth side of the executor that must not
-// contend with the Python control plane for the GIL.  Speaks the same
-// framing as arrow_ballista_tpu/net/wire.py:
+// FetchPartition, with the handshake bearer token of
+// flight_service.rs:136-157) — the high-bandwidth side of the executor
+// that must not contend with the Python control plane for the GIL.
+// Speaks the same framing as arrow_ballista_tpu/net/wire.py:
 //
-//     u32 json_len | json | u32 bin_len | bin
+//     u32 json_len | u64 bin_len | json | bin
 //
-// Handles: fetch_partition {"path": ...} -> file bytes; ping.
-// Path-traversal guard mirrors is_subdirectory
+// The binary length is 64-bit so multi-GiB shuffle partitions stream
+// without truncation.  Handles: fetch_partition {"path", "token"?} ->
+// file bytes; ping.  Path-traversal guard mirrors is_subdirectory
 // (reference executor_server.rs:839-876): realpath must stay under the
-// work dir.
+// work dir.  Concurrency is bounded (max_conns) so a fetch storm cannot
+// spawn unbounded threads on a shared pod.
 //
 // Exposed via C ABI for ctypes:
-//   dp_start(work_dir, port) -> listening port (0 on error)
+//   dp_start(work_dir, port, token, max_conns) -> listening port (0 on error)
 //   dp_stop()
 //   dp_bytes_served() -> counter for metrics
 #include <arpa/inet.h>
+#include <atomic>
 #include <cerrno>
+#include <climits>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
-#include <cstring>
-#include <climits>
 #include <cstdlib>
+#include <cstring>
+#include <mutex>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <string>
@@ -33,7 +39,6 @@
 #include <thread>
 #include <unistd.h>
 #include <vector>
-#include <atomic>
 
 namespace {
 
@@ -41,7 +46,14 @@ std::atomic<int> g_listen_fd{-1};
 std::atomic<bool> g_running{false};
 std::atomic<uint64_t> g_bytes_served{0};
 std::string g_work_dir;
+std::string g_token;
 std::thread g_accept_thread;
+
+// bounded connection slots
+std::mutex g_conn_mu;
+std::condition_variable g_conn_cv;
+int g_active_conns = 0;
+int g_max_conns = 64;
 
 bool read_exact(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
@@ -63,6 +75,27 @@ bool write_exact(int fd, const void* buf, size_t n) {
     n -= static_cast<size_t>(r);
   }
   return true;
+}
+
+// header: u32 json_len (network order) | u64 bin_len (network order)
+bool read_header(int fd, uint32_t* jlen, uint64_t* blen) {
+  unsigned char hdr[12];
+  if (!read_exact(fd, hdr, sizeof(hdr))) return false;
+  *jlen = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
+          (uint32_t(hdr[2]) << 8) | uint32_t(hdr[3]);
+  *blen = 0;
+  for (int i = 0; i < 8; ++i) *blen = (*blen << 8) | uint64_t(hdr[4 + i]);
+  return true;
+}
+
+bool write_header(int fd, uint32_t jlen, uint64_t blen) {
+  unsigned char hdr[12];
+  hdr[0] = (jlen >> 24) & 0xff;
+  hdr[1] = (jlen >> 16) & 0xff;
+  hdr[2] = (jlen >> 8) & 0xff;
+  hdr[3] = jlen & 0xff;
+  for (int i = 0; i < 8; ++i) hdr[4 + i] = (blen >> (8 * (7 - i))) & 0xff;
+  return write_exact(fd, hdr, sizeof(hdr));
 }
 
 // Minimal JSON string-field extractor: finds "key":"value" at the top
@@ -96,9 +129,8 @@ bool json_str_field(const std::string& json, const std::string& key,
 }
 
 void send_response(int fd, const std::string& json, const void* bin,
-                   uint32_t bin_len) {
-  uint32_t hdr[2] = {htonl(static_cast<uint32_t>(json.size())), htonl(bin_len)};
-  write_exact(fd, hdr, sizeof(hdr));
+                   uint64_t bin_len) {
+  write_header(fd, static_cast<uint32_t>(json.size()), bin_len);
   write_exact(fd, json.data(), json.size());
   if (bin_len) write_exact(fd, bin, bin_len);
 }
@@ -148,9 +180,7 @@ void handle_fetch(int fd, const std::string& json) {
   uint64_t size = static_cast<uint64_t>(st.st_size);
   std::string hdr_json =
       "{\"ok\":true,\"payload\":{\"num_bytes\":" + std::to_string(size) + "}}";
-  uint32_t hdr[2] = {htonl(static_cast<uint32_t>(hdr_json.size())),
-                     htonl(static_cast<uint32_t>(size))};
-  write_exact(fd, hdr, sizeof(hdr));
+  write_header(fd, static_cast<uint32_t>(hdr_json.size()), size);
   write_exact(fd, hdr_json.data(), hdr_json.size());
   // zero-copy file -> socket (the Flight-stream analog)
   off_t off = 0;
@@ -169,10 +199,10 @@ void serve_conn(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   for (;;) {
-    uint32_t hdr[2];
-    if (!read_exact(fd, hdr, sizeof(hdr))) break;
-    uint32_t jlen = ntohl(hdr[0]), blen = ntohl(hdr[1]);
-    if (jlen > (64u << 20) || blen > (64u << 20)) break;
+    uint32_t jlen;
+    uint64_t blen;
+    if (!read_header(fd, &jlen, &blen)) break;
+    if (jlen > (64u << 20) || blen > (64ull << 20)) break;  // requests are small
     std::string json(jlen, '\0');
     if (jlen && !read_exact(fd, json.data(), jlen)) break;
     if (blen) {  // drain unused binary part
@@ -181,6 +211,14 @@ void serve_conn(int fd) {
     }
     std::string method;
     json_str_field(json, "method", &method);
+    if (!g_token.empty()) {
+      std::string tok;
+      json_str_field(json, "token", &tok);
+      if (tok != g_token) {
+        send_error(fd, "data plane auth failed");
+        break;
+      }
+    }
     if (method == "fetch_partition") {
       handle_fetch(fd, json);
     } else if (method == "ping") {
@@ -190,14 +228,33 @@ void serve_conn(int fd) {
     }
   }
   close(fd);
+  {
+    std::lock_guard<std::mutex> lk(g_conn_mu);
+    --g_active_conns;
+  }
+  g_conn_cv.notify_one();
 }
 
 void accept_loop(int listen_fd) {
   while (g_running.load()) {
+    // bounded fan-in: wait for a free connection slot before accepting
+    {
+      std::unique_lock<std::mutex> lk(g_conn_mu);
+      g_conn_cv.wait(lk, [] {
+        return g_active_conns < g_max_conns || !g_running.load();
+      });
+      if (!g_running.load()) break;
+      ++g_active_conns;
+    }
     sockaddr_in peer{};
     socklen_t plen = sizeof(peer);
     int fd = accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &plen);
     if (fd < 0) {
+      {
+        std::lock_guard<std::mutex> lk(g_conn_mu);
+        --g_active_conns;
+      }
+      g_conn_cv.notify_one();
       if (!g_running.load()) break;
       continue;
     }
@@ -209,10 +266,19 @@ void accept_loop(int listen_fd) {
 
 extern "C" {
 
-// Returns the bound port (0 on failure).
-int dp_start(const char* work_dir, int port) {
+// Returns the bound port (0 on failure).  ``token``: optional shared
+// secret required on every request when non-empty.  ``max_conns``:
+// concurrent connection bound (<=0 means default 64).
+int dp_start(const char* work_dir, int port, const char* token,
+             int max_conns) {
   if (g_running.load()) return 0;
   g_work_dir = work_dir;
+  g_token = token ? token : "";
+  g_max_conns = max_conns > 0 ? max_conns : 64;
+  {
+    std::lock_guard<std::mutex> lk(g_conn_mu);
+    g_active_conns = 0;
+  }
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return 0;
   int one = 1;
@@ -236,6 +302,14 @@ int dp_start(const char* work_dir, int port) {
 
 void dp_stop() {
   if (!g_running.exchange(false)) return;
+  {
+    // close the lost-wakeup window: the accept thread evaluates its wait
+    // predicate under g_conn_mu, so the stop flag flip must be visible
+    // before notify (an unsynchronized notify can land between predicate
+    // check and block, leaving the thread waiting forever)
+    std::lock_guard<std::mutex> lk(g_conn_mu);
+  }
+  g_conn_cv.notify_all();
   int fd = g_listen_fd.exchange(-1);
   if (fd >= 0) {
     shutdown(fd, SHUT_RDWR);

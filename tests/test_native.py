@@ -17,7 +17,7 @@ def dp(tmp_path_factory):
     (work / "job1" / "1" / "0").mkdir(parents=True)
     payload = b"arrow-ipc-bytes" * 1000
     (work / "job1" / "1" / "0" / "data-0.arrow").write_bytes(payload)
-    port = lib.dp_start(str(work).encode(), 0)
+    port = lib.dp_start(str(work).encode(), 0, b"", 0)
     assert port > 0
     yield lib, str(work), port, payload
     lib.dp_stop()

@@ -48,6 +48,66 @@ def test_grouped_null_aggregates(ctx):
                   "group by k order by k").to_pandas()
     assert out.nx.tolist() == [1, 1, 0]
     assert out.sx.tolist()[:2] == [10, 30]
+    # SQL: sum over an all-NULL group is NULL, not the skip-identity 0
+    assert np.isnan(out.sx[2])
+
+
+def test_all_null_group_min_max(ctx):
+    out = ctx.sql("select k, min(x) as lo, max(x) as hi from t "
+                  "group by k order by k").to_pandas()
+    assert out.lo.tolist()[:2] == [10, 30] and out.hi.tolist()[:2] == [10, 30]
+    assert np.isnan(out.lo[2]) and np.isnan(out.hi[2])
+
+
+def test_null_projection_decodes(ctx):
+    # NULL int64 must come back as NULL, never the in-band sentinel
+    out = ctx.sql("select x from t").to_pandas()
+    vals = out.x.tolist()
+    assert sorted(v for v in vals if not (isinstance(v, float) and np.isnan(v))) == [10, 30]
+    assert sum(1 for v in vals if isinstance(v, float) and np.isnan(v)) == 3
+    tbl = ctx.sql("select x from t").to_arrow()
+    assert tbl.column("x").null_count == 3
+
+
+def test_null_comparison_is_false(ctx):
+    # x < 50 must not admit NULL rows (sentinel is int64-min, "less than" 50)
+    assert ctx.sql("select count(*) as n from t where x < 50").to_pandas().n[0] == 2
+    assert ctx.sql("select count(*) as n from t where x > 0").to_pandas().n[0] == 2
+    assert ctx.sql("select count(*) as n from t where not (x < 50)").to_pandas().n[0] == 0
+    assert ctx.sql("select count(*) as n from t where x <> 10").to_pandas().n[0] == 1
+    # dates: sentinel is int32-min epoch days
+    assert ctx.sql("select count(*) as n from t where d < date '1970-01-06'").to_pandas().n[0] == 4
+
+
+def test_null_in_list(ctx):
+    assert ctx.sql("select count(*) as n from t where x in (10, 30, 99)").to_pandas().n[0] == 2
+    # NULL NOT IN (...) is NULL -> excluded
+    assert ctx.sql("select count(*) as n from t where x not in (10, 99)").to_pandas().n[0] == 1
+
+
+def test_null_arithmetic_propagates(ctx):
+    out = ctx.sql("select x + 1 as y from t").to_pandas()
+    vals = [v for v in out.y.tolist() if not (isinstance(v, float) and np.isnan(v))]
+    assert sorted(vals) == [11, 31]
+
+
+def test_global_agg_empty_input_is_null(ctx):
+    out = ctx.sql("select count(x) as n, sum(x) as s, min(x) as lo "
+                  "from t where k > 100").to_pandas()
+    assert out.n[0] == 0
+    assert np.isnan(out.s[0]) and np.isnan(out.lo[0])
+
+
+def test_null_join_keys_never_match(ctx):
+    import pyarrow as pa
+
+    ctx.register_table("u", pa.table({
+        "x": pa.array([10, None, 77], type=pa.int64()),
+        "tag": pa.array(["ten", "null", "sevenseven"]),
+    }))
+    out = ctx.sql("select t.k, u.tag from t join u on t.x = u.x").to_pandas()
+    # only the x=10 row joins; the three NULL x rows must not match u's NULL
+    assert out.tag.tolist() == ["ten"]
 
 
 def test_null_column_scan_marked_nullable(ctx):
@@ -70,3 +130,49 @@ def test_parquet_null_stats(tmp_path):
     assert schema.field("a").nullable and not schema.field("b").nullable
     out = c.sql("select count(a) as na, count(b) as nb from n").to_pandas()
     assert out.na[0] == 2 and out.nb[0] == 3
+
+
+def test_not_over_boolean_combination(ctx):
+    # Kleene: NOT(NULL or FALSE) = NOT(NULL) = NULL -> excluded
+    assert ctx.sql("select count(*) as n from t "
+                   "where not (x < 50 or x > 100)").to_pandas().n[0] == 0
+    # NOT(NULL and FALSE) = NOT(FALSE) = TRUE -> NULL-x rows with k>2 kept
+    out = ctx.sql("select count(*) as n from t "
+                  "where not (x < 50 and k > 100)").to_pandas()
+    assert out.n[0] == 5  # k>100 is false everywhere -> all rows kept
+    # string NULLs under NOT: s <> 'a' is NULL for NULL s -> excluded either way
+    assert ctx.sql("select count(*) as n from t where not (s = 'a')").to_pandas().n[0] == 2
+    assert ctx.sql("select count(*) as n from t where s <> 'a'").to_pandas().n[0] == 2
+
+
+def test_case_launders_null(ctx):
+    # CASE can turn NULL into a real value; sentinel re-assertion must not
+    # overwrite it back to NULL
+    out = ctx.sql("select case when x is null then 0 else x end as y "
+                  "from t").to_pandas()
+    assert sorted(out.y.tolist()) == [0, 0, 0, 10, 30]
+    # and aggregates over laundering expressions count every row
+    out = ctx.sql("select count(case when x is null then 1 else 1 end) as n "
+                  "from t").to_pandas()
+    assert out.n[0] == 5
+
+
+def test_mesh_join_null_keys_never_match(ctx):
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    mctx = BallistaContext.local(BallistaConfig({
+        "ballista.shuffle.mesh": "true",
+        "ballista.join.broadcast_threshold": "0",
+        "ballista.shuffle.partitions": "4"}))
+    mctx.register_table("t", pa.table({
+        "k": pa.array([1, 1, 2, 2, 3], type=pa.int64()),
+        "x": pa.array([10, None, 30, None, None], type=pa.int64()),
+    }))
+    mctx.register_table("u", pa.table({
+        "x": pa.array([10, None, 77], type=pa.int64()),
+        "tag": pa.array(["ten", "null", "sevenseven"]),
+    }))
+    out = mctx.sql("select t.k, u.tag from t join u on t.x = u.x").to_pandas()
+    assert out.tag.tolist() == ["ten"]

@@ -193,3 +193,24 @@ def test_string_sort_via_codes():
                     [(E.Column("flag"), True)])
     got = run_all(plan)
     assert got["flag"].tolist() == ["A", "A", "N", "R"]
+
+
+def test_aggregate_adaptive_capacity():
+    """High-cardinality GROUP BY beyond ballista.agg.capacity must succeed
+    via power-of-two recompilation (the join path's bucketed-recompile
+    discipline applied to aggregation)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    n = 5000  # distinct keys far above the configured capacity of 16
+    ctx = BallistaContext.local(BallistaConfig({"ballista.agg.capacity": "16"}))
+    ctx.register_table("big", pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array(np.ones(n, dtype=np.int64)),
+    }))
+    out = ctx.sql("select k, sum(v) as s from big group by k").to_pandas()
+    assert len(out) == n
+    assert out.s.sum() == n

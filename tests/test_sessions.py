@@ -1,0 +1,129 @@
+"""Per-session isolation + the external SQL surface.
+
+Parity: reference SessionManager (state/session_manager.rs:27-57 — one
+DataFusion session per client with its own BallistaConfig) and the Flight
+SQL endpoint (flight_sql.rs:83-911 — handshake/session, prepared
+statements, execute, endpoints to executor partitions) that lets
+non-library clients run SQL.
+"""
+import io
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.net import wire
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    sched = SchedulerNetService("127.0.0.1", 0,
+                                config=BallistaConfig({"ballista.shuffle.partitions": "4"}))
+    sched.start()
+    ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                        work_dir=str(tmp_path_factory.mktemp("sess-exec")),
+                        concurrent_tasks=4, executor_id="sess-exec-0")
+    ex.start()
+    yield sched
+    ex.stop(notify=False)
+    sched.stop()
+
+
+def test_session_table_isolation(cluster):
+    a = BallistaContext.remote("127.0.0.1", cluster.port)
+    b = BallistaContext.remote("127.0.0.1", cluster.port)
+    t = pa.table({"x": pa.array([1, 2, 3], type=pa.int64())})
+    a.register_table("mine", t)
+    # a sees it; b does not (private namespace per session)
+    assert "mine" in a.sql("show tables").to_pandas().table_name.tolist()
+    assert "mine" not in b.sql("show tables").to_pandas().table_name.tolist()
+    out = a.sql("select sum(x) as s from mine").to_pandas()
+    assert out.s[0] == 6
+    a.shutdown()
+    b.shutdown()
+
+
+def test_session_config_isolation(cluster):
+    """Two concurrent sessions with different shuffle partitions plan
+    independently (the VERDICT done-criterion for per-session config)."""
+    a = BallistaContext.remote("127.0.0.1", cluster.port,
+                               BallistaConfig({"ballista.shuffle.partitions": "2"}))
+    b = BallistaContext.remote("127.0.0.1", cluster.port,
+                               BallistaConfig({"ballista.shuffle.partitions": "5"}))
+    rng = np.random.default_rng(5)
+    t = pa.table({"g": pa.array(rng.integers(0, 40, 4000).astype(np.int64)),
+                  "v": pa.array(np.ones(4000, dtype=np.int64))})
+    a.register_table("t", t)
+    b.register_table("t", t)
+    ga = a.sql("select g, sum(v) as s from t group by g order by g").to_pandas()
+    gb = b.sql("select g, sum(v) as s from t group by g order by g").to_pandas()
+    assert ga.s.sum() == 4000 and gb.s.sum() == 4000
+    # the scheduler really planned with each session's partitioning: inspect
+    # the last two jobs' graphs
+    graphs = [cluster.server.jobs.get_graph(j)
+              for j in cluster.server.jobs.job_ids()]
+    parts = sorted({len(g.stages[2].task_infos) for g in graphs if g is not None
+                    and len(g.stages) >= 2})
+    assert 2 in parts and 5 in parts, f"stage partition counts seen: {parts}"
+    a.shutdown()
+    b.shutdown()
+
+
+def test_prepared_statements(cluster):
+    ctx = BallistaContext.remote("127.0.0.1", cluster.port)
+    t = pa.table({"x": pa.array([5, 7], type=pa.int64())})
+    ctx.register_table("p", t)
+    sid = ctx._remote.session_id
+    prep, _ = wire.call("127.0.0.1", cluster.port, "prepare",
+                        {"session_id": sid, "sql": "select sum(x) as s from p"})
+    assert prep["schema"][0]["name"] == "s"
+    payload, _ = wire.call("127.0.0.1", cluster.port, "execute_query",
+                           {"session_id": sid,
+                            "statement_id": prep["statement_id"]})
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st, _ = wire.call("127.0.0.1", cluster.port, "get_job_status",
+                          {"job_id": payload["job_id"]})
+        if st["state"] == "successful":
+            break
+        assert st["state"] not in ("failed", "cancelled"), st
+        time.sleep(0.05)
+    assert st["state"] == "successful"
+    ctx.shutdown()
+
+
+def test_expired_session_rejected(cluster):
+    payload, _ = wire.call("127.0.0.1", cluster.port, "create_session", {})
+    sid = payload["session_id"]
+    wire.call("127.0.0.1", cluster.port, "remove_session", {"session_id": sid})
+    with pytest.raises(wire.RemoteError):
+        wire.call("127.0.0.1", cluster.port, "list_tables", {"session_id": sid})
+
+
+def test_external_client_script(cluster, tmp_path):
+    """The examples/ client (stdlib + pyarrow only) runs SQL end-to-end."""
+    import subprocess
+    import sys
+
+    import pyarrow.parquet as pq
+
+    data = tmp_path / "nums.parquet"
+    pq.write_table(pa.table({"v": pa.array(range(100), type=pa.int64())}),
+                   str(data))
+    script = "examples/external_sql_client.py"
+    out = subprocess.run(
+        [sys.executable, script, "127.0.0.1", str(cluster.port),
+         f"create external table nums stored as parquet location '{data}'",
+         "select count(*) as n, sum(v) as s from nums"],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "4950" in out.stdout and "100" in out.stdout
