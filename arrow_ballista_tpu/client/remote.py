@@ -75,7 +75,9 @@ class RemoteCluster:
         self._call("deregister_table", {"name": name})
 
     # --- query execution -------------------------------------------------
-    def execute_sql(self, sql: str, timeout: float = 600.0) -> List[ColumnBatch]:
+    def execute_sql(self, sql: str, timeout: Optional[float] = None) -> List[ColumnBatch]:
+        if timeout is None:
+            timeout = float(self.config.job_timeout_s)
         payload, _ = self._call("execute_query",
                                 {"sql": sql, "config": dict(self.config._settings)})
         job_id = payload["job_id"]

@@ -53,7 +53,11 @@ class RpcServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # socketserver.shutdown() waits on an event that only serve_forever
+        # sets — calling it before start() would block forever (round-2: a
+        # stop-before-start hang deadlocked the whole test suite)
+        if self._thread.is_alive():
+            self._server.shutdown()
         self._server.server_close()
 
     def _dispatch(self, sock, req: dict, binary: bytes) -> None:

@@ -161,10 +161,23 @@ class SchedulerServer:
             self._reaper.start()
 
     def shutdown(self) -> None:
+        # order matters: stop the event loop BEFORE closing the launch pool,
+        # so no event handler can race a _launch_pool.submit against
+        # pool.shutdown (round-2 bench crash: "cannot schedule new futures
+        # after shutdown" killed the event loop mid-run)
         self._stopped.set()
         self._event_loop.stop()
         self._launch_pool.shutdown(wait=False)
         self.launcher.stop()
+
+    def _submit_work(self, fn, *args) -> None:
+        """Submit to the launch pool, tolerating shutdown races."""
+        if self._stopped.is_set():
+            return
+        try:
+            self._launch_pool.submit(fn, *args)
+        except RuntimeError:  # pool closed between the check and the submit
+            log.info("dropping work submitted during shutdown")
 
     # --- public API (the SchedulerGrpc surface, ballista.proto:665-689) --
     def register_executor(self, meta: ExecutorMetadata) -> None:
@@ -247,7 +260,7 @@ class SchedulerServer:
                 self._event_loop.post(JobPlanned(ev.job_id, None,
                                                  f"planning error: {e}"))
 
-        self._launch_pool.submit(plan)
+        self._submit_work(plan)
 
     def _on_job_planned(self, ev: JobPlanned) -> None:
         if ev.graph is None:
@@ -427,7 +440,7 @@ class SchedulerServer:
         if unused:
             self.cluster.cancel_reservations(unused)
         for executor_id, tasks in assignments.items():
-            self._launch_pool.submit(self._launch, executor_id, tasks)
+            self._submit_work(self._launch, executor_id, tasks)
 
     def _launch(self, executor_id: str, tasks: List[TaskDescription]) -> None:
         try:

@@ -106,7 +106,11 @@ class StandaloneCluster:
 
         job_id = random_job_id()
         self.scheduler.submit_job(job_id, lambda: (planned.plan, scalars))
-        status = self.scheduler.wait_for_job(job_id)
+        # deadline is config-driven (round-2 failure mode: a slow first-compile
+        # TPU run blew through a hard-coded 300 s wait and "failed" a job that
+        # would have finished)
+        status = self.scheduler.wait_for_job(job_id,
+                                             timeout=float(self.config.job_timeout_s))
         if status.state == "failed":
             raise ExecutionError(f"job {job_id} failed: {status.error}")
         if status.state != "successful":

@@ -28,6 +28,7 @@ COLLECT_STATISTICS = "ballista.collect_statistics"
 MESH_SHUFFLE = "ballista.shuffle.mesh"  # use ICI all-to-all when executors co-located on a mesh
 TASK_SLOTS = "ballista.executor.task_slots"
 BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides smaller skip the shuffle
+JOB_TIMEOUT_S = "ballista.job.timeout.seconds"  # client-side wait_for_job deadline
 
 
 @dataclasses.dataclass
@@ -64,6 +65,8 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(TASK_SLOTS, 4, int, "concurrent task slots per executor"),
         ConfigEntry(BROADCAST_THRESHOLD, 1_000_000, int,
                     "broadcast join build sides with fewer estimated rows"),
+        ConfigEntry(JOB_TIMEOUT_S, 3600, int,
+                    "seconds a client waits for a submitted job before giving up"),
     ]
 }
 
@@ -120,6 +123,10 @@ class BallistaConfig:
     @property
     def task_slots(self) -> int:
         return self.get(TASK_SLOTS)
+
+    @property
+    def job_timeout_s(self) -> int:
+        return self.get(JOB_TIMEOUT_S)
 
     def to_dict(self) -> Dict[str, Any]:
         d = {k: e.default for k, e in _ENTRIES.items()}

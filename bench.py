@@ -119,6 +119,10 @@ def _worker(platform: str) -> None:
     config = BallistaConfig({
         "ballista.shuffle.partitions": "8",
         "ballista.batch.size": str(1 << 20),
+        # engine deadline: generous (slow first-compile runs must finish) but
+        # below the parent's subprocess timeout so the engine fails first
+        # with a real error instead of a SIGKILL
+        "ballista.job.timeout.seconds": "1800",
     })
     ctx = BallistaContext.standalone(config, concurrent_tasks=4)
     register_tables(ctx, DATA_DIR)
@@ -156,26 +160,46 @@ def _worker(platform: str) -> None:
 # --------------------------------------------------------------------------
 
 
-def _attempt(platform: str, timeout: int):
+LOG_DIR = os.path.join(REPO, ".bench_logs")
+
+
+def _attempt(platform: str, timeout: int, tag: str = ""):
+    """Run one worker subprocess.  The FULL stdout/stderr is persisted to a
+    log file win or lose (round-2 failure mode: only a 1500-char tail
+    survived, losing the TPU kernel number that printed before the engine
+    bench died)."""
     env = dict(os.environ) if platform == "tpu" else _cpu_env()
+    os.makedirs(LOG_DIR, exist_ok=True)
+    log_path = os.path.join(LOG_DIR, f"attempt-{int(time.time())}-{platform}{tag}.log")
     t0 = time.time()
+    timed_out = False
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--worker",
              "--platform", platform],
             cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
         )
-    except subprocess.TimeoutExpired:
+        stdout, stderr, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        stderr = (e.stderr or b"").decode("utf-8", "replace") \
+            if isinstance(e.stderr, bytes) else (e.stderr or "")
+        rc, timed_out = -1, True
+    with open(log_path, "w") as fh:
+        fh.write(f"# platform={platform} rc={rc} wall={time.time()-t0:.0f}s "
+                 f"timed_out={timed_out}\n--- stdout ---\n{stdout}\n"
+                 f"--- stderr ---\n{stderr}\n")
+    print(f"[bench] full log: {log_path}", file=sys.stderr)
+    if timed_out:
         print(f"[bench] {platform} attempt timed out after {timeout}s", file=sys.stderr)
         return None
-    sys.stderr.write(proc.stderr[-4000:])
-    if proc.returncode != 0:
-        print(f"[bench] {platform} attempt failed rc={proc.returncode} "
+    sys.stderr.write(stderr[-4000:])
+    if rc != 0:
+        print(f"[bench] {platform} attempt failed rc={rc} "
               f"after {time.time()-t0:.0f}s", file=sys.stderr)
-        tail = (proc.stdout + proc.stderr)[-1500:]
-        print(f"[bench] tail: {tail}", file=sys.stderr)
         return None
-    for line in reversed(proc.stdout.strip().splitlines()):
+    for line in reversed(stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -198,11 +222,13 @@ def main() -> None:
 
     ensure_data()
 
+    # subprocess timeout must exceed the engine's own job deadline (the
+    # worker sets ballista.job.timeout.seconds below it) so a slow-but-alive
+    # TPU run is never SIGKILLed from outside
+    tpu_budget = int(os.environ.get("BENCH_TPU_TIMEOUT", "3600"))
     plan = []
     if args.platform in ("auto", "tpu"):
-        # TPU backend init is transiently Unavailable when the device-grant
-        # tunnel is recovering: retry fresh subprocesses with backoff
-        plan += [("tpu", 2400), ("tpu", 2400)]
+        plan += [("tpu", tpu_budget)]
     if args.platform in ("auto", "cpu"):
         plan += [("cpu", 2400)]
 
@@ -210,7 +236,15 @@ def main() -> None:
     for i, (platform, timeout) in enumerate(plan):
         if i > 0:
             time.sleep(20)
-        result = _attempt(platform, timeout)
+        t0 = time.time()
+        result = _attempt(platform, timeout, tag=f"-{i}")
+        if result is None and platform == "tpu" and time.time() - t0 < 300:
+            # fast failure = transient backend-init Unavailable (device-grant
+            # tunnel recovering), not a slow run: one fresh retry is cheap
+            # and often succeeds.  Slow failures are NOT retried — a second
+            # identical attempt can only fail the same way (round-2 lesson).
+            time.sleep(20)
+            result = _attempt(platform, timeout, tag=f"-{i}-retry")
         if result is not None:
             break
     if result is None:

@@ -24,8 +24,30 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+import faulthandler  # noqa: E402
+import sys  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Suite-level watchdog (round-2 failure mode: one deadlocked test hung the
+# whole suite forever).  Each test re-arms a hard deadline; on expiry every
+# thread's stack is dumped and the process exits non-zero, so a hang can
+# never silently eat a run.  pytest-timeout is not in the image, hence
+# faulthandler.
+TEST_TIMEOUT_S = int(os.environ.get("BALLISTA_TEST_TIMEOUT", "600"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    if TEST_TIMEOUT_S > 0:
+        # sys.__stderr__: pytest's fd capture redirects fd 2 to an unlinked
+        # temp file, so dumping there would lose the stacks
+        faulthandler.dump_traceback_later(TEST_TIMEOUT_S, exit=True,
+                                          file=sys.__stderr__)
+    yield
+    if TEST_TIMEOUT_S > 0:
+        faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture(scope="session")
