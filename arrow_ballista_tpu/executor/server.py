@@ -30,6 +30,54 @@ log = logging.getLogger(__name__)
 HEARTBEAT_INTERVAL_S = 60.0
 
 
+class StagePlanCache:
+    """Tasks of one stage share ONE decoded plan instance so operators'
+    lazily-built XLA programs compile once per stage, not once per task
+    (the reference decodes a MultiTaskDefinition's stage plan once,
+    executor_server.rs:613-697).  Keyed by plan CONTENT, not just
+    (job, stage): a stage re-run after lineage rollback carries new shuffle
+    locations and must not reuse the stale instance."""
+
+    def __init__(self, max_entries: int = 64):
+        import collections
+
+        self._cache = collections.OrderedDict()
+        self._max = max_entries
+        self._lock = threading.Lock()
+
+    def decode(self, t: dict):
+        import hashlib
+        import json
+
+        from ..scheduler.types import TaskDescription, TaskId
+
+        tid = t.get("task", {})
+        blob = json.dumps(t.get("plan"), sort_keys=True,
+                          separators=(",", ":")).encode()
+        key = (tid.get("job_id"), tid.get("stage_id"),
+               hashlib.sha256(blob).hexdigest())
+        with self._lock:
+            cached = self._cache.get(key)
+            if cached is not None:
+                self._cache.move_to_end(key)
+        if cached is not None:
+            # cache hit: only the cheap task envelope is decoded
+            return TaskDescription(TaskId(**t["task"]), cached,
+                                   t.get("internal_id", 0),
+                                   dict(t.get("scalars", {})))
+        td = serde.task_from_obj(t)
+        with self._lock:
+            # re-check: a racing decode of the same stage wins ties
+            now = self._cache.get(key)
+            if now is not None:
+                td.plan = now
+            else:
+                self._cache[key] = td.plan
+                while len(self._cache) > self._max:
+                    self._cache.popitem(last=False)
+        return td
+
+
 class SchedulerClient:
     """Executor -> scheduler control-plane client."""
 
@@ -53,11 +101,11 @@ class SchedulerClient:
                    "statuses": [serde.status_to_obj(s) for s in statuses]})
 
     def poll_work(self, executor_id: str, num_free_slots: int,
-                  statuses: List[TaskStatus]):
+                  statuses: List[TaskStatus], decode=serde.task_from_obj):
         payload, _ = wire.call(self.host, self.port, "poll_work", {
             "executor_id": executor_id, "num_free_slots": num_free_slots,
             "statuses": [serde.status_to_obj(s) for s in statuses]})
-        return [serde.task_from_obj(t) for t in payload["tasks"]]
+        return [decode(t) for t in payload["tasks"]]
 
     def executor_stopped(self, executor_id: str, reason: str = "") -> None:
         wire.call(self.host, self.port, "executor_stopped",
@@ -125,6 +173,7 @@ class ExecutorServer:
         self.job_data_ttl_s = job_data_ttl_s
         self.janitor_interval_s = janitor_interval_s
         self._janitor_thread: Optional[threading.Thread] = None
+        self._plan_cache = StagePlanCache()
 
         self.rpc.register("launch_multi_task", self._launch_multi_task)
         self.rpc.register("cancel_tasks", self._cancel_tasks)
@@ -194,7 +243,8 @@ class ExecutorServer:
                 self.metadata.task_slots - self.executor.active_tasks()
             try:
                 tasks = self.scheduler.poll_work(self.metadata.executor_id,
-                                                 max(0, free), statuses)
+                                                 max(0, free), statuses,
+                                                 decode=self._plan_cache.decode)
             except Exception:  # noqa: BLE001 — scheduler briefly unreachable
                 log.warning("poll_work failed", exc_info=True)
                 # re-queue unreported statuses for the next poll
@@ -254,10 +304,13 @@ class ExecutorServer:
 
     # --- RPC handlers ----------------------------------------------------
     def _launch_multi_task(self, payload: dict, _bin: bytes):
-        tasks = [serde.task_from_obj(t) for t in payload["tasks"]]
+        tasks = [self._decode_task(t) for t in payload["tasks"]]
         for task in tasks:
             self.executor.submit_task(task, self._report_status)
         return {"accepted": len(tasks)}, b""
+
+    def _decode_task(self, t: dict):
+        return self._plan_cache.decode(t)
 
     def _report_status(self, status: TaskStatus) -> None:
         # push mode routes through the batching reporter loop so a transient

@@ -152,7 +152,9 @@ class ColumnBatch:
         target = round_capacity(n)
         if target >= self.capacity:
             return self
-        order = jnp.argsort(~self.mask, stable=True)[:target]
+        from ..ops.kernels import compaction_order
+
+        order = compaction_order(self.mask)[:target]
         cols = {k: v[order] for k, v in self.columns.items()}
         mask = self.mask[order]
         return ColumnBatch(self.schema, cols, mask, self.dicts, num_rows=n)

@@ -40,17 +40,18 @@ def _physical_arrow_schema(schema: Schema):
     return pa.schema(fields)
 
 
-def batch_to_physical_table(batch: ColumnBatch):
-    """Live rows only, physical representation (no decimal/date decoding)."""
+def physical_table_from_numpy(schema: Schema, data: Dict[str, np.ndarray],
+                              dicts: Dict[str, np.ndarray]):
+    """Compact host numpy columns -> physical arrow table (no decoding).
+    Non-string columns wrap zero-copy."""
     import pyarrow as pa
 
-    data = batch.compacted_numpy()
-    pa_schema = _physical_arrow_schema(batch.schema)
+    pa_schema = _physical_arrow_schema(schema)
     arrays = []
-    for f in batch.schema:
+    for f in schema:
         arr = data[f.name]
         if f.dtype.is_string:
-            dic = batch.dicts.get(f.name)
+            dic = dicts.get(f.name)
             if dic is None:
                 if len(arr) and arr.max(initial=-1) >= 0:
                     raise InternalError(f"string column {f.name!r} missing dictionary")
@@ -62,19 +63,35 @@ def batch_to_physical_table(batch: ColumnBatch):
     return pa.table(arrays, schema=pa_schema)
 
 
-def write_ipc_file(batch: ColumnBatch, path: str) -> tuple:
-    """Returns (num_rows, num_bytes)."""
+def batch_to_physical_table(batch: ColumnBatch):
+    """Live rows only, physical representation (no decimal/date decoding)."""
+    return physical_table_from_numpy(batch.schema, batch.compacted_numpy(),
+                                     batch.dicts)
+
+
+def _write_table_ipc(table, path: str) -> tuple:
     import pyarrow as pa
     import pyarrow.ipc as ipc
 
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    table = batch_to_physical_table(batch)
     tmp = path + ".tmp"
     with pa.OSFile(tmp, "wb") as sink:
         with ipc.new_file(sink, table.schema) as writer:
             writer.write_table(table)
     os.replace(tmp, path)
     return table.num_rows, os.path.getsize(path)
+
+
+def write_ipc_file(batch: ColumnBatch, path: str) -> tuple:
+    """Returns (num_rows, num_bytes)."""
+    return _write_table_ipc(batch_to_physical_table(batch), path)
+
+
+def write_ipc_rows(schema: Schema, data: Dict[str, np.ndarray],
+                   dicts: Dict[str, np.ndarray], path: str) -> tuple:
+    """Write already-compacted host rows (numpy slices wrap zero-copy).
+    Returns (num_rows, num_bytes)."""
+    return _write_table_ipc(physical_table_from_numpy(schema, data, dicts), path)
 
 
 def read_ipc_files(paths: Sequence[str], schema: Schema, capacity: Optional[int] = None) -> List[ColumnBatch]:

@@ -32,6 +32,19 @@ from .operators import AggSpec, HashAggregateExec
 from .physical import ExecutionPlan, Partitioning, TaskContext
 
 
+def _unshard(x: jnp.ndarray) -> jnp.ndarray:
+    """Collapse a mesh-sharded result to one ordinary single-device array.
+
+    Downstream operators run eager single-device ops; feeding them sharded
+    arrays makes every eager op an 8-device collective program, and
+    concurrently dispatched collective programs deadlock XLA's CPU
+    rendezvous (observed: 'Expected 8 threads to join ... only 6 arrived'
+    -> hard abort).  The fused program's outputs are small (group states /
+    join rows), so one host hop is cheap and keeps the mesh strictly
+    inside shard_map."""
+    return jnp.asarray(np.asarray(x))
+
+
 class MeshAggregateExec(ExecutionPlan):
     """Fused grouped aggregation over every local device.
 
@@ -150,9 +163,17 @@ class MeshAggregateExec(ExecutionPlan):
         # bound must respond to the config knob
         partial_cap = max(256, min(cap, padded // n_dev + 1))
         final_cap = max(256, min(cap, padded + 1))
+        # static dict-code ranges select the dense sort-free grouping path
+        # inside the fused program (kernels.grouped_aggregate)
+        key_ranges = tuple(
+            (-1, int(len(kc.dict_fn(big.dicts))) - 1)
+            if kc.dtype.is_string and kc.dict_fn is not None
+            else ((0, 1) if kc.dtype.kind == "bool" else None)
+            for kc, _n in key_c)
         run = distributed_filter_aggregate(
             mesh, derive, key_names, agg_specs,
-            partial_capacity=partial_cap, final_capacity=final_cap)
+            partial_capacity=partial_cap, final_capacity=final_cap,
+            key_ranges=key_ranges)
         fk, fv, fmask, overflow = run(cols, mask)
         if bool(overflow):
             raise CapacityError(
@@ -163,13 +184,14 @@ class MeshAggregateExec(ExecutionPlan):
         out_cols: Dict[str, jnp.ndarray] = {}
         dicts: Dict[str, np.ndarray] = {}
         for (kc, name), arr in zip(key_c, fk):
-            out_cols[name] = arr
+            out_cols[name] = _unshard(arr)
             if kc.dict_fn is not None:
                 dicts[name] = kc.dict_fn(big.dicts)
         for (cc, a), arr in zip(val_c, fv):
             want = self._schema.field(a.name).dtype.np_dtype
+            arr = _unshard(arr)
             out_cols[a.name] = arr.astype(want) if arr.dtype != want else arr
-        result = ColumnBatch(self._schema, out_cols, fmask, dicts)
+        result = ColumnBatch(self._schema, out_cols, _unshard(fmask), dicts)
         self.metrics().add("output_rows", result.num_rows)
         self.metrics().add("mesh_devices", n_dev)
         return [result]
@@ -342,7 +364,9 @@ class MeshJoinExec(ExecutionPlan):
         dicts = dict(probe.dicts)
         if self.join_type in ("inner", "left"):
             dicts.update(build.dicts)
-        result = ColumnBatch(self._schema, dict(out_cols), out_mask, dicts)
+        result = ColumnBatch(self._schema,
+                             {k: _unshard(v) for k, v in out_cols.items()},
+                             _unshard(out_mask), dicts)
         self.metrics().add("output_rows", result.num_rows)
         self.metrics().add("mesh_devices", n_dev)
         return [result]

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import glob as globmod
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -98,6 +99,9 @@ class Partitioning:
         return Partitioning("single", 1)
 
 
+_LOCK_CREATE = threading.Lock()
+
+
 class ExecutionPlan:
     """Base physical operator."""
 
@@ -106,6 +110,23 @@ class ExecutionPlan:
     @property
     def schema(self) -> Schema:
         return self._schema
+
+    def xla_lock(self) -> threading.Lock:
+        """Per-operator lock serializing jit-build + device dispatch.
+
+        Same-stage tasks share one operator instance; without this, N pool
+        threads race the lazy ``self._compiled`` build and trigger N
+        duplicate XLA compilations (minutes each on TPU).  Serializing the
+        dispatch itself costs nothing on one chip — device work from
+        concurrent tasks queues on the single TPU anyway; host-side scan
+        IO stays parallel (it runs outside this lock)."""
+        lock = getattr(self, "_xla_lock", None)
+        if lock is None:
+            with _LOCK_CREATE:
+                lock = getattr(self, "_xla_lock", None)
+                if lock is None:
+                    self._xla_lock = lock = threading.Lock()
+        return lock
 
     def children(self) -> List["ExecutionPlan"]:
         return []
@@ -261,16 +282,17 @@ class ScanExec(ExecutionPlan):
         if not self.filters:
             return batches
         # compile the conjunction once (per scan instance)
-        if self._filter_fn is None:
-            comp = ExprCompiler(self._schema, "device")
-            pred = comp.compile_pred(E.and_all(self.filters))
-            self._filter_compiler = comp
-            self._filter_fn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
-        out = []
-        for b in batches:
-            aux = self._filter_compiler.aux_arrays(b.dicts)
-            new_mask = self._filter_fn(b.columns, b.mask, aux)
-            out.append(ColumnBatch(b.schema, b.columns, new_mask, b.dicts))
+        with self.xla_lock():
+            if self._filter_fn is None:
+                comp = ExprCompiler(self._schema, "device")
+                pred = comp.compile_pred(E.and_all(self.filters))
+                self._filter_compiler = comp
+                self._filter_fn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
+            out = []
+            for b in batches:
+                aux = self._filter_compiler.aux_arrays(b.dicts)
+                new_mask = self._filter_fn(b.columns, b.mask, aux)
+                out.append(ColumnBatch(b.schema, b.columns, new_mask, b.dicts))
         return out
 
 

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 
 from ..models import expr as E
 from ..models.batch import ColumnBatch, concat_batches
-from ..models.ipc import read_ipc_files, write_ipc_file
+from ..models.ipc import read_ipc_files, write_ipc_file, write_ipc_rows
 from ..models.schema import Schema
 from ..utils.errors import FetchFailedError, InternalError
 from .expressions import ExprCompiler
@@ -109,29 +109,55 @@ class ShuffleWriterExec(ExecutionPlan):
 
         num_out = self.partitioning.count
         if self.partitioning.kind == "hash" and num_out > 1:
-            if self._compiled is None:
-                comp = ExprCompiler(self.input.schema, "device")
-                keys_c = [comp.compile_key(e) for e in self.partitioning.exprs]
+            # Device computes only the per-row bucket id (elementwise hash —
+            # compiles in seconds); then ONE device->host transfer per
+            # column and a host-side stable grouping sort hand the writer
+            # contiguous per-partition slices that wrap zero-copy into
+            # arrow arrays.  The reference streams batches through
+            # BatchPartitioner+IPCWriter incrementally
+            # (shuffle_writer.rs:214-252); the earlier rendition here
+            # materialized num_out full-capacity host copies instead, which
+            # made write_time dominate q1 wall-clock.  Grouping stays OFF
+            # the device on purpose: data-dependent sorts are the one XLA
+            # program measured to compile pathologically on TPU
+            # (kernels.py grouped_aggregate notes).
+            with self.xla_lock():
+                if self._compiled is None:
+                    comp = ExprCompiler(self.input.schema, "device")
+                    keys_c = [comp.compile_key(e) for e in self.partitioning.exprs]
 
-                def bucket_fn(cols, mask, aux):
-                    keys = [c.fn(cols, aux) for c in keys_c]
-                    return K.bucket_of(keys, num_out)
+                    def bucket_fn(cols, mask, aux):
+                        keys = [c.fn(cols, aux) for c in keys_c]
+                        return K.bucket_of(keys, num_out)
 
-                self._compiled = (comp, jax.jit(bucket_fn))
+                    self._compiled = (comp, jax.jit(bucket_fn))
             comp, bfn = self._compiled
             with self.metrics().timer("repart_time"):
                 aux = comp.aux_arrays(big.dicts)
-                buckets = bfn(big.columns, big.mask, aux)
-        else:
-            buckets = None  # everything to partition 0
+                buckets = np.asarray(bfn(big.columns, big.mask, aux))
+                mask_np = np.asarray(big.mask)
+                tagged = np.where(mask_np, buckets, num_out)
+                order = np.argsort(tagged, kind="stable")
+                counts = np.bincount(tagged, minlength=num_out + 1)[:num_out]
+                host_cols = {k: np.asarray(v)[order]
+                             for k, v in big.columns.items()}
+            offsets = np.concatenate([[0], np.cumsum(counts)])
+            out: List[ShuffleWritePartition] = []
+            with self.metrics().timer("write_time"):
+                for q in range(num_out):
+                    lo, hi = int(offsets[q]), int(offsets[q + 1])
+                    data = {k: v[lo:hi] for k, v in host_cols.items()}
+                    path = os.path.join(base, f"data-{q}.arrow")
+                    rows, nbytes = write_ipc_rows(big.schema, data, big.dicts, path)
+                    out.append(ShuffleWritePartition(q, path, rows, nbytes))
+            self.metrics().add("input_rows", big.num_rows)
+            self.metrics().add("output_rows", sum(p.num_rows for p in out))
+            return out
 
-        out: List[ShuffleWritePartition] = []
+        out = []
         with self.metrics().timer("write_time"):
             for q in range(num_out):
-                if buckets is None:
-                    part_mask = big.mask if q == 0 else jnp.zeros_like(big.mask)
-                else:
-                    part_mask = big.mask & (buckets == q)
+                part_mask = big.mask if q == 0 else jnp.zeros_like(big.mask)
                 pb = ColumnBatch(big.schema, big.columns, part_mask, big.dicts)
                 path = os.path.join(base, f"data-{q}.arrow")
                 rows, nbytes = write_ipc_file(pb, path)

@@ -48,6 +48,7 @@ def distributed_filter_aggregate(
     final_capacity: int,
     axis: str = PART_AXIS,
     skew_factor: float = 2.0,
+    key_ranges=None,
 ):
     """Fused scan-filter → partial agg → ICI shuffle → final agg step.
 
@@ -55,7 +56,9 @@ def distributed_filter_aggregate(
     stage's projection/filter pipeline).  ``agg_specs``: (value_column,
     how) with how in sum/count/min/max — AVG is decomposed into sum+count
     by the planner, the same two-phase split the reference inherits from
-    DataFusion.
+    DataFusion.  ``key_ranges`` (static per-key (lo, hi) bounds or None)
+    selects the dense sort-free grouping path on both sides of the
+    exchange — see kernels.grouped_aggregate.
 
     Returns ``run(cols, mask) -> (out_keys, out_vals, out_mask, overflow)``
     with outputs sharded over the mesh (device d owns the groups whose
@@ -70,7 +73,8 @@ def distributed_filter_aggregate(
         keys = [cols[k] for k in key_names]
         vals = [(cols[v], how) for v, how in agg_specs]
         pk, pv, pmask, ovf1 = K.grouped_aggregate(keys, vals, mask,
-                                                  partial_capacity)
+                                                  partial_capacity,
+                                                  key_ranges=key_ranges)
         shuffled = {f"k{i}": a for i, a in enumerate(pk)}
         shuffled.update({f"v{i}": a for i, a in enumerate(pv)})
         dest = K.bucket_of(pk, n)
@@ -78,7 +82,8 @@ def distributed_filter_aggregate(
         rk = [recv[f"k{i}"] for i in range(len(pk))]
         rv = [(recv[f"v{i}"], _MERGE[agg_specs[i][1]]) for i in range(len(pv))]
         fk, fv, fmask, ovf3 = K.grouped_aggregate(rk, rv, rmask,
-                                                  final_capacity)
+                                                  final_capacity,
+                                                  key_ranges=key_ranges)
         overflow = lax.psum((ovf1 | ovf2[0] | ovf3).astype(jnp.int32), axis) > 0
         return fk, fv, fmask, overflow
 

@@ -42,26 +42,31 @@ def dispatch_to_buckets(
     Returns (send_cols, send_mask, overflow).  Rows whose within-bucket rank
     exceeds ``capacity`` are dropped and flagged via ``overflow``.
     """
-    n_rows = mask.shape[0]
-    dkey = jnp.where(mask, dest, num_dest)  # dead rows -> sentinel bucket
-    order = jnp.argsort(dkey, stable=True)
-    dsorted = dkey[order]
-    counts = jnp.bincount(dkey, length=num_dest + 1)
-    starts = jnp.concatenate(
-        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])[:-1]
-    rank = jnp.arange(n_rows) - starts[dsorted]
-    slot_ok = (dsorted < num_dest) & (rank < capacity)
-    flat = jnp.where(slot_ok, dsorted * capacity + rank, num_dest * capacity)
+    dkey = jnp.where(mask, dest, num_dest).astype(jnp.int32)
+    # sort-free ranking: one cumsum per destination (num_dest = mesh size,
+    # small and static).  Data-dependent device sorts are the one XLA
+    # program measured to compile pathologically on TPU (kernels.py notes),
+    # and this dispatch runs inside the fused mesh program.
+    rank = jnp.zeros(mask.shape, dtype=jnp.int32)
+    counts = []
+    for b in range(num_dest):
+        is_b = dkey == b
+        within = jnp.cumsum(is_b.astype(jnp.int32))
+        rank = jnp.where(is_b, within - 1, rank)
+        counts.append(within[-1])
+    counts = jnp.stack(counts)
+    slot_ok = (dkey < num_dest) & (rank < capacity)
+    flat = jnp.where(slot_ok, dkey * capacity + rank, num_dest * capacity)
 
     send_cols = {}
     for name, col in cols.items():
         buf = jnp.zeros((num_dest * capacity + 1,), dtype=col.dtype)
-        buf = buf.at[flat].set(col[order], mode="drop")
+        buf = buf.at[flat].set(col, mode="drop")
         send_cols[name] = buf[:-1].reshape(num_dest, capacity)
     mbuf = jnp.zeros((num_dest * capacity + 1,), dtype=jnp.bool_)
     mbuf = mbuf.at[flat].set(slot_ok, mode="drop")
     send_mask = mbuf[:-1].reshape(num_dest, capacity)
-    overflow = jnp.any(counts[:num_dest] > capacity)
+    overflow = jnp.any(counts > capacity)
     return send_cols, send_mask, overflow
 
 

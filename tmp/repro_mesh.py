@@ -1,0 +1,29 @@
+import faulthandler, sys
+faulthandler.enable(file=sys.stderr, all_threads=True)
+import numpy as np
+import jax, jax.numpy as jnp
+from arrow_ballista_tpu.parallel.ici_shuffle import shuffle_rows, dispatch_to_buckets
+
+rng = np.random.default_rng(0)
+n = 1024
+cols = {"a": jnp.asarray(rng.integers(0, 100, n).astype(np.int64))}
+dest = jnp.asarray(rng.integers(0, 8, n).astype(np.int32))
+mask = jnp.asarray(np.ones(n, dtype=bool))
+sc, sm, ovf = jax.jit(lambda c, d, m: dispatch_to_buckets(c, d, m, 8, 256))(cols, dest, mask)
+jax.block_until_ready(sm)
+print("dispatch ok", bool(ovf))
+
+from arrow_ballista_tpu.parallel.mesh import make_mesh, row_sharding
+from arrow_ballista_tpu.parallel.distributed import distributed_grouped_aggregate
+
+mesh = make_mesh(8)
+rows = 128 * 8
+k = jnp.asarray(rng.integers(0, 5, rows).astype(np.int64))
+v = jnp.asarray(rng.integers(0, 100, rows).astype(np.int64))
+sh = row_sharding(mesh)
+cols = {"k": jax.device_put(k, sh), "v": jax.device_put(v, sh)}
+m = jax.device_put(jnp.ones(rows, dtype=bool), sh)
+run = distributed_grouped_aggregate(mesh, ["k"], [("v", "sum")], 32, 32)
+fk, fv, fmask, ovf = run(cols, m)
+jax.block_until_ready(fv)
+print("dist agg ok", bool(ovf))

@@ -1,0 +1,94 @@
+"""Which construct makes the q1 grouped_aggregate compile take 163 s on TPU?"""
+import sys, time
+import numpy as np
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+
+N = 1 << 20  # engine batch capacity
+rng = np.random.default_rng(0)
+k1 = jax.device_put(jnp.asarray(rng.integers(0, 3, N).astype(np.int64)))
+k2 = jax.device_put(jnp.asarray(rng.integers(0, 2, N).astype(np.int64)))
+v = jax.device_put(jnp.asarray(rng.integers(0, 10**9, N).astype(np.int64)))
+mask = jax.device_put(jnp.ones(N, dtype=bool))
+CAP = 16
+
+
+def ctime(name, fn, *args):
+    t0 = time.perf_counter()
+    out = jax.jit(fn).lower(*args).compile()
+    dt = time.perf_counter() - t0
+    print(f"compile {name:50s} {dt:8.1f} s", flush=True)
+    return out
+
+
+ctime("lexsort3 only", lambda a, b, m: jnp.lexsort([b, a, ~m]), k1, k2, mask)
+ctime("lexsort3 + 1 gather", lambda a, b, m: a[jnp.lexsort([b, a, ~m])], k1, k2, mask)
+
+
+def sort_boundary(a, b, m):
+    order = jnp.lexsort([b, a, ~m])
+    ms, as_, bs = m[order], a[order], b[order]
+    first = jnp.zeros(N, dtype=bool).at[0].set(True)
+    diff = (as_ != jnp.roll(as_, 1)) | (bs != jnp.roll(bs, 1))
+    boundary = ms & (first | diff)
+    return jnp.cumsum(boundary)
+
+
+ctime("sort+boundary+cumsum", sort_boundary, k1, k2, mask)
+
+
+def sort_seg1(a, b, m, vv):
+    order = jnp.lexsort([b, a, ~m])
+    ms, as_, bs = m[order], a[order], b[order]
+    first = jnp.zeros(N, dtype=bool).at[0].set(True)
+    diff = (as_ != jnp.roll(as_, 1)) | (bs != jnp.roll(bs, 1))
+    boundary = ms & (first | diff)
+    seg = jnp.cumsum(boundary) - 1
+    seg_ok = ms & (seg >= 0) & (seg < CAP)
+    seg_ids = jnp.where(seg_ok, seg, CAP)
+    return jax.ops.segment_sum(jnp.where(seg_ok, vv[order], 0), seg_ids, num_segments=CAP + 1)
+
+
+ctime("sort + 1 segment_sum", sort_seg1, k1, k2, mask, v)
+
+
+def sort_seg6(a, b, m, vv):
+    order = jnp.lexsort([b, a, ~m])
+    ms, as_, bs = m[order], a[order], b[order]
+    first = jnp.zeros(N, dtype=bool).at[0].set(True)
+    diff = (as_ != jnp.roll(as_, 1)) | (bs != jnp.roll(bs, 1))
+    boundary = ms & (first | diff)
+    seg = jnp.cumsum(boundary) - 1
+    seg_ok = ms & (seg >= 0) & (seg < CAP)
+    seg_ids = jnp.where(seg_ok, seg, CAP)
+    outs = []
+    for i in range(6):
+        outs.append(jax.ops.segment_sum(jnp.where(seg_ok, vv[order] + i, 0), seg_ids,
+                                        num_segments=CAP + 1))
+    return outs
+
+
+ctime("sort + 6 segment_sums", sort_seg6, k1, k2, mask, v)
+
+
+def key_scatter(a, b, m):
+    order = jnp.lexsort([b, a, ~m])
+    ms, as_ = m[order], a[order]
+    first = jnp.zeros(N, dtype=bool).at[0].set(True)
+    boundary = ms & first
+    seg = jnp.cumsum(boundary) - 1
+    seg_ok = ms & (seg >= 0) & (seg < CAP)
+    return jnp.zeros(CAP, dtype=as_.dtype).at[
+        jnp.where(boundary & seg_ok, seg, CAP)].set(as_, mode="drop")
+
+
+ctime("sort + key scatter (at.set drop)", key_scatter, k1, k2, mask)
+
+sys.path.insert(0, "/root/repo")
+from arrow_ballista_tpu.ops import kernels as K
+
+ctime("full grouped_aggregate (2 keys, 1 val)",
+      lambda a, b, m, vv: K.grouped_aggregate([a, b], [(vv, "sum")], m, CAP),
+      k1, k2, mask, v)

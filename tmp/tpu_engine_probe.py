@@ -1,0 +1,29 @@
+"""Engine q1+q6 SF1 on the real TPU chip with wall-clock breakdown."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax
+
+jax.config.update("jax_enable_x64", True)
+print("backend:", jax.devices()[0].platform, flush=True)
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+from benchmarks.queries import QUERIES as SQL
+from benchmarks.tpch import register_tables
+
+config = BallistaConfig({
+    "ballista.shuffle.partitions": "8",
+    "ballista.batch.size": str(1 << 20),
+    "ballista.job.timeout.seconds": "1800",
+})
+ctx = BallistaContext.standalone(config, concurrent_tasks=4)
+register_tables(ctx, "/root/repo/.bench_data/tpch-sf1")
+
+for q in (1, 6):
+    for it in range(2):
+        t0 = time.perf_counter()
+        res = ctx.sql(SQL[q]).collect()
+        nrows = sum(b.num_rows for b in res)
+        print(f"q{q} iter{it}: {time.perf_counter()-t0:8.1f} s ({nrows} rows)", flush=True)
+ctx.shutdown()
+print("DONE", flush=True)

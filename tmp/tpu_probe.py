@@ -1,0 +1,119 @@
+"""Measure primitive kernel costs on the real TPU chip: what makes q1 slow?"""
+import sys, time
+import numpy as np
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+
+dev = jax.devices()[0]
+print(f"backend: {dev.platform} ({dev.device_kind})", flush=True)
+
+N = 8_000_000
+rng = np.random.default_rng(0)
+
+
+def bench(name, fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    ms = np.median(ts) * 1000
+    print(f"{name:45s} {ms:10.1f} ms   ({N/np.median(ts)/1e6:8.1f}M rows/s)", flush=True)
+    return ms
+
+
+i64 = jax.device_put(jnp.asarray(rng.integers(0, 6, N).astype(np.int64)))
+i64b = jax.device_put(jnp.asarray(rng.integers(0, 3, N).astype(np.int64)))
+i64big = jax.device_put(jnp.asarray(rng.integers(0, 2**40, N).astype(np.int64)))
+i32 = jax.device_put(jnp.asarray(rng.integers(0, 6, N).astype(np.int32)))
+i32b = jax.device_put(jnp.asarray(rng.integers(0, 3, N).astype(np.int32)))
+f32 = jax.device_put(jnp.asarray(rng.random(N, dtype=np.float32)))
+mask = jax.device_put(jnp.ones(N, dtype=bool))
+
+bench("argsort int64 (small domain)", jax.jit(jnp.argsort), i64)
+bench("argsort int32 (small domain)", jax.jit(jnp.argsort), i32)
+bench("argsort int64 (big domain)", jax.jit(jnp.argsort), i64big)
+bench("argsort f32", jax.jit(jnp.argsort), f32)
+bench("lexsort 3x int64", jax.jit(lambda a, b, m: jnp.lexsort([a, b, ~m])), i64, i64b, mask)
+bench("lexsort 3x int32", jax.jit(lambda a, b, m: jnp.lexsort([a, b, ~m])), i32, i32b, mask)
+
+seg32 = jax.device_put(jnp.asarray(rng.integers(0, 16, N).astype(np.int32)))
+bench("segment_sum int64 vals, 17 segs",
+      jax.jit(lambda v, s: jax.ops.segment_sum(v, s, num_segments=17)), i64big, seg32)
+bench("segment_sum int32->int64 cast, 17 segs",
+      jax.jit(lambda v, s: jax.ops.segment_sum(v.astype(jnp.int64), s, num_segments=17)), i32, seg32)
+bench("segment_sum f32, 17 segs",
+      jax.jit(lambda v, s: jax.ops.segment_sum(v, s, num_segments=17)), f32, seg32)
+
+# gather (the compaction/sort-apply pattern)
+order = jax.jit(jnp.argsort)(i64big)
+jax.block_until_ready(order)
+bench("gather int64 by order", jax.jit(lambda a, o: a[o]), i64big, order)
+bench("gather int32 by order", jax.jit(lambda a, o: a[o]), i32, order)
+
+# elementwise int64 math (q1 augment)
+bench("elementwise int64 mul chain",
+      jax.jit(lambda a, b: a * (100 - b) * (100 + b) // 100), i64big, i64b)
+
+# the current full q1 kernel for comparison
+sys.path.insert(0, "/root/repo")
+from __graft_entry__ import _q1_augment, _q1_example, _q1_filter, _Q1_AGGS, _Q1_KEYS
+from arrow_ballista_tpu.ops import kernels as K
+
+cols_np, mask_np = _q1_example(N, seed=7)
+cols = {k: jax.device_put(jnp.asarray(v)) for k, v in cols_np.items()}
+msk = jax.device_put(jnp.asarray(mask_np))
+
+
+@jax.jit
+def q1_current(cols, mask):
+    cols, mask = _q1_filter(cols, mask)
+    cols = _q1_augment(cols)
+    keys = [cols[k] for k in _Q1_KEYS]
+    vals = [(cols[v], how) for v, how in _Q1_AGGS]
+    return K.grouped_aggregate(keys, vals, mask, 16)
+
+
+t0 = time.perf_counter()
+out = q1_current(cols, msk)
+jax.block_until_ready(out[1])
+print(f"q1 current: compile+first run {time.perf_counter()-t0:.1f} s", flush=True)
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    out = q1_current(cols, msk)
+    jax.block_until_ready(out[1])
+    ts.append(time.perf_counter() - t0)
+print(f"q1 current kernel: {np.median(ts)*1000:.1f} ms ({N/np.median(ts)/1e6:.1f}M rows/s)", flush=True)
+
+
+# dense-domain variant: fused int32 key, segment ops, no sort
+@jax.jit
+def q1_dense(cols, mask):
+    cols, mask = _q1_filter(cols, mask)
+    cols = _q1_augment(cols)
+    fused = (cols["l_returnflag"] * 2 + cols["l_linestatus"]).astype(jnp.int32)
+    seg = jnp.where(mask, fused, 6)
+    outs = []
+    for v, how in _Q1_AGGS:
+        outs.append(jax.ops.segment_sum(jnp.where(mask, cols[v], 0), seg, num_segments=7)[:6])
+    counts = jax.ops.segment_sum(jnp.where(mask, 1, 0), seg, num_segments=7)[:6]
+    return outs, counts
+
+
+t0 = time.perf_counter()
+out = q1_dense(cols, msk)
+jax.block_until_ready(out[1])
+print(f"q1 dense: compile+first run {time.perf_counter()-t0:.1f} s", flush=True)
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    out = q1_dense(cols, msk)
+    jax.block_until_ready(out[1])
+    ts.append(time.perf_counter() - t0)
+print(f"q1 dense kernel: {np.median(ts)*1000:.1f} ms ({N/np.median(ts)/1e6:.1f}M rows/s)", flush=True)

@@ -1,0 +1,24 @@
+"""Is the 110 s sort compile triggered by power-of-two shapes?"""
+import time
+import numpy as np
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+
+rng = np.random.default_rng(0)
+
+for n, label in [
+    (1 << 16, "2^16"),
+    ((1 << 16) + 128, "2^16+128"),
+    (1 << 20, "2^20"),
+    ((1 << 20) + 128, "2^20+128"),
+    ((1 << 20) - 128, "2^20-128"),
+    (1_000_000, "1e6"),
+    (8_000_000, "8e6"),
+    (1 << 23, "2^23"),
+]:
+    a = jax.device_put(jnp.asarray(rng.integers(0, 2**40, n).astype(np.int64)))
+    t0 = time.perf_counter()
+    jax.jit(jnp.argsort).lower(a).compile()
+    print(f"argsort int64 n={label:10s} compile {time.perf_counter()-t0:7.1f} s", flush=True)
