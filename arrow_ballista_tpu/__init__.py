@@ -13,11 +13,41 @@ Arrow/DataFusion, reference at /root/reference) re-designed for TPU:
 """
 from __future__ import annotations
 
+import os as _os
+
 import jax as _jax
 
 # int64 is load-bearing: decimals are fixed-point int64 (exact money math on
 # TPU, which has no native f64).  Without x64, JAX silently truncates to int32.
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: every ctx.sql() builds fresh operator
+# instances, so in-memory jit caches never hit across queries — but the HLO
+# is identical, and TPU sort programs take 30-110s to compile (measured on
+# v5e).  The disk cache turns repeat compiles into millisecond loads, across
+# queries AND processes.  Opt out with BALLISTA_XLA_CACHE=0 or point it
+# elsewhere with BALLISTA_XLA_CACHE=<dir>.
+_cache = _os.environ.get("BALLISTA_XLA_CACHE", "")
+if _cache != "0":
+    if not _cache:
+        # per-platform dirs: entries carry machine-specific AOT artifacts
+        # (a TPU-tunnel process compiles host programs on the REMOTE
+        # machine; loading those on this host warns about mismatched CPU
+        # features and risks SIGILL), so cpu-forced and tpu processes must
+        # never share a cache
+        _plat = (_os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+                 or "default")
+        _cache = _os.path.join(
+            _os.environ.get("XDG_CACHE_HOME",
+                            _os.path.expanduser("~/.cache")),
+            "ballista_tpu_xla", _plat)
+    try:
+        _os.makedirs(_cache, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
 
 __version__ = "0.1.0"
 

@@ -93,32 +93,23 @@ class MemoryTable(TableProvider):
 
 class ParquetTable(TableProvider):
     def __init__(self, name: str, paths, schema: Optional[Schema] = None):
-        import glob
-        import os
-
-        import pyarrow.parquet as pq
+        from .utils import object_store as obs
 
         self.name = name
         self.paths = [paths] if isinstance(paths, str) else list(paths)
         if schema is None:
-            first = self.paths[0]
-            if os.path.isdir(first):
-                files = sorted(glob.glob(os.path.join(first, "*.parquet")))
-                if not files:
-                    raise PlanningError(f"no parquet files in {first}")
-                first = files[0]
-            first_path = self.paths[0]
-            if os.path.isdir(first_path):
-                files = sorted(glob.glob(os.path.join(first_path, "*.parquet")))
-            else:
-                files = list(self.paths)
-            pf = pq.ParquetFile(files[0])
+            files = []
+            for p in self.paths:
+                files.extend(obs.list_files(p, (".parquet",)))
+            if not files:
+                raise PlanningError(f"no parquet files in {self.paths}")
+            pf = obs.parquet_file(files[0])
             # nullability from row-group statistics across EVERY file
             # (cheap, metadata-only); columns without stats are
             # conservatively nullable
             nullable: Dict[str, bool] = {}
             for fpath in files:
-                meta = pq.ParquetFile(fpath).metadata
+                meta = obs.parquet_file(fpath).metadata
                 for ci in range(meta.num_columns):
                     col_name = meta.schema.column(ci).name
                     if nullable.get(col_name):
@@ -160,13 +151,18 @@ class CsvTable(TableProvider):
         if schema is None:
             import pyarrow.csv as pacsv
 
-            table = pacsv.read_csv(
-                self.paths[0],
-                parse_options=pacsv.ParseOptions(delimiter=delimiter),
-            )
-            import os as osmod
+            from .utils import object_store as obs
 
-            multi = len(self.paths) > 1 or osmod.path.isdir(self.paths[0])
+            samples = obs.list_files(self.paths[0], (".csv", ".tbl"))
+            if not samples:
+                raise PlanningError(f"no csv files in {self.paths[0]}")
+            sample = samples[0]
+            with obs.open_input(sample) as fh:
+                table = pacsv.read_csv(
+                    fh, parse_options=pacsv.ParseOptions(delimiter=delimiter),
+                )
+
+            multi = len(self.paths) > 1 or obs.is_dir(self.paths[0])
             if multi:
                 # only the first file was sampled; other files may hold
                 # NULLs, so be conservative

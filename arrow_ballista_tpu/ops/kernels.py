@@ -110,6 +110,19 @@ AGG_MAX = "max"
 DENSE_DOMAIN_LIMIT = 1 << 16  # max enumerable key-combination count
 
 
+def dense_domain(key_ranges) -> Optional[int]:
+    """Enumerable key-combination count when EVERY key has static (lo, hi)
+    bounds and the product is within DENSE_DOMAIN_LIMIT; else None.  The
+    single authority for 'does the dense path apply' — callers use it to
+    clamp output capacities to what the kernel will actually produce."""
+    if not key_ranges or any(r is None for r in key_ranges):
+        return None
+    domain = 1
+    for lo, hi in key_ranges:
+        domain *= max(0, hi - lo + 1)
+    return domain if 0 < domain <= DENSE_DOMAIN_LIMIT else None
+
+
 def grouped_aggregate(
     key_cols: List[jnp.ndarray],
     val_cols: List[Tuple[jnp.ndarray, str]],
@@ -132,11 +145,9 @@ def grouped_aggregate(
     q1 shape on v5e) and runs ~2.5x faster.  Otherwise grouping is
     sort-based (lexsort -> boundary flags -> segment reductions).
     """
-    if key_cols and key_ranges is not None and all(r is not None for r in key_ranges):
-        domain = 1
-        for lo, hi in key_ranges:
-            domain *= max(0, hi - lo + 1)
-        if 0 < domain <= DENSE_DOMAIN_LIMIT:
+    if key_cols:
+        domain = dense_domain(key_ranges)
+        if domain is not None:
             return _grouped_aggregate_dense(key_cols, val_cols, mask,
                                             out_capacity, key_ranges, domain)
     n = mask.shape[0]

@@ -170,6 +170,13 @@ class MeshAggregateExec(ExecutionPlan):
             if kc.dtype.is_string and kc.dict_fn is not None
             else ((0, 1) if kc.dtype.kind == "bool" else None)
             for kc, _n in key_c)
+        from .kernels import dense_domain
+
+        domain = dense_domain(key_ranges)
+        if domain is not None:
+            # dense domain bounds groups exactly on both exchange sides
+            partial_cap = min(partial_cap, domain)
+            final_cap = min(final_cap, domain)
         run = distributed_filter_aggregate(
             mesh, derive, key_names, agg_specs,
             partial_capacity=partial_cap, final_capacity=final_cap,

@@ -425,6 +425,11 @@ class HashAggregateExec(ExecutionPlan):
         # groups can never exceed live rows).  Mirrors the join's bucketed
         # recompilation; static shapes stay static per bucket.
         out_cap = min(cfg_cap, big.capacity)
+        # dense domain bounds distinct groups exactly: don't allocate (or
+        # device->host transfer) a 64k-row output for 12 possible groups
+        domain = K.dense_domain(key_ranges)
+        if domain is not None:
+            out_cap = min(out_cap, domain)
         with self.metrics().timer("agg_time"):
             aux = comp.aux_arrays(big.dicts)
             while True:

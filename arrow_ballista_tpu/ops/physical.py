@@ -12,8 +12,6 @@ stream of small batches.
 from __future__ import annotations
 
 import dataclasses
-import glob as globmod
-import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -418,15 +416,12 @@ class ParquetScanExec(ScanExec):
     def __init__(self, schema: Schema, paths: List[str], target_partitions: int,
                  filters: Sequence[E.Expr] = (), table_schema: Optional[Schema] = None):
         super().__init__(schema, filters)
-        import pyarrow.parquet as pq
+        from ..utils import object_store as obs
 
         self.table_schema = table_schema or schema
         files = []
         for p in paths:
-            if os.path.isdir(p):
-                files.extend(sorted(globmod.glob(os.path.join(p, "*.parquet"))))
-            else:
-                files.append(p)
+            files.extend(obs.list_files(p, (".parquet",)))
         if not files:
             raise ExecutionError(f"no parquet files found in {paths}")
         self.files = files
@@ -435,7 +430,7 @@ class ParquetScanExec(ScanExec):
         units: List[Tuple[str, int, int]] = []  # (file, row_group, rows)
         self.pruned_row_groups = 0
         for f in files:
-            meta = pq.ParquetFile(f).metadata
+            meta = obs.parquet_file(f).metadata
             name_to_idx = {meta.schema.column(i).name: i
                            for i in range(meta.num_columns)}
             for rg in range(meta.num_row_groups):
@@ -474,21 +469,28 @@ class ParquetScanExec(ScanExec):
 
     def _read_partition(self, partition: int):
         import pyarrow as pa
-        import pyarrow.parquet as pq
+
+        from ..utils import object_store as obs
 
         units = self.groups[partition]
         if not units:
             return self._schema.to_arrow_empty()
-        tables = []
         by_file: Dict[str, List[int]] = {}
         for f, rg, _ in units:
             by_file.setdefault(f, []).append(rg)
-        for f, rgs in by_file.items():
-            tables.append(
-                pq.ParquetFile(f).read_row_groups(sorted(rgs),
-                                                  columns=self._schema.names())
-            )
-        return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        cols = self._schema.names()
+        if len(by_file) == 1:
+            f, rgs = next(iter(by_file.items()))
+            return obs.read_parquet_row_groups(f, sorted(rgs), cols)
+        # overlap reads across files (each pyarrow read releases the GIL;
+        # object-store fetches overlap their network latency)
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(by_file))) as pool:
+            tables = list(pool.map(
+                lambda kv: obs.read_parquet_row_groups(kv[0], sorted(kv[1]), cols),
+                by_file.items()))
+        return pa.concat_tables(tables)
 
     def row_count_estimate(self) -> int:
         return self._total_rows
@@ -507,16 +509,14 @@ class CsvScanExec(ScanExec):
                  filters: Sequence[E.Expr] = (), table_schema: Optional[Schema] = None,
                  delimiter: str = ",", has_header: bool = True):
         super().__init__(schema, filters)
+        from ..utils import object_store as obs
+
         self.table_schema = table_schema or schema
         self.delimiter = delimiter
         self.has_header = has_header
         files = []
         for p in paths:
-            if os.path.isdir(p):
-                for pat in ("*.csv", "*.tbl"):
-                    files.extend(sorted(globmod.glob(os.path.join(p, pat))))
-            else:
-                files.append(p)
+            files.extend(obs.list_files(p, (".csv", ".tbl")))
         if not files:
             raise ExecutionError(f"no csv files found in {paths}")
         self.files = files
@@ -539,6 +539,8 @@ class CsvScanExec(ScanExec):
         import pyarrow as pa
         import pyarrow.csv as pacsv
 
+        from ..utils import object_store as obs
+
         names = self.table_schema.names()
         column_types = {f.name: self._arrow_type(f.dtype) for f in self.table_schema}
         tables = []
@@ -550,8 +552,10 @@ class CsvScanExec(ScanExec):
             copts = pacsv.ConvertOptions(
                 column_types=column_types, include_columns=self._schema.names()
             )
-            tables.append(pacsv.read_csv(f, read_options=ropts, parse_options=popts,
-                                         convert_options=copts))
+            with obs.open_input(f) as fh:
+                tables.append(pacsv.read_csv(fh, read_options=ropts,
+                                             parse_options=popts,
+                                             convert_options=copts))
         return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
 
     def _label(self):
@@ -559,6 +563,16 @@ class CsvScanExec(ScanExec):
 
 
 def _has_trailing_delimiter(path: str, delim: str) -> bool:
-    with open(path, "rb") as fh:
-        line = fh.readline().rstrip(b"\r\n")
+    from ..utils import object_store as obs
+
+    buf = b""
+    with obs.open_input(path) as fh:
+        # read until the first newline (or EOF) — never misjudge a first
+        # line longer than one chunk
+        while b"\n" not in buf:
+            chunk = fh.read(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+    line = buf.split(b"\n", 1)[0].rstrip(b"\r")
     return line.endswith(delim.encode())

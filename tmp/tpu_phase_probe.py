@@ -1,0 +1,87 @@
+"""Phase breakdown of one q1 map partition on TPU + H2D bandwidth + cache test."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import arrow_ballista_tpu  # noqa: F401  (enables persistent cache)
+import jax.numpy as jnp
+
+dev = jax.devices()[0]
+print("backend:", dev.platform, flush=True)
+
+# --- H2D / D2H bandwidth over the tunnel ---
+x = np.random.default_rng(0).integers(0, 1 << 40, 4_000_000).astype(np.int64)  # 32 MB
+t0 = time.perf_counter()
+dx = jax.device_put(x)
+jax.block_until_ready(dx)
+t1 = time.perf_counter()
+print(f"H2D 32MB: {t1-t0:6.2f} s ({32/(t1-t0):6.1f} MB/s)", flush=True)
+t0 = time.perf_counter()
+_ = np.asarray(dx)
+t1 = time.perf_counter()
+print(f"D2H 32MB: {t1-t0:6.2f} s ({32/(t1-t0):6.1f} MB/s)", flush=True)
+
+# --- one q1 map partition: scan -> convert -> H2D -> filter+partial agg ---
+from arrow_ballista_tpu.models.schema import Schema
+from arrow_ballista_tpu.ops.physical import ParquetScanExec, TaskContext, table_to_batches
+from arrow_ballista_tpu.utils.config import BallistaConfig
+from benchmarks.schema import TABLES
+
+sch = TABLES["lineitem"]
+cols_needed = ["l_quantity", "l_extendedprice", "l_discount", "l_tax",
+               "l_returnflag", "l_linestatus", "l_shipdate"]
+proj = Schema([f for f in sch if f.name in cols_needed])
+
+import pyarrow.parquet as pq
+
+t0 = time.perf_counter()
+pf = pq.ParquetFile("/root/repo/.bench_data/tpch-sf1/lineitem.parquet")
+nrg = pf.metadata.num_row_groups
+table = pf.read_row_groups(list(range(min(2, nrg))), columns=cols_needed)
+t1 = time.perf_counter()
+print(f"parquet read {table.num_rows} rows ({nrg} rgs total): {t1-t0:6.2f} s", flush=True)
+
+cfg = BallistaConfig({"ballista.batch.size": str(1 << 20)})
+t0 = time.perf_counter()
+batches = table_to_batches(table, proj, 1 << 20)
+t1 = time.perf_counter()
+print(f"convert+H2D ({len(batches)} batches): {t1-t0:6.2f} s", flush=True)
+
+b = batches[0]
+t0 = time.perf_counter()
+jax.block_until_ready(list(b.columns.values()))
+print(f"block on batch arrays: {time.perf_counter()-t0:6.2f} s", flush=True)
+
+# filter + partial agg (dense path) jitted, timed separately compile vs run
+from arrow_ballista_tpu.ops import kernels as K
+
+CUT = 10471
+rf_range = (-1, 2)
+ls_range = (-1, 1)
+
+
+@jax.jit
+def partial(cols, mask):
+    mask = mask & (cols["l_shipdate"] <= CUT)
+    disc = cols["l_extendedprice"] * (100 - cols["l_discount"])
+    charge = disc * (100 + cols["l_tax"]) // 100
+    keys = [cols["l_returnflag"], cols["l_linestatus"]]
+    vals = [(cols["l_quantity"], "sum"), (cols["l_extendedprice"], "sum"),
+            (disc, "sum"), (charge, "sum"), (cols["l_discount"], "sum"),
+            (jnp.ones_like(mask, jnp.int64), "sum")]
+    return K.grouped_aggregate(keys, vals, mask, 64,
+                               key_ranges=(rf_range, ls_range))
+
+
+t0 = time.perf_counter()
+out = partial(b.columns, b.mask)
+jax.block_until_ready(out[1])
+t1 = time.perf_counter()
+print(f"partial agg compile+run: {t1-t0:6.2f} s", flush=True)
+t0 = time.perf_counter()
+out = partial(b.columns, b.mask)
+jax.block_until_ready(out[1])
+print(f"partial agg steady: {time.perf_counter()-t0:6.3f} s", flush=True)
+print("DONE", flush=True)
