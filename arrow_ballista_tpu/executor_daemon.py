@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import time
 
@@ -18,9 +19,11 @@ def main(argv=None) -> None:
     ap.add_argument("--scheduler-port", type=int, default=50050)
     ap.add_argument("--bind-host", default="127.0.0.1")
     ap.add_argument("--bind-port", type=int, default=0)
-    ap.add_argument("--external-host", default=None,
+    ap.add_argument("--external-host",
+                    default=os.environ.get("BALLISTA_EXTERNAL_HOST") or None,
                     help="address advertised to peers for shuffle fetch "
-                         "(defaults to bind host, or hostname when 0.0.0.0)")
+                         "(env BALLISTA_EXTERNAL_HOST; defaults to bind "
+                         "host, or hostname when 0.0.0.0)")
     ap.add_argument("--work-dir", default=None)
     ap.add_argument("--concurrent-tasks", type=int, default=4)
     ap.add_argument("--connect-timeout-s", type=float, default=30.0)

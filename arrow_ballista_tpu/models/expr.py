@@ -278,6 +278,29 @@ AGG_FUNCS = ("sum", "min", "max", "count", "avg")
 
 
 @dataclasses.dataclass
+class Udf(Expr):
+    """Scalar UDF call, resolved by name from the process-global registry
+    (reference plugin/udf.rs — executors resolve plugins by name too)."""
+
+    name: str
+    args: tuple  # of Expr
+
+    def dtype(self, schema: Schema) -> DataType:
+        from ..udf import GLOBAL_UDFS
+
+        udf = GLOBAL_UDFS.get(self.name)
+        if udf is None:
+            raise PlanningError(f"unknown function {self.name!r}")
+        return udf.result_dtype([a.dtype(schema) for a in self.args])
+
+    def children(self):
+        return tuple(self.args)
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+@dataclasses.dataclass
 class Agg(Expr):
     func: str
     operand: Optional[Expr]  # None for count(*)

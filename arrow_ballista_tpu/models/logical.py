@@ -149,6 +149,11 @@ class Join(LogicalPlan):
             self.schema = Schema(
                 list(left.schema)
                 + [Field(f.name, f.dtype, nullable=True) for f in right.schema])
+        elif join_type == "full":
+            # both sides nullable: unmatched rows from either side carry NULLs
+            self.schema = Schema(
+                [Field(f.name, f.dtype, nullable=True) for f in left.schema]
+                + [Field(f.name, f.dtype, nullable=True) for f in right.schema])
         else:
             raise PlanningError(f"unsupported join type {join_type}")
 

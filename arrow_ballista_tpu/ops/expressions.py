@@ -414,6 +414,20 @@ class ExprCompiler:
             field = e.field
             return Compiled(lambda c, a: K.extract_field(oc.fn(c, a), field, xp), INT32)
 
+        if isinstance(e, E.Udf):
+            from ..udf import GLOBAL_UDFS
+
+            udf = GLOBAL_UDFS.get(e.name)
+            if udf is None:
+                raise PlanningError(f"unknown function {e.name!r} (not in the "
+                                    "UDF registry on this node)")
+            arg_c = [self._c(a) for a in e.args]
+            out_t = udf.result_dtype([c.dtype for c in arg_c])
+            f = udf.fn
+            return Compiled(
+                lambda c, a, f=f, arg_c=arg_c: f(*[ac.fn(c, a) for ac in arg_c]),
+                out_t)
+
         if isinstance(e, E.Substring):
             oc = self._c(e.operand)
             if not oc.dtype.is_string:

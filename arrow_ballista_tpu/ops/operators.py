@@ -505,8 +505,12 @@ class JoinExec(ExecutionPlan):
     def __init__(self, left: ExecutionPlan, right: ExecutionPlan,
                  on: List[Tuple[E.Expr, E.Expr]], join_type: str = "inner",
                  filter: Optional[E.Expr] = None, dist: str = "partitioned"):
-        assert join_type in ("inner", "left", "semi", "anti")
+        assert join_type in ("inner", "left", "full", "semi", "anti")
         assert dist in ("partitioned", "broadcast")
+        # broadcast replicates the build side to every probe partition; a
+        # full join would then emit each unmatched build row once PER
+        # partition — the planner must use the partitioned path instead
+        assert not (join_type == "full" and dist == "broadcast")
         self.left = left
         self.right = right
         self.on = on
@@ -518,6 +522,10 @@ class JoinExec(ExecutionPlan):
         elif join_type == "left":
             self._schema = Schema(
                 list(left.schema)
+                + [Field(f.name, f.dtype, nullable=True) for f in right.schema])
+        elif join_type == "full":
+            self._schema = Schema(
+                [Field(f.name, f.dtype, nullable=True) for f in left.schema]
                 + [Field(f.name, f.dtype, nullable=True) for f in right.schema])
         else:
             self._schema = left.schema.merge(right.schema)
@@ -568,6 +576,7 @@ class JoinExec(ExecutionPlan):
             lnames = [f.name for f in lsch]
             rnames = [f.name for f in rsch]
             rfill = {f.name: f.dtype.null_sentinel for f in rsch}
+            lfill = {f.name: f.dtype.null_sentinel for f in lsch}
 
             def join_fn(pcols, pmask, bcols, bmask, laux, raux, faux, out_cap):
                 pk = [c.fn(pcols, laux) for c in lkeys]
@@ -602,7 +611,7 @@ class JoinExec(ExecutionPlan):
                 out_cols = {n: pcols[n][pi] for n in lnames}
                 out_cols.update({n: bcols[n][bidx] for n in rnames})
                 out_mask = ok
-                if jt == "left":
+                if jt in ("left", "full"):
                     hit = K.segment_any(ok, pi, pmask.shape[0])
                     miss = pmask & ~hit
                     # append unmatched probe rows; build side filled with the
@@ -619,6 +628,22 @@ class JoinExec(ExecutionPlan):
                         for n in out_cols
                     }
                     out_mask = jnp.concatenate([out_mask, miss])
+                if jt == "full":
+                    # unmatched BUILD rows too, probe side NULL-filled
+                    hit_b = K.segment_any(ok, bidx, bmask.shape[0])
+                    miss_b = bmask & ~hit_b
+                    out_cols = {
+                        n: jnp.concatenate([
+                            out_cols[n],
+                            bcols[n] if n in rnames else jnp.full(
+                                bmask.shape[0],
+                                lfill[n],
+                                out_cols[n].dtype,
+                            ),
+                        ])
+                        for n in out_cols
+                    }
+                    out_mask = jnp.concatenate([out_mask, miss_b])
                 return out_cols, out_mask, total
 
             self._compiled = (lcomp, rcomp, fcomp, jax.jit(join_fn, static_argnums=(7,)))
@@ -652,7 +677,7 @@ class JoinExec(ExecutionPlan):
                 )
 
         dicts = dict(probe.dicts)
-        if self.join_type in ("inner", "left"):
+        if self.join_type in ("inner", "left", "full"):
             dicts.update(build.dicts)
         result = ColumnBatch(self._schema, dict(out_cols), out_mask, dicts)
         self.metrics().add("output_rows", result.num_rows)

@@ -92,14 +92,126 @@ class KeyValueStore:
             except TxnGuardFailed:
                 pass
 
+    def watch(self, space: str, poll_interval_s: float = 0.2) -> "Watch":
+        """Subscribe to changes in a keyspace (reference KeyValueStore::watch,
+        storage/mod.rs:30-147 — etcd watch streams; sled subscriber).  The
+        base implementation polls scan() and diffs snapshots, which works for
+        ANY driver including multi-process sqlite; push-capable drivers
+        (MemoryKv, RemoteKv) override with real event streams."""
+        return _PollingWatch(self, space, poll_interval_s)
+
     def close(self) -> None:
         pass
+
+
+class WatchEvent:
+    __slots__ = ("op", "space", "key", "value")
+
+    def __init__(self, op: str, space: str, key: str, value: Optional[str]):
+        # 'put' | 'del' | 'resync' ('resync' = the stream lost history:
+        # consumers mirroring the keyspace must clear their mirror; a full
+        # snapshot follows as puts)
+        self.op = op
+        self.space = space
+        self.key = key
+        self.value = value
+
+    def __repr__(self):
+        return f"WatchEvent({self.op}, {self.space}/{self.key})"
+
+
+class Watch:
+    """Event stream handle.  ``get(timeout)`` returns the next WatchEvent or
+    None on timeout; iterate for a blocking stream; ``close()`` releases."""
+
+    def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __iter__(self):
+        while True:
+            ev = self.get(timeout=None)
+            if ev is None:
+                return
+            yield ev
+
+
+class _QueueWatch(Watch):
+    def __init__(self, on_close=None):
+        import queue
+
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._closed = False
+        self._close_started = False
+        self._on_close = on_close
+
+    def _push(self, ev: Optional[WatchEvent]) -> None:
+        self._q.put(ev)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        import queue
+
+        if self._closed:
+            return None
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if ev is None:
+            self._closed = True
+        return ev
+
+    def close(self) -> None:
+        if not self._close_started:
+            self._close_started = True
+            # sentinel (get() flips _closed when it sees None): a consumer
+            # blocked in get(timeout=None) / `for ev in watch` must wake up
+            # and terminate; queued events before the sentinel still drain
+            self._q.put(None)
+            if self._on_close is not None:
+                self._on_close(self)
+
+
+class _PollingWatch(_QueueWatch):
+    """Snapshot-diff poller: the watch fallback that works across processes
+    (sqlite on a shared filesystem has no push channel)."""
+
+    def __init__(self, store: KeyValueStore, space: str, interval_s: float):
+        super().__init__()
+        self._stop = threading.Event()
+        self._snapshot = dict(store.scan(space))
+
+        def run():
+            while not self._stop.wait(interval_s):
+                try:
+                    now = dict(store.scan(space))
+                except Exception:  # noqa: BLE001 — store closing
+                    break
+                for k, v in now.items():
+                    old = self._snapshot.get(k)
+                    if old is None or old != v:
+                        self._push(WatchEvent("put", space, k, v))
+                for k in self._snapshot:
+                    if k not in now:
+                        self._push(WatchEvent("del", space, k, None))
+                self._snapshot = now
+
+        self._thread = threading.Thread(target=run, name=f"kv-watch-{space}",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        super().close()
 
 
 class MemoryKv(KeyValueStore):
     def __init__(self):
         self._data: Dict[Tuple[str, str], str] = {}
         self._lock = threading.RLock()
+        self._watchers: Dict[str, List[_QueueWatch]] = {}
 
     def get(self, space, key):
         with self._lock:
@@ -119,6 +231,25 @@ class MemoryKv(KeyValueStore):
                     self._data[(space, key)] = value
                 else:
                     self._data.pop((space, key), None)
+                # deliver under the lock: queue puts never block, and
+                # delivering outside would let two racing txns enqueue their
+                # events in the opposite order of their commits (a watcher
+                # mirroring state would diverge permanently)
+                for w in self._watchers.get(space, ()):
+                    w._push(WatchEvent(
+                        "put" if op == "put" else "del", space, key, value))
+
+    def watch(self, space, poll_interval_s: float = 0.2):
+        def on_close(w):
+            with self._lock:
+                lst = self._watchers.get(space, [])
+                if w in lst:
+                    lst.remove(w)
+
+        w = _QueueWatch(on_close)
+        with self._lock:
+            self._watchers.setdefault(space, []).append(w)
+        return w
 
 
 class SqliteKv(KeyValueStore):
@@ -397,7 +528,14 @@ class KvClusterState:
             self.free_slots_many({executor_id: n})
 
     def free_slots_many(self, counts: Dict[str, int]) -> None:
-        for _ in range(16):
+        # must NOT give up: an abandoned free leaks slots forever (observed
+        # under RPC-latency contention with a bounded retry count).  Guard
+        # failures are transient by construction — some other reserver/freer
+        # committed first — so retry with jitter until it lands.
+        import random as _random
+
+        attempt = 0
+        while True:
             guards, ops = [], []
             for eid, c in counts.items():
                 cur = self.store.get(SLOTS, eid)
@@ -413,7 +551,8 @@ class KvClusterState:
                 self.store.txn(ops, guards=guards)
                 return
             except TxnGuardFailed:
-                continue
+                attempt += 1
+                time.sleep(min(0.05, 0.001 * attempt) * _random.random())
 
     def available_slots(self) -> int:
         return sum(int(v) for _, v in self.store.scan(SLOTS))

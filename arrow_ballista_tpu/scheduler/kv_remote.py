@@ -1,0 +1,260 @@
+"""Networked KV store: the etcd-role driver for multi-host HA.
+
+Parity: the reference's etcd driver gives N schedulers on DIFFERENT hosts
+one shared, transactional, watchable state store (reference
+ballista/scheduler/src/cluster/storage/etcd.rs:37-346 — namespaced keys,
+lease locks, watch streams).  The embedded drivers here (MemoryKv, SqliteKv)
+need shared memory or a shared filesystem; this module removes that
+constraint with a standalone KV service over the framework's own wire
+protocol:
+
+- :class:`KvServer` hosts any embedded ``KeyValueStore`` behind RPC,
+  assigning every mutation a monotonically increasing sequence number and
+  keeping a bounded replay log so watches survive short disconnects;
+- :class:`RemoteKv` is a full ``KeyValueStore``: get/scan/txn proxy
+  straight through (guards evaluate server-side, so CAS semantics are
+  exactly the embedded ones), and ``watch`` long-polls the replay log.
+
+Run the service with ``python -m arrow_ballista_tpu.scheduler.kv_remote
+--port 50070 [--store sqlite:///path]`` next to (or replicated behind) the
+schedulers, then point every scheduler at ``kv://host:port``.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..net import wire
+from ..net.rpc import RpcServer
+from .kv import (
+    KeyValueStore,
+    MemoryKv,
+    TxnGuardFailed,
+    Watch,
+    WatchEvent,
+    _QueueWatch,
+    open_store,
+)
+
+log = logging.getLogger(__name__)
+
+
+class KvServer:
+    """RPC front for an embedded KeyValueStore + watch replay log."""
+
+    REPLAY_CAP = 4096
+
+    def __init__(self, store: Optional[KeyValueStore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store or MemoryKv()
+        self.rpc = RpcServer(host, port)
+        self.host, self.port = self.rpc.host, self.rpc.port
+        self._seq = 0
+        self._log: "collections.deque[Tuple[int, str, str, str, Optional[str]]]" = \
+            collections.deque(maxlen=self.REPLAY_CAP)
+        self._log_lock = threading.Condition()
+        self.rpc.register("kv_get", self._get)
+        self.rpc.register("kv_scan", self._scan)
+        self.rpc.register("kv_txn", self._txn)
+        self.rpc.register("kv_poll", self._poll)
+
+    def start(self) -> None:
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self.rpc.stop()
+        with self._log_lock:
+            self._log_lock.notify_all()
+        self.store.close()
+
+    # --- handlers --------------------------------------------------------
+    def _get(self, p: dict, _b: bytes):
+        return {"value": self.store.get(p["space"], p["key"])}, b""
+
+    def _scan(self, p: dict, _b: bytes):
+        return {"items": self.store.scan(p["space"])}, b""
+
+    def _txn(self, p: dict, _b: bytes):
+        ops = [tuple(op) for op in p["ops"]]
+        guards = [tuple(g) for g in p.get("guards") or []]
+        try:
+            # single-writer section: the embedded store's txn is atomic; the
+            # log append must observe the same order
+            with self._log_lock:
+                self.store.txn(ops, guards=guards or None)
+                for op, space, key, value in ops:
+                    self._seq += 1
+                    self._log.append((self._seq, op, space, key,
+                                      value if op == "put" else None))
+                self._log_lock.notify_all()
+            return {"ok": True, "seq": self._seq}, b""
+        except TxnGuardFailed as e:
+            return {"ok": False, "guard_failed": str(e)}, b""
+
+    def _poll(self, p: dict, _b: bytes):
+        """Long-poll events after ``since`` for one keyspace."""
+        since = int(p.get("since", 0))
+        space = p["space"]
+        timeout = min(float(p.get("timeout", 10.0)), 30.0)
+        with self._log_lock:
+            if not self._log or self._log[-1][0] <= since:
+                self._log_lock.wait(timeout)
+            events = [
+                {"seq": s, "op": op, "key": k, "value": v}
+                for (s, op, sp, k, v) in self._log
+                if s > since and sp == space
+            ]
+            head = self._seq
+            oldest = self._log[0][0] if self._log else head
+        # a client whose cursor predates the replay window must resync
+        resync = since and oldest > since + 1
+        return {"events": events, "head": head, "resync": bool(resync)}, b""
+
+
+class RemoteKv(KeyValueStore):
+    """KeyValueStore client for a KvServer (the 'etcd client' analog).
+
+    Connections are persistent per thread: the scheduler's slot-reservation
+    CAS loops issue many small get/txn calls, and a fresh TCP handshake per
+    call would dominate their latency (RpcServer handlers loop on
+    recv_frame, so one socket serves many frames)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._local = threading.local()
+
+    def _call(self, method: str, payload: dict) -> dict:
+        last_err = None
+        for attempt in range(2):  # one reconnect on a stale pooled socket
+            sock = getattr(self._local, "sock", None)
+            try:
+                if sock is None:
+                    sock = wire.connect(self.host, self.port)
+                    sock.settimeout(60.0)
+                    self._local.sock = sock
+                wire.send_frame(sock, {"method": method,
+                                       "payload": payload or {}})
+                resp, _ = wire.recv_frame(sock)
+                if not resp.get("ok"):
+                    raise wire.RemoteError(resp.get("error", "remote error"),
+                                           resp.get("error_kind", ""))
+                return resp.get("payload", {})
+            except wire.RemoteError:
+                raise
+            except Exception as e:  # noqa: BLE001 — socket died; reconnect
+                last_err = e
+                try:
+                    if sock is not None:
+                        sock.close()
+                except OSError:
+                    pass
+                self._local.sock = None
+        raise last_err
+
+    def get(self, space, key):
+        return self._call("kv_get", {"space": space, "key": key})["value"]
+
+    def scan(self, space):
+        return [tuple(kv) for kv in self._call("kv_scan", {"space": space})["items"]]
+
+    def txn(self, ops, guards=None):
+        out = self._call("kv_txn", {"ops": [list(o) for o in ops],
+                                    "guards": [list(g) for g in guards]
+                                    if guards else None})
+        if not out.get("ok"):
+            raise TxnGuardFailed(out.get("guard_failed", ""))
+
+    def watch(self, space, poll_interval_s: float = 0.2) -> Watch:
+        w = _RemoteWatch(self, space)
+        return w
+
+    def close(self):
+        sock = getattr(self._local, "sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._local.sock = None
+
+
+class _RemoteWatch(_QueueWatch):
+    def __init__(self, kv: RemoteKv, space: str):
+        super().__init__()
+        self._stop = threading.Event()
+        # cursor starts at the server head so only NEW events stream
+        head = kv._call("kv_poll", {"space": space, "since": 0,
+                                    "timeout": 0.0})["head"]
+
+        def run():
+            since = head
+            while not self._stop.is_set():
+                try:
+                    out = kv._call("kv_poll", {"space": space, "since": since,
+                                               "timeout": 5.0})
+                except Exception:  # noqa: BLE001 — server away; retry
+                    if self._stop.wait(1.0):
+                        break
+                    continue
+                if out.get("resync"):
+                    # replay window lost: a 'resync' marker tells consumers
+                    # to CLEAR their mirror (deletes during the gap produce
+                    # no events), then the snapshot streams as puts
+                    self._push(WatchEvent("resync", space, "", None))
+                    for k, v in kv.scan(space):
+                        self._push(WatchEvent("put", space, k, v))
+                    since = out["head"]
+                    continue
+                for ev in out["events"]:
+                    self._push(WatchEvent(ev["op"], space, ev["key"],
+                                          ev["value"]))
+                # head covers every logged event <= it (events and head are
+                # read under one server lock), so advancing to head is safe
+                # AND required: without it, traffic in OTHER keyspaces makes
+                # the long-poll return immediately forever (busy loop)
+                since = max(since, int(out.get("head", since)))
+
+        self._thread = threading.Thread(target=run, name=f"kv-rwatch-{space}",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        super().close()
+
+
+def open_remote_or_local(url: str) -> KeyValueStore:
+    """Extended factory: 'kv://host:port' -> RemoteKv, else open_store."""
+    if url.startswith("kv://"):
+        hostport = url[len("kv://"):]
+        host, _, port = hostport.partition(":")
+        return RemoteKv(host or "127.0.0.1", int(port))
+    return open_store(url)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="standalone cluster-state KV service")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=50070)
+    ap.add_argument("--store", default="memory://",
+                    help="backing store: memory:// or sqlite:///path")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    srv = KvServer(open_store(args.store), args.host, args.port)
+    srv.start()
+    log.info("kv service on %s:%d (store %s)", srv.host, srv.port, args.store)
+    import signal
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -71,10 +71,13 @@ class SchedulerNetService:
         if cluster_url:
             # shared KV backend: job checkpoints AND slot accounting go
             # through one store so sibling schedulers cooperate (kv.py)
-            from .kv import KvClusterState, KvJobStateBackend, open_store
+            from .kv import KvClusterState, KvJobStateBackend
+            from .kv_remote import open_remote_or_local
 
             sc = scheduler_config or SchedulerConfig()
-            store = open_store(cluster_url)
+            # kv://host:port -> networked KV service (multi-host HA);
+            # memory:// / sqlite:/// -> embedded
+            store = open_remote_or_local(cluster_url)
             job_backend = KvJobStateBackend(store)
             cluster_state = KvClusterState(store, sc.task_distribution)
         elif state_dir:

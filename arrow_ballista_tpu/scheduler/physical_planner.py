@@ -196,7 +196,10 @@ class PhysicalPlanner:
         on = [(self._prep_expr(l), self._prep_expr(r)) for l, r in node.on]
         filt = self._prep_expr(node.filter) if node.filter is not None else None
 
-        if self._estimate_rows(node.right) <= self.config.get(BROADCAST_THRESHOLD):
+        if node.join_type != "full" and \
+                self._estimate_rows(node.right) <= self.config.get(BROADCAST_THRESHOLD):
+            # full joins can't broadcast: unmatched build rows would be
+            # emitted once per probe partition
             right_bc = self._to_single_partition(right)
             return O.JoinExec(left, right_bc, on, node.join_type, filt, dist="broadcast")
 
@@ -234,6 +237,8 @@ class PhysicalPlanner:
         if isinstance(node, L.Join):
             if node.join_type in ("semi", "anti"):
                 return self._estimate_rows(node.left)
+            if node.join_type == "full":
+                return self._estimate_rows(node.left) + self._estimate_rows(node.right)
             return max(self._estimate_rows(node.left), self._estimate_rows(node.right))
         if isinstance(node, L.CrossJoin):
             return self._estimate_rows(node.left) * self._estimate_rows(node.right)

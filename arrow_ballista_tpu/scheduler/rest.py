@@ -70,6 +70,13 @@ class RestApi:
     # --- routing ---------------------------------------------------------
     def _route_get(self, h) -> None:
         parts = h.path.strip("/").split("/")
+        if parts in ([""], ["ui"], ["index.html"]):
+            # the web dashboard (reference ships a React app over the same
+            # /api surface, ui/src/components/*.tsx)
+            from .webui import INDEX_HTML
+
+            h._send(200, INDEX_HTML, ctype="text/html; charset=utf-8")
+            return
         if parts[:1] != ["api"]:
             h._send(404, json.dumps({"error": "not found"}))
             return
@@ -90,6 +97,12 @@ class RestApi:
                 h._send(200, graph_to_dot(graph), ctype="text/vnd.graphviz")
         elif rest == ["metrics"]:
             h._send(200, self.server.metrics.gather(), ctype="text/plain")
+        elif rest == ["scaler"]:
+            # KEDA-scaler-shaped endpoint (reference external_scaler.rs:14-60
+            # reports inflight_tasks = pending task count); consumed by a
+            # metrics-api trigger (deploy/helm templates/hpa.yaml)
+            h._send(200, json.dumps(
+                {"inflight_tasks": self.server.pending_task_count()}))
         else:
             h._send(404, json.dumps({"error": "not found"}))
 

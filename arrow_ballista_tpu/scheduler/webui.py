@@ -1,0 +1,120 @@
+"""Scheduler web UI: one static page over the REST API.
+
+Parity: the reference ships a React app (Summary, ExecutorsList,
+QueriesList with progress bars, JobStagesMetrics — reference
+ballista/ui/src/components/*.tsx) talking to the same /api endpoints.
+This is the dependency-free rendition: vanilla JS polling /api/state,
+/api/executors, /api/jobs and /api/job/<id>/stages, served by RestApi at
+``/`` — no build step, works from any daemon.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>Ballista-TPU Scheduler</title>
+<style>
+  :root { color-scheme: light; }
+  body { font: 14px/1.45 system-ui, sans-serif; margin: 0; background:#f5f6f8; color:#1b1f24; }
+  header { background:#1b3a5c; color:#fff; padding:14px 22px; display:flex; align-items:baseline; gap:14px; }
+  header h1 { font-size:17px; margin:0; font-weight:600; }
+  header .sub { opacity:.75; font-size:12px; }
+  main { max-width:1060px; margin:18px auto; padding:0 16px; }
+  .cards { display:flex; gap:12px; flex-wrap:wrap; margin-bottom:18px; }
+  .card { background:#fff; border:1px solid #dde1e6; border-radius:8px; padding:12px 18px; min-width:130px; }
+  .card .v { font-size:24px; font-weight:650; }
+  .card .k { color:#57606a; font-size:12px; }
+  section { background:#fff; border:1px solid #dde1e6; border-radius:8px; margin-bottom:18px; overflow:hidden; }
+  section h2 { font-size:13px; letter-spacing:.04em; text-transform:uppercase; color:#57606a;
+               margin:0; padding:10px 16px; border-bottom:1px solid #eceff2; }
+  table { width:100%; border-collapse:collapse; }
+  th, td { text-align:left; padding:7px 16px; border-bottom:1px solid #f0f2f4; font-size:13px; }
+  th { color:#57606a; font-weight:600; background:#fafbfc; }
+  tr:last-child td { border-bottom:none; }
+  .bar { background:#e8ebee; border-radius:4px; height:8px; width:140px; display:inline-block; vertical-align:middle; }
+  .bar i { display:block; height:100%; border-radius:4px; background:#2da44e; }
+  .state { padding:1px 8px; border-radius:10px; font-size:12px; }
+  .state.running { background:#dbeafe; color:#1d4ed8; }
+  .state.successful { background:#dcfce7; color:#15803d; }
+  .state.failed { background:#fee2e2; color:#b91c1c; }
+  .state.cancelled { background:#f3f4f6; color:#4b5563; }
+  .state.active { background:#dcfce7; color:#15803d; }
+  .state.terminating, .state.unknown { background:#fef3c7; color:#92400e; }
+  tr.job { cursor:pointer; }
+  pre { margin:4px 0 10px; padding:8px 12px; background:#f6f8fa; border-radius:6px;
+        font-size:11px; overflow-x:auto; }
+  td.stages-cell { background:#fbfcfd; }
+  .err { color:#b91c1c; font-size:12px; }
+</style>
+</head>
+<body>
+<header><h1>Ballista-TPU Scheduler</h1><span class="sub" id="refreshed"></span></header>
+<main>
+  <div class="cards" id="cards"></div>
+  <section><h2>Executors</h2>
+    <table><thead><tr><th>ID</th><th>Host</th><th>Data port</th><th>Slots</th>
+      <th>Status</th><th>Last seen</th></tr></thead><tbody id="executors"></tbody></table>
+  </section>
+  <section><h2>Jobs</h2>
+    <table><thead><tr><th>Job</th><th>State</th><th>Stages</th><th>Progress</th>
+      <th>Tasks</th><th>Error</th></tr></thead><tbody id="jobs"></tbody></table>
+  </section>
+</main>
+<script>
+const open_ = new Set();
+async function j(url) { const r = await fetch(url); return r.json(); }
+function esc(s) { return String(s ?? "").replace(/[&<>]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c])); }
+async function refresh() {
+  try {
+    const [state, execs, jobs] = await Promise.all([
+      j("/api/state"), j("/api/executors"), j("/api/jobs")]);
+    document.getElementById("cards").innerHTML = [
+      ["Executors alive", state.alive_executors + " / " + state.executors],
+      ["Free task slots", state.available_task_slots],
+      ["Pending tasks", state.pending_tasks],
+      ["Jobs", jobs.length],
+    ].map(([k, v]) => `<div class="card"><div class="v">${esc(v)}</div>` +
+                      `<div class="k">${esc(k)}</div></div>`).join("");
+    document.getElementById("executors").innerHTML = execs.map(e =>
+      `<tr><td>${esc(e.executor_id)}</td><td>${esc(e.host)}</td>` +
+      `<td>${esc(e.port)}</td><td>${esc(e.task_slots)}</td>` +
+      `<td><span class="state ${esc(e.status)}">${esc(e.status)}</span></td>` +
+      `<td>${e.last_seen_s_ago == null ? "-" : esc(e.last_seen_s_ago) + "s ago"}</td></tr>`
+    ).join("") || `<tr><td colspan="6">none registered</td></tr>`;
+    const rows = [];
+    for (const job of jobs) {
+      const total = job.tasks_total || 0, done = job.tasks_completed || 0;
+      const pct = total ? Math.round(100 * done / total) : 0;
+      rows.push(
+        `<tr class="job" onclick="toggle('${esc(job.job_id)}')">` +
+        `<td>${esc(job.job_id)}</td>` +
+        `<td><span class="state ${esc(job.state)}">${esc(job.state)}</span></td>` +
+        `<td>${esc(job.stages ?? "-")}</td>` +
+        `<td><span class="bar"><i style="width:${pct}%"></i></span> ${pct}%</td>` +
+        `<td>${done} / ${total}</td>` +
+        `<td class="err">${esc(job.error || "")}</td></tr>`);
+      if (open_.has(job.job_id)) {
+        const stages = await j(`/api/job/${job.job_id}/stages`);
+        rows.push(`<tr><td colspan="6" class="stages-cell">` + stages.map(s =>
+          `<div><b>stage ${s.stage_id}</b> ` +
+          `<span class="state ${esc(s.state)}">${esc(s.state)}</span> ` +
+          `${s.completed}/${s.partitions} tasks, attempt ${s.attempt}` +
+          `<pre>${esc(s.plan)}</pre></div>`).join("") + `</td></tr>`);
+      }
+    }
+    document.getElementById("jobs").innerHTML =
+      rows.join("") || `<tr><td colspan="6">no jobs yet</td></tr>`;
+    document.getElementById("refreshed").textContent =
+      "refreshed " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("refreshed").textContent = "refresh failed: " + e;
+  }
+}
+function toggle(id) { open_.has(id) ? open_.delete(id) : open_.add(id); refresh(); }
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -86,6 +86,9 @@ def expr_to_obj(e: Optional[E.Expr]):
     if isinstance(e, E.Substring):
         return {"t": "substr", "o": expr_to_obj(e.operand), "start": e.start,
                 "len": e.length}
+    if isinstance(e, E.Udf):
+        return {"t": "udf", "name": e.name,
+                "args": [expr_to_obj(a) for a in e.args]}
     if isinstance(e, E.Agg):
         return {"t": "agg", "f": e.func, "o": expr_to_obj(e.operand),
                 "distinct": e.distinct}
@@ -128,6 +131,8 @@ def expr_from_obj(o) -> Optional[E.Expr]:
         return E.Extract(o["f"], expr_from_obj(o["o"]))
     if t == "substr":
         return E.Substring(expr_from_obj(o["o"]), o["start"], o["len"])
+    if t == "udf":
+        return E.Udf(o["name"], tuple(expr_from_obj(a) for a in o["args"]))
     if t == "agg":
         return E.Agg(o["f"], expr_from_obj(o["o"]), o.get("distinct", False))
     if t == "scalarref":

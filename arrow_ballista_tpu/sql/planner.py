@@ -92,6 +92,8 @@ def _map_children(e: E.Expr, f) -> E.Expr:
         return E.Substring(f(e.operand), e.start, e.length)
     if isinstance(e, E.Agg):
         return E.Agg(e.func, None if e.operand is None else f(e.operand), e.distinct)
+    if isinstance(e, E.Udf):
+        return E.Udf(e.name, tuple(f(a) for a in e.args))
     return e
 
 
@@ -320,12 +322,19 @@ class SqlToRel:
                         on_pairs.append(pair)
                     else:
                         residual.append(c)
-                jt = rel.kind if rel.kind in ("inner", "left") else None
-                if jt is None:
+                if rel.kind not in ("inner", "left", "right", "full"):
                     raise PlanningError(f"unsupported join type {rel.kind}")
                 if not on_pairs:
                     raise PlanningError(f"non-equi {rel.kind} join not supported: {rel.condition}")
-                joined = L.Join(lplan, rplan, on_pairs, jt, E.and_all(residual))
+                if rel.kind == "right":
+                    # A RIGHT JOIN B == B LEFT JOIN A (column resolution is
+                    # by qualified name, so output order is unaffected)
+                    joined = L.Join(rplan, lplan,
+                                    [(r, l) for l, r in on_pairs], "left",
+                                    E.and_all(residual))
+                else:
+                    joined = L.Join(lplan, rplan, on_pairs, rel.kind,
+                                    E.and_all(residual))
             alias = self._fresh("join")
             merged = Relation(alias, joined)
             # the joined relation keeps original qualified names; expose the
@@ -590,6 +599,16 @@ class SqlToRel:
                 if len(node.args) != 1:
                     raise PlanningError(f"{node.name} takes one argument")
                 return E.Agg(node.name, self.resolve_expr(node.args[0], scope), node.distinct)
+            from ..udf import GLOBAL_UDFS
+
+            udf = GLOBAL_UDFS.get(node.name)
+            if udf is not None:
+                if udf.arg_count is not None and len(node.args) != udf.arg_count:
+                    raise PlanningError(
+                        f"{node.name} takes {udf.arg_count} argument(s), "
+                        f"got {len(node.args)}")
+                return E.Udf(node.name.lower(),
+                             tuple(self.resolve_expr(a, scope) for a in node.args))
             raise PlanningError(f"unsupported function {node.name}")
         if isinstance(node, ast.Case):
             whens = []

@@ -1,0 +1,121 @@
+"""Scalar UDF plugin system: user functions feeding the expression compiler.
+
+Parity: the reference loads UDF plugins from shared objects at startup and
+registers them into every session's function registry
+(reference ballista/core/src/plugin/mod.rs + plugin/udf.rs + the
+`plugin_dir` config key).  The Python-native analog:
+
+- ``register_udf`` puts a :class:`ScalarUdf` in the process-global registry
+  (the analog of ``GlobalPluginManager``);
+- ``load_plugin_dir(path)`` imports every ``*.py`` file in a directory —
+  plugin modules call ``register_udf`` at import time, exactly like the
+  reference's ``dlopen`` + ``declare_plugin!`` handshake;
+- entry-point discovery (``arrow_ballista_tpu.udfs`` group) covers
+  pip-installed plugin packages.
+
+UDFs evaluate on device: ``fn`` receives one jnp (or numpy, host mode)
+array per argument and must return an array of the declared return dtype —
+a pure elementwise/vectorized function, which is what XLA can fuse into the
+surrounding stage program.  Both scheduler and executors resolve UDFs by
+NAME from their local registry, so plugin code must be installed on every
+node (true in the reference too — every node loads the same plugin dir).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from .models.schema import DataType
+from .utils.errors import PlanningError
+
+log = logging.getLogger(__name__)
+
+ReturnType = Union[DataType, Callable[[Sequence[DataType]], DataType]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarUdf:
+    name: str
+    fn: Callable  # (*arrays) -> array, vectorized & jit-traceable
+    return_type: ReturnType
+    arg_count: Optional[int] = None  # None = variadic
+    doc: str = ""
+
+    def result_dtype(self, arg_dtypes: Sequence[DataType]) -> DataType:
+        if callable(self.return_type):
+            return self.return_type(arg_dtypes)
+        return self.return_type
+
+
+class UdfRegistry:
+    def __init__(self):
+        self._udfs: Dict[str, ScalarUdf] = {}
+
+    def register(self, udf: ScalarUdf) -> None:
+        key = udf.name.lower()
+        if key in self._udfs:
+            log.info("replacing UDF %s", key)
+        self._udfs[key] = udf
+
+    def get(self, name: str) -> Optional[ScalarUdf]:
+        return self._udfs.get(name.lower())
+
+    def names(self) -> List[str]:
+        return sorted(self._udfs)
+
+    def deregister(self, name: str) -> None:
+        self._udfs.pop(name.lower(), None)
+
+
+# process-global registry (reference GlobalPluginManager singleton)
+GLOBAL_UDFS = UdfRegistry()
+
+
+def register_udf(name: str, fn: Callable, return_type: ReturnType,
+                 arg_count: Optional[int] = None, doc: str = "") -> ScalarUdf:
+    udf = ScalarUdf(name, fn, return_type, arg_count, doc)
+    GLOBAL_UDFS.register(udf)
+    return udf
+
+
+def load_plugin_dir(path: str) -> List[str]:
+    """Import every ``*.py`` in ``path``; modules register UDFs at import
+    (reference plugin_manager walking plugin_dir for .so files).  Returns
+    the module names loaded."""
+    import importlib.util
+
+    loaded = []
+    if not os.path.isdir(path):
+        raise PlanningError(f"plugin dir not found: {path}")
+    for fname in sorted(os.listdir(path)):
+        if not fname.endswith(".py") or fname.startswith("_"):
+            continue
+        mod_name = f"ballista_udf_plugin_{fname[:-3]}"
+        spec = importlib.util.spec_from_file_location(
+            mod_name, os.path.join(path, fname))
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        loaded.append(mod_name)
+        log.info("loaded UDF plugin %s", fname)
+    return loaded
+
+
+def load_entry_points() -> List[str]:
+    """Discover pip-installed plugins via the ``arrow_ballista_tpu.udfs``
+    entry-point group (each entry point is a callable invoked with the
+    global registry)."""
+    loaded = []
+    try:
+        from importlib.metadata import entry_points
+
+        for ep in entry_points(group="arrow_ballista_tpu.udfs"):
+            try:
+                ep.load()(GLOBAL_UDFS)
+                loaded.append(ep.name)
+            except Exception:  # noqa: BLE001 — a bad plugin must not kill boot
+                log.exception("UDF entry point %s failed", ep.name)
+    except Exception:  # noqa: BLE001 — metadata API unavailable
+        pass
+    return loaded

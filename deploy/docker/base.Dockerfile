@@ -1,0 +1,16 @@
+# Shared base image: python + jax (TPU wheels picked at build time) + the
+# framework package.  Role parity: reference dev/docker/ballista-builder +
+# per-binary Dockerfiles (dev/docker/*.Dockerfile).
+FROM python:3.12-slim
+
+ARG JAX_EXTRA=tpu
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make netcat-openbsd && rm -rf /var/lib/apt/lists/*
+RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" pyarrow pandas fsspec
+
+WORKDIR /opt/ballista-tpu
+COPY arrow_ballista_tpu ./arrow_ballista_tpu
+COPY benchmarks ./benchmarks
+COPY native ./native
+RUN make -C native 2>/dev/null || true  # native data plane is optional
+ENV PYTHONPATH=/opt/ballista-tpu

@@ -1,0 +1,9 @@
+# Executor daemon (reference dev/docker/ballista-executor.Dockerfile).
+# Executors bind the TPU: run with the TPU runtime mounted / device plugin
+# (e.g. GKE TPU node pools) or JAX_PLATFORMS=cpu for CPU-only pools.
+FROM ballista-tpu-base
+
+EXPOSE 50052
+ENTRYPOINT ["python", "-m", "arrow_ballista_tpu.executor_daemon"]
+CMD ["--bind-host", "0.0.0.0", "--bind-port", "50052", \
+     "--scheduler-host", "ballista-scheduler"]

@@ -1,0 +1,9 @@
+# Scheduler daemon (reference dev/docker/ballista-scheduler.Dockerfile).
+# Build from the repo root:
+#   docker build -f deploy/docker/base.Dockerfile -t ballista-tpu-base .
+#   docker build -f deploy/docker/scheduler.Dockerfile -t ballista-tpu-scheduler .
+FROM ballista-tpu-base
+
+EXPOSE 50050 50051
+ENTRYPOINT ["python", "-m", "arrow_ballista_tpu.scheduler_daemon"]
+CMD ["--bind-host", "0.0.0.0", "--bind-port", "50050", "--rest-port", "50051"]

@@ -23,6 +23,7 @@
 #include <arpa/inet.h>
 #include <atomic>
 #include <cerrno>
+#include <chrono>
 #include <climits>
 #include <condition_variable>
 #include <cstdint>
@@ -229,10 +230,13 @@ void serve_conn(int fd) {
   }
   close(fd);
   {
+    // notify INSIDE the critical section: dp_stop destroys the process
+    // right after observing g_active_conns==0, and a notify issued after
+    // releasing the lock can race pthread_cond_destroy (TSAN-verified)
     std::lock_guard<std::mutex> lk(g_conn_mu);
     --g_active_conns;
+    g_conn_cv.notify_one();
   }
-  g_conn_cv.notify_one();
 }
 
 void accept_loop(int listen_fd) {
@@ -253,8 +257,8 @@ void accept_loop(int listen_fd) {
       {
         std::lock_guard<std::mutex> lk(g_conn_mu);
         --g_active_conns;
+        g_conn_cv.notify_one();
       }
-      g_conn_cv.notify_one();
       if (!g_running.load()) break;
       continue;
     }
@@ -316,6 +320,15 @@ void dp_stop() {
     close(fd);
   }
   if (g_accept_thread.joinable()) g_accept_thread.join();
+  // drain in-flight connection threads: they are detached, and a thread
+  // still signalling g_conn_cv after static destructors tore it down is a
+  // use-after-destroy at process exit (found by the TSAN build).  Bounded
+  // wait — sockets are short-lived and the listener is already closed.
+  {
+    std::unique_lock<std::mutex> lk(g_conn_mu);
+    g_conn_cv.wait_for(lk, std::chrono::seconds(10),
+                       [] { return g_active_conns == 0; });
+  }
 }
 
 uint64_t dp_bytes_served() { return g_bytes_served.load(); }

@@ -29,14 +29,26 @@ from .test_persistence import half_run_graph
 from .test_scheduler import VirtualTaskLauncher
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "remote"])
 def store(request, tmp_path):
     if request.param == "memory":
         s = MemoryKv()
-    else:
+        yield s
+        s.close()
+    elif request.param == "sqlite":
         s = SqliteKv(str(tmp_path / "state.db"))
-    yield s
-    s.close()
+        yield s
+        s.close()
+    else:
+        # networked driver (the etcd-role service): full conformance over RPC
+        from arrow_ballista_tpu.scheduler.kv_remote import KvServer, RemoteKv
+
+        srv = KvServer()
+        srv.start()
+        s = RemoteKv(srv.host, srv.port)
+        yield s
+        s.close()
+        srv.stop()
 
 
 # --------------------------------------------------------------------------
@@ -210,3 +222,143 @@ def test_two_scheduler_takeover_sqlite(tmp_path):
         server.shutdown()
         store_a.close()
         store_b.close()
+
+
+# --------------------------------------------------------------------------
+# watch streams (reference KeyValueStore::watch, storage/mod.rs:30-147)
+# --------------------------------------------------------------------------
+
+
+def _await_event(w, pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ev = w.get(timeout=0.5)
+        if ev is not None and pred(ev):
+            return ev
+    return None
+
+
+def test_watch_sees_puts_and_deletes(store):
+    # mutations are spaced by event arrival: polling drivers (sqlite)
+    # legitimately coalesce a rapid put+delete of the same key
+    w = store.watch("ws", poll_interval_s=0.05)
+    try:
+        store.put("ws", "a", "1")
+        ev = _await_event(w, lambda e: e.op == "put" and e.key == "a")
+        assert ev is not None and ev.value == "1"
+        store.put("ws", "b", "2")
+        ev = _await_event(w, lambda e: e.op == "put" and e.key == "b")
+        assert ev is not None and ev.value == "2"
+        store.delete("ws", "a")
+        assert _await_event(w, lambda e: e.op == "del" and e.key == "a") is not None
+    finally:
+        w.close()
+
+
+def test_watch_is_scoped_to_keyspace(store):
+    w = store.watch("only_this", poll_interval_s=0.05)
+    try:
+        store.put("other_space", "x", "1")
+        store.put("only_this", "y", "2")
+        deadline = time.time() + 5.0
+        got = []
+        while time.time() < deadline and not got:
+            ev = w.get(timeout=0.5)
+            if ev is not None:
+                got.append(ev)
+        assert got and got[0].key == "y"
+        assert all(ev.space == "only_this" for ev in got)
+    finally:
+        w.close()
+
+
+def test_remote_kv_two_clients_share_state_and_watch():
+    """Two RemoteKv clients (two 'schedulers on different hosts') against
+    one KV service: CAS atomicity + cross-client watch delivery."""
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer, RemoteKv
+
+    srv = KvServer()
+    srv.start()
+    try:
+        c1 = RemoteKv(srv.host, srv.port)
+        c2 = RemoteKv(srv.host, srv.port)
+        w = c2.watch("jobs")
+        c1.put("jobs", "j1", "running")
+        ev = w.get(timeout=5.0)
+        assert ev is not None and ev.key == "j1" and ev.value == "running"
+        # CAS conflict: c2's guard must observe c1's write
+        with pytest.raises(TxnGuardFailed):
+            c2.txn([("put", "jobs", "j1", "stolen")],
+                   guards=[("jobs", "j1", None)])
+        c2.txn([("put", "jobs", "j1", "done")],
+               guards=[("jobs", "j1", "running")])
+        assert c1.get("jobs", "j1") == "done"
+        w.close()
+    finally:
+        srv.stop()
+
+
+def test_remote_kv_backs_full_cluster_state():
+    """KvClusterState + KvJobStateBackend run unmodified over the
+    networked driver — the multi-host HA configuration."""
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer, RemoteKv
+
+    srv = KvServer()
+    srv.start()
+    try:
+        kv = RemoteKv(srv.host, srv.port)
+        cs = KvClusterState(kv)
+        cs.register_executor(ExecutorMetadata("e1", task_slots=2))
+        res = cs.reserve_slots(3)
+        assert len(res) == 2
+        cs.free_slots("e1", 2)
+        assert cs.available_slots() == 2
+
+        jb = KvJobStateBackend(kv)
+        assert jb.try_acquire_job("job1", "sched-a")
+        assert not jb.try_acquire_job("job1", "sched-b")
+    finally:
+        srv.stop()
+
+
+def test_scheduler_netservice_with_kv_url():
+    """--cluster-backend kv://host:port connects the scheduler to the KV
+    service (the HA deploy shape in deploy/docker-compose.yml)."""
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer, RemoteKv
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    srv = KvServer()
+    srv.start()
+    sched = None
+    try:
+        sched = SchedulerNetService("127.0.0.1", 0, rest_port=0,
+                                    cluster_url=f"kv://{srv.host}:{srv.port}")
+        sched.start()
+        from arrow_ballista_tpu.scheduler.types import ExecutorMetadata
+
+        sched.server.register_executor(ExecutorMetadata("kv-e1", task_slots=3))
+        # the registration must be visible THROUGH the shared KV service
+        peek = RemoteKv(srv.host, srv.port)
+        assert peek.get("executors", "kv-e1") is not None
+        assert peek.get("slots", "kv-e1") == "3"
+    finally:
+        if sched is not None:
+            sched.stop()
+        srv.stop()
+
+
+def test_watch_close_wakes_blocked_iterator(store):
+    done = []
+
+    def consume(w):
+        for _ in w:
+            pass
+        done.append(True)
+
+    w = store.watch("idle_space", poll_interval_s=0.05)
+    t = threading.Thread(target=consume, args=(w,))
+    t.start()
+    time.sleep(0.2)
+    w.close()
+    t.join(timeout=5.0)
+    assert done, "blocked watch iterator did not terminate on close()"

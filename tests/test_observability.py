@@ -84,3 +84,22 @@ def test_rest_cancel_patch(stack):
     with urllib.request.urlopen(req, timeout=10) as r:
         body = json.loads(r.read().decode())
     assert body["cancelled"] == "nonexistent"
+
+
+def test_web_ui_served(stack):
+    """The dashboard page is served at / and references the API it polls
+    (reference: React UI over the same /api endpoints, ui/src/*)."""
+    sched, ex, ctx = stack
+    html = _get(sched, "/", as_json=False)
+    assert "<!doctype html>" in html.lower()
+    for marker in ("/api/state", "/api/executors", "/api/jobs",
+                   "Ballista-TPU Scheduler"):
+        assert marker in html
+    assert _get(sched, "/ui", as_json=False) == html
+
+
+def test_keda_scaler_endpoint(stack):
+    """KEDA external-scaler shape (reference external_scaler.rs:14-60)."""
+    sched, ex, ctx = stack
+    out = _get(sched, "/api/scaler")
+    assert "inflight_tasks" in out and isinstance(out["inflight_tasks"], int)

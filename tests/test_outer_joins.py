@@ -1,0 +1,86 @@
+"""RIGHT and FULL OUTER joins (reference gets the full set from DataFusion;
+SURVEY §1 ENGINE layer).  Oracle: pandas merge on the same data."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+
+@pytest.fixture(scope="module")
+def tables():
+    rng = np.random.default_rng(17)
+    n_l, n_r = 3_000, 800
+    left = pa.table({
+        "lk": pa.array(rng.integers(0, 1000, n_l).astype(np.int64)),
+        "lv": pa.array(rng.integers(0, 100, n_l).astype(np.int64)),
+    })
+    right = pa.table({
+        "rk": pa.array(rng.integers(500, 1500, n_r).astype(np.int64)),
+        "rv": pa.array(rng.integers(0, 100, n_r).astype(np.int64)),
+    })
+    return left, right
+
+
+def _norm(df):
+    cols = list(df.columns)
+    out = df.copy()
+    for c in cols:
+        out[c] = out[c].astype(np.float64)
+    return out.sort_values(cols, kind="mergesort").reset_index(drop=True)
+
+
+def _run(tables, sql, how, config=None):
+    left, right = tables
+    ctx = BallistaContext.local(config) if config is None \
+        else BallistaContext.standalone(config, concurrent_tasks=2)
+    try:
+        ctx.register_table("l", left)
+        ctx.register_table("r", right)
+        got = ctx.sql(sql).to_pandas()
+    finally:
+        ctx.shutdown()
+    want = left.to_pandas().merge(right.to_pandas(), left_on="lk",
+                                  right_on="rk", how=how)
+    pd.testing.assert_frame_equal(_norm(got), _norm(want[list(got.columns)]),
+                                  check_dtype=False, atol=1e-9)
+    return got
+
+
+SQL = "SELECT lk, lv, rk, rv FROM l {} JOIN r ON lk = rk"
+
+
+def test_right_join_matches_pandas(tables):
+    _run(tables, SQL.format("RIGHT"), "right")
+
+
+def test_right_outer_keyword(tables):
+    _run(tables, SQL.format("RIGHT OUTER"), "right")
+
+
+def test_full_join_matches_pandas(tables):
+    got = _run(tables, SQL.format("FULL"), "outer")
+    # both sides must show NULL holes
+    assert got["lk"].isna().any() and got["rk"].isna().any()
+
+
+def test_full_join_through_standalone(tables):
+    cfg = BallistaConfig({"ballista.shuffle.partitions": "4",
+                          "ballista.join.broadcast_threshold": "1"})
+    _run(tables, SQL.format("FULL OUTER"), "outer", config=cfg)
+
+
+def test_right_join_counts(tables):
+    left, right = tables
+    ctx = BallistaContext.local()
+    try:
+        ctx.register_table("l", left)
+        ctx.register_table("r", right)
+        got = ctx.sql("SELECT COUNT(*) AS c FROM l RIGHT JOIN r ON lk = rk").to_pandas()
+    finally:
+        ctx.shutdown()
+    want = len(left.to_pandas().merge(right.to_pandas(), left_on="lk",
+                                      right_on="rk", how="right"))
+    assert got["c"].tolist() == [want]

@@ -1,0 +1,20 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, pyarrow as pa
+from arrow_ballista_tpu.executor.server import ExecutorServer
+from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+from arrow_ballista_tpu.client.context import BallistaContext
+
+sched = SchedulerNetService("127.0.0.1", 0, rest_port=47777)
+sched.start()
+ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                    work_dir="/tmp/ui-stack-work", executor_id="ui-exec-1")
+ex.start()
+ctx = BallistaContext.remote("127.0.0.1", sched.port)
+ctx.register_table("t", pa.table({
+    "g": pa.array(np.arange(5000) % 9, type=pa.int64()),
+    "v": pa.array(np.arange(5000), type=pa.int64()),
+}))
+out = ctx.sql("select g, sum(v) as s from t group by g order by g").to_pandas()
+print("query ok:", len(out), "rows; UI at http://127.0.0.1:47777/", flush=True)
+time.sleep(600)
