@@ -45,6 +45,84 @@ def _unshard(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.asarray(np.asarray(x))
 
 
+# --- shared pieces of the two mesh aggregate operators ---------------------
+
+
+def _compile_agg_exprs(in_schema, group_exprs, aggs):
+    comp = ExprCompiler(in_schema, "device")
+    key_c = [(comp.compile(e), n) for e, n in group_exprs]
+    val_c = [(comp.compile(a.operand) if a.operand is not None else None, a)
+             for a in aggs]
+    return comp, key_c, val_c
+
+
+def _make_derive(key_c, val_c, aux):
+    """Per-shard projection: group keys + aggregate operand columns
+    (count aggregates count live rows via a ones column)."""
+
+    def derive(cols, mask):
+        out = {}
+        for kc, n in key_c:
+            out[n] = kc.fn(cols, aux)
+        for cc, a in val_c:
+            if cc is None or a.func == "count":
+                out[a.name] = jnp.ones(mask.shape, jnp.int64)
+            else:
+                v = cc.fn(cols, aux)
+                out[a.name] = (jnp.broadcast_to(v, mask.shape)
+                               if v.ndim == 0 else v)
+        return out, mask
+
+    return derive
+
+
+def _shard_batch(big: ColumnBatch, mesh, n_dev: int):
+    """Rows data-parallel over the mesh, padded to a device-count multiple.
+    Returns (cols, mask, padded_rows)."""
+    from ..parallel.mesh import row_sharding
+
+    rows = big.capacity
+    per = -(-rows // n_dev)
+    padded = per * n_dev
+    sharding = row_sharding(mesh)
+
+    def shard(arr, fill=0):
+        if padded != rows:
+            pad = jnp.full((padded - rows,), fill, arr.dtype)
+            arr = jnp.concatenate([arr, pad])
+        return jax.device_put(arr, sharding)
+
+    return ({k: shard(v) for k, v in big.columns.items()},
+            shard(big.mask, fill=False), padded)
+
+
+def _agg_key_ranges(key_c, dicts):
+    """Static per-key bounds for the dense sort-free grouping path
+    (kernels.grouped_aggregate): dict-code ranges for strings, {0,1} for
+    bools, None otherwise."""
+    return tuple(
+        (-1, int(len(kc.dict_fn(dicts))) - 1)
+        if kc.dtype.is_string and kc.dict_fn is not None
+        else ((0, 1) if kc.dtype.kind == "bool" else None)
+        for kc, _n in key_c)
+
+
+def _finish_states(schema, key_c, val_c, ks, vs, msk, big_dicts):
+    """Unshard fused-program outputs into one ordinary ColumnBatch, casting
+    values to the operator's declared schema dtypes."""
+    out_cols: Dict[str, jnp.ndarray] = {}
+    dicts: Dict[str, np.ndarray] = {}
+    for (kc, name), arr in zip(key_c, ks):
+        out_cols[name] = _unshard(arr)
+        if kc.dict_fn is not None:
+            dicts[name] = kc.dict_fn(big_dicts)
+    for (cc, a), arr in zip(val_c, vs):
+        want = schema.field(a.name).dtype.np_dtype
+        arr = _unshard(arr)
+        out_cols[a.name] = arr.astype(want) if arr.dtype != want else arr
+    return ColumnBatch(schema, out_cols, _unshard(msk), dicts)
+
+
 class MeshAggregateExec(ExecutionPlan):
     """Fused grouped aggregation over every local device.
 
@@ -115,47 +193,16 @@ class MeshAggregateExec(ExecutionPlan):
         mesh = make_mesh(n_dev)
 
         if self._compiled is None:
-            comp = ExprCompiler(in_schema, "device")
-            key_c = [(comp.compile(e), n) for e, n in self.group_exprs]
-            val_c = []
-            for a in self.aggs:
-                cc = comp.compile(a.operand) if a.operand is not None else None
-                val_c.append((cc, a))
-            self._compiled = (comp, key_c, val_c)
+            self._compiled = _compile_agg_exprs(in_schema, self.group_exprs,
+                                                self.aggs)
         comp, key_c, val_c = self._compiled
         aux = comp.aux_arrays(big.dicts)  # replicated constants in the program
 
         key_names = [n for _, n in key_c]
-        agg_specs = []
-        for cc, a in val_c:
-            agg_specs.append((a.name, "count" if a.func == "count" else a.func))
-
-        def derive(cols, mask):
-            out = {}
-            for kc, n in key_c:
-                out[n] = kc.fn(cols, aux)
-            for cc, a in val_c:
-                if cc is None or a.func == "count":
-                    out[a.name] = jnp.ones(mask.shape, jnp.int64)
-                else:
-                    v = cc.fn(cols, aux)
-                    out[a.name] = jnp.broadcast_to(v, mask.shape) if v.ndim == 0 else v
-            return out, mask
-
-        # shard rows over the mesh (pad to a multiple of the device count)
-        rows = big.capacity
-        per = -(-rows // n_dev)
-        padded = per * n_dev
-        sharding = row_sharding(mesh)
-
-        def shard(arr, fill=0):
-            if padded != rows:
-                pad = jnp.full((padded - rows,), fill, arr.dtype)
-                arr = jnp.concatenate([arr, pad])
-            return jax.device_put(arr, sharding)
-
-        cols = {k: shard(v) for k, v in big.columns.items()}
-        mask = shard(big.mask, fill=False)
+        agg_specs = [(a.name, "count" if a.func == "count" else a.func)
+                     for _, a in val_c]
+        derive = _make_derive(key_c, val_c, aux)
+        cols, mask, padded = _shard_batch(big, mesh, n_dev)
 
         cap = ctx.config.get(AGG_CAPACITY)
         # partial states are bounded by the shard size; the final aggregate
@@ -163,13 +210,7 @@ class MeshAggregateExec(ExecutionPlan):
         # bound must respond to the config knob
         partial_cap = max(256, min(cap, padded // n_dev + 1))
         final_cap = max(256, min(cap, padded + 1))
-        # static dict-code ranges select the dense sort-free grouping path
-        # inside the fused program (kernels.grouped_aggregate)
-        key_ranges = tuple(
-            (-1, int(len(kc.dict_fn(big.dicts))) - 1)
-            if kc.dtype.is_string and kc.dict_fn is not None
-            else ((0, 1) if kc.dtype.kind == "bool" else None)
-            for kc, _n in key_c)
+        key_ranges = _agg_key_ranges(key_c, big.dicts)
         from .kernels import dense_domain
 
         domain = dense_domain(key_ranges)
@@ -188,17 +229,8 @@ class MeshAggregateExec(ExecutionPlan):
                 f"(partial {partial_cap}/device, final {final_cap}/device); "
                 f"raise {AGG_CAPACITY}")
 
-        out_cols: Dict[str, jnp.ndarray] = {}
-        dicts: Dict[str, np.ndarray] = {}
-        for (kc, name), arr in zip(key_c, fk):
-            out_cols[name] = _unshard(arr)
-            if kc.dict_fn is not None:
-                dicts[name] = kc.dict_fn(big.dicts)
-        for (cc, a), arr in zip(val_c, fv):
-            want = self._schema.field(a.name).dtype.np_dtype
-            arr = _unshard(arr)
-            out_cols[a.name] = arr.astype(want) if arr.dtype != want else arr
-        result = ColumnBatch(self._schema, out_cols, _unshard(fmask), dicts)
+        result = _finish_states(self._schema, key_c, val_c, fk, fv, fmask,
+                                big.dicts)
         self.metrics().add("output_rows", result.num_rows)
         self.metrics().add("mesh_devices", n_dev)
         return [result]
@@ -207,6 +239,106 @@ class MeshAggregateExec(ExecutionPlan):
         g = ", ".join(n for _, n in self.group_exprs)
         a = ", ".join(f"{x.func}({x.name})" for x in self.aggs)
         return f"MeshAggregateExec(fused partial+all_to_all+final): groupBy=[{g}] aggr=[{a}]"
+
+
+class MeshPartialAggregateExec(ExecutionPlan):
+    """HYBRID mesh composition: the partial aggregate of a file-shuffled
+    stage pair, fused over the executing host's LOCAL device mesh.
+
+    Where MeshAggregateExec fuses the whole exchange in-process (one task,
+    one host), this operator keeps the reference's stage structure — one
+    task per input partition, file shuffle between stages — and uses the
+    mesh only WITHIN each task: rows shard across the host's chips, every
+    chip reduces its shard to group states, and the states ship through the
+    ordinary shuffle to the final aggregate.  On a multi-host cluster this
+    is "ICI within a host, Flight/file across hosts"
+    (BASELINE.json.north_star; SURVEY §2.5 comm-backend row).
+
+    Output schema/dtypes mirror HashAggregateExec(mode='partial') exactly,
+    so the downstream RepartitionExec + final HashAggregateExec are
+    untouched.
+    """
+
+    def __init__(self, input: ExecutionPlan, group_exprs: List[Tuple[E.Expr, str]],
+                 aggs: List[AggSpec]):
+        self.input = input
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+        ref = HashAggregateExec(input, group_exprs, aggs, mode="partial")
+        self._schema = ref.schema
+        self._compiled = None
+
+    eligible = MeshAggregateExec.eligible
+
+    def children(self):
+        return [self.input]
+
+    def output_partition_count(self):
+        return self.input.output_partition_count()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        from ..parallel.distributed import distributed_partial_aggregate
+        from ..parallel.mesh import make_mesh, row_sharding
+
+        in_schema = self.input.schema
+        big = concat_batches(in_schema, self.input.execute(partition, ctx))
+
+        n_dev = len(jax.devices())
+        mesh = make_mesh(n_dev)
+
+        with self.xla_lock():
+            if self._compiled is None:
+                self._compiled = _compile_agg_exprs(
+                    in_schema, self.group_exprs, self.aggs)
+                self._runs = {}
+            comp, key_c, val_c = self._compiled
+            aux = comp.aux_arrays(big.dicts)
+
+            key_names = [n for _, n in key_c]
+            agg_specs = [(a.name, a.func) for _, a in val_c]
+            cols, mask, padded = _shard_batch(big, mesh, n_dev)
+
+            cap = ctx.config.get(AGG_CAPACITY)
+            per_dev_cap = max(64, min(cap, padded // n_dev + 1))
+            key_ranges = _agg_key_ranges(key_c, big.dicts)
+            from .kernels import dense_domain
+
+            domain = dense_domain(key_ranges)
+            if domain is not None:
+                per_dev_cap = min(per_dev_cap, domain)
+            # reuse the compiled shard_map program across a stage's N
+            # partition tasks — they share this operator instance, and
+            # re-tracing an identical program per task would serialize N
+            # duplicate compiles under xla_lock.  aux LUTs are baked into
+            # the closure as constants, so their content is part of the key
+            # (per-partition scans can build different dictionaries).
+            aux_key = tuple(sorted(
+                (k, hash(v.tobytes()) if hasattr(v, "tobytes") else hash(str(v)))
+                for k, v in aux.items()))
+            run_key = (padded, per_dev_cap, key_ranges, aux_key)
+            run = self._runs.get(run_key)
+            if run is None:
+                run = distributed_partial_aggregate(
+                    mesh, _make_derive(key_c, val_c, aux), key_names,
+                    agg_specs, per_dev_cap, key_ranges=key_ranges)
+                self._runs[run_key] = run
+            pk, pv, pmask, overflow = run(cols, mask)
+            if bool(overflow):
+                raise CapacityError(
+                    f"mesh partial aggregation exceeded {per_dev_cap} "
+                    f"groups/device; raise {AGG_CAPACITY}")
+
+        result = _finish_states(self._schema, key_c, val_c, pk, pv, pmask,
+                                big.dicts)
+        self.metrics().add("output_rows", result.num_rows)
+        self.metrics().add("mesh_devices", n_dev)
+        return [result]
+
+    def _label(self):
+        g = ", ".join(n for _, n in self.group_exprs)
+        a = ", ".join(f"{x.func}({x.name})" for x in self.aggs)
+        return (f"MeshPartialAggregateExec(per-host mesh, file exchange): "
+                f"groupBy=[{g}] aggr=[{a}]")
 
 
 class MeshJoinExec(ExecutionPlan):

@@ -104,6 +104,51 @@ def distributed_filter_aggregate(
     return run
 
 
+def distributed_partial_aggregate(
+    mesh: Mesh,
+    derive_fn,
+    key_names: Sequence[str],
+    agg_specs: Sequence[Tuple[str, str]],
+    capacity: int,
+    axis: str = PART_AXIS,
+    key_ranges=None,
+):
+    """Mesh-local HALF of the hybrid exchange: derive -> per-device grouped
+    aggregate, NO collective.  Each device reduces its row shard to group
+    states; the cross-HOST merge happens via the ordinary file shuffle +
+    final aggregate (SURVEY §2.5 north star: "ICI shuffle for co-located
+    executors, Flight fallback across hosts" — this is the ICI-side piece
+    that composes with the file side).
+
+    Returns ``run(cols, mask) -> (keys, vals, mask, overflow)`` where each
+    output is the concatenation of every device's ``capacity`` state rows.
+    """
+    def per_shard(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
+        cols, mask = derive_fn(cols, mask)
+        keys = [cols[k] for k in key_names]
+        vals = [(cols[v], how) for v, how in agg_specs]
+        pk, pv, pmask, ovf = K.grouped_aggregate(keys, vals, mask, capacity,
+                                                 key_ranges=key_ranges)
+        overflow = lax.psum(ovf.astype(jnp.int32), axis) > 0
+        return pk, pv, pmask, overflow
+
+    row = P(axis)
+    compiled: Dict[Tuple[str, ...], object] = {}
+
+    def run(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
+        key = tuple(sorted(cols))
+        fn = compiled.get(key)
+        if fn is None:
+            in_specs = ({name: row for name in cols}, row)
+            out_specs = ([row] * len(key_names), [row] * len(agg_specs), row, P())
+            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs))
+            compiled[key] = fn
+        return fn(cols, mask)
+
+    return run
+
+
 def distributed_hash_join(
     mesh: Mesh,
     n_keys: int,

@@ -25,7 +25,12 @@ from ..models import logical as L
 from ..ops import operators as O
 from ..ops.physical import ExecutionPlan, Partitioning
 from ..ops.shuffle import RepartitionExec
-from ..utils.config import BROADCAST_THRESHOLD, MESH_SHUFFLE, BallistaConfig
+from ..utils.config import (
+    BROADCAST_THRESHOLD,
+    MESH_HYBRID,
+    MESH_SHUFFLE,
+    BallistaConfig,
+)
 from ..utils.errors import PlanningError
 
 
@@ -153,11 +158,25 @@ class PhysicalPlanner:
 
         # TPU fast path: fuse partial agg -> all_to_all -> final agg into one
         # XLA program over the local device mesh (ops/mesh_exec.py) instead
-        # of a file-shuffle stage pair
+        # of a file-shuffle stage pair.  Hybrid mode keeps the stage pair
+        # (tasks spread over executors, file shuffle across hosts) and
+        # meshes only the per-task partial — the multi-HOST composition.
         if self.config.get(MESH_SHUFFLE):
-            from ..ops.mesh_exec import MeshAggregateExec
+            from ..ops.mesh_exec import MeshAggregateExec, MeshPartialAggregateExec
 
             if MeshAggregateExec.eligible(groups, specs, child.schema):
+                if self.config.get(MESH_HYBRID):
+                    # eligible() guarantees non-empty groups here (global
+                    # aggregates take the plain path)
+                    partial = MeshPartialAggregateExec(child, groups, specs)
+                    key_exprs = tuple(E.Column(n) for _, n in groups)
+                    exchange = RepartitionExec(
+                        partial,
+                        Partitioning.hash(key_exprs,
+                                          self.config.shuffle_partitions))
+                    final_groups = [(E.Column(n), n) for _, n in groups]
+                    return O.HashAggregateExec(exchange, final_groups, specs,
+                                               mode="final")
                 return MeshAggregateExec(child, groups, specs)
 
         partial = O.HashAggregateExec(child, groups, specs, mode="partial")

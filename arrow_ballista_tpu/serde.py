@@ -17,7 +17,7 @@ from typing import Dict, List, Optional
 from .models import expr as E
 from .models.schema import DataType, Field, Schema
 from .ops import operators as O
-from .ops.mesh_exec import MeshAggregateExec
+from .ops.mesh_exec import MeshAggregateExec, MeshPartialAggregateExec
 from .ops import physical as P
 from .ops import shuffle as SH
 from .ops.shuffle import PartitionLocation, ShuffleWritePartition
@@ -227,6 +227,11 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
         return {"t": "limit", "input": plan_to_obj(p.input), "n": p.n}
     if isinstance(p, O.CoalescePartitionsExec):
         return {"t": "coalesce", "input": plan_to_obj(p.input)}
+    if isinstance(p, MeshPartialAggregateExec):
+        return {"t": "meshpartial", "input": plan_to_obj(p.input),
+                "groups": [[expr_to_obj(e), n] for e, n in p.group_exprs],
+                "aggs": [{"func": a.func, "operand": expr_to_obj(a.operand),
+                          "name": a.name} for a in p.aggs]}
     if isinstance(p, MeshAggregateExec):
         return {"t": "meshagg", "input": plan_to_obj(p.input),
                 "groups": [[expr_to_obj(e), n] for e, n in p.group_exprs],
@@ -302,6 +307,12 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
         return O.LimitExec(plan_from_obj(o["input"]), o["n"])
     if t == "coalesce":
         return O.CoalescePartitionsExec(plan_from_obj(o["input"]))
+    if t == "meshpartial":
+        return MeshPartialAggregateExec(
+            plan_from_obj(o["input"]),
+            [(expr_from_obj(e), n) for e, n in o["groups"]],
+            [O.AggSpec(a["func"], expr_from_obj(a["operand"]), a["name"])
+             for a in o["aggs"]])
     if t == "meshagg":
         return MeshAggregateExec(
             plan_from_obj(o["input"]),

@@ -26,6 +26,7 @@ JOIN_OUTPUT_FACTOR = "ballista.join.output_factor"  # out_cap = factor * probe_c
 JOIN_MAX_CAPACITY = "ballista.join.max_capacity"  # ceiling for adaptive retry
 COLLECT_STATISTICS = "ballista.collect_statistics"
 MESH_SHUFFLE = "ballista.shuffle.mesh"  # use ICI all-to-all when executors co-located on a mesh
+MESH_HYBRID = "ballista.shuffle.mesh.hybrid"  # mesh WITHIN a host, file shuffle ACROSS hosts
 TASK_SLOTS = "ballista.executor.task_slots"
 BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides smaller skip the shuffle
 JOB_TIMEOUT_S = "ballista.job.timeout.seconds"  # client-side wait_for_job deadline
@@ -62,6 +63,8 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "hard ceiling for adaptive join-capacity growth (rows)"),
         ConfigEntry(COLLECT_STATISTICS, True, _parse_bool, ""),
         ConfigEntry(MESH_SHUFFLE, False, _parse_bool, "use ICI mesh all-to-all shuffle"),
+        ConfigEntry(MESH_HYBRID, False, _parse_bool,
+                    "hybrid exchange: mesh-fused partials per host, file shuffle across hosts"),
         ConfigEntry(TASK_SLOTS, 4, int, "concurrent task slots per executor"),
         ConfigEntry(BROADCAST_THRESHOLD, 1_000_000, int,
                     "broadcast join build sides with fewer estimated rows"),

@@ -149,3 +149,93 @@ def test_mesh_semi_join_matches(join_tables):
     got = mesh_ctx.sql(sql).to_pandas()
     want = file_ctx.sql(sql).to_pandas()
     pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+# --------------------------------------------------------------------------
+# hybrid composition: mesh WITHIN a host, file shuffle ACROSS hosts
+# --------------------------------------------------------------------------
+
+
+def test_mesh_hybrid_plan_shape(table):
+    """Hybrid mode keeps the stage pair (file exchange) and meshes only the
+    partial: MeshPartialAggregateExec under a hash Repartition under a
+    final HashAggregateExec."""
+    from arrow_ballista_tpu.ops.mesh_exec import MeshPartialAggregateExec
+    from arrow_ballista_tpu.ops.operators import HashAggregateExec
+    from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+    from arrow_ballista_tpu.scheduler.planner import collect_nodes
+    from arrow_ballista_tpu.sql.optimizer import optimize
+
+    cfg = BallistaConfig({"ballista.shuffle.mesh": "true",
+                          "ballista.shuffle.mesh.hybrid": "true",
+                          "ballista.shuffle.partitions": "4"})
+    ctx = BallistaContext.local(cfg)
+    try:
+        ctx.register_table("t", table)
+        df = ctx.sql(QUERIES[0])
+        planned = PhysicalPlanner(ctx.catalog, ctx.config).plan_query(
+            optimize(df.logical))
+        partials = collect_nodes(planned.plan, MeshPartialAggregateExec)
+        finals = [n for n in collect_nodes(planned.plan, HashAggregateExec)
+                  if n.mode == "final"]
+        assert partials and finals, planned.plan.display()
+        # the partial keeps the input's partitioning (one task per partition)
+        assert partials[0].output_partition_count() > 1
+    finally:
+        ctx.shutdown()
+
+
+def test_mesh_hybrid_matches_file_shuffle(table):
+    """Hybrid path results are identical to the plain file-shuffle path."""
+    hybrid_cfg = BallistaConfig({"ballista.shuffle.mesh": "true",
+                                 "ballista.shuffle.mesh.hybrid": "true",
+                                 "ballista.shuffle.partitions": "4"})
+    plain_cfg = BallistaConfig({"ballista.shuffle.partitions": "4"})
+    for sql in QUERIES:
+        hctx = BallistaContext.local(hybrid_cfg)
+        fctx = BallistaContext.local(plain_cfg)
+        try:
+            hctx.register_table("t", table)
+            fctx.register_table("t", table)
+            got = hctx.sql(sql).to_pandas()
+            want = fctx.sql(sql).to_pandas()
+        finally:
+            hctx.shutdown()
+            fctx.shutdown()
+        pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_mesh_hybrid_through_network_scheduler(tmp_path, table):
+    """The hybrid exchange runs through SchedulerNetService with TWO
+    executors: mesh-fused partial tasks execute on different executors and
+    their states cross hosts via the file/data-plane shuffle (north star:
+    ICI within a host, Flight fallback across hosts)."""
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    sched = SchedulerNetService("127.0.0.1", 0, rest_port=0)
+    sched.start()
+    exes = [ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                           work_dir=str(tmp_path / f"w{i}"),
+                           executor_id=f"hyb-exec-{i}", concurrent_tasks=2)
+            for i in range(2)]
+    for ex in exes:
+        ex.start()
+    try:
+        cfg = BallistaConfig({"ballista.shuffle.mesh": "true",
+                              "ballista.shuffle.mesh.hybrid": "true",
+                              "ballista.shuffle.partitions": "4"})
+        ctx = BallistaContext.remote("127.0.0.1", sched.port, cfg)
+        ctx.register_table("t", table)
+        got = ctx.sql(QUERIES[0]).to_pandas()
+        ctx.shutdown()
+
+        plain = BallistaContext.local(BallistaConfig())
+        plain.register_table("t", table)
+        want = plain.sql(QUERIES[0]).to_pandas()
+        plain.shutdown()
+        pd.testing.assert_frame_equal(got, want, check_dtype=False)
+    finally:
+        for ex in exes:
+            ex.stop(notify=False)
+        sched.stop()
