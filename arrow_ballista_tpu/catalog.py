@@ -181,6 +181,67 @@ class CsvTable(TableProvider):
                            has_header=self.has_header)
 
 
+class JsonTable(TableProvider):
+    """Newline-delimited JSON (reference register_json, context.rs:358-530)."""
+
+    def __init__(self, name: str, paths, schema: Optional[Schema] = None):
+        from .utils import object_store as obs
+
+        self.name = name
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        if schema is None:
+            import pyarrow.json as pajson
+
+            files = obs.list_files(self.paths[0], (".json", ".jsonl", ".ndjson"))
+            if not files:
+                raise PlanningError(f"no json files in {self.paths[0]}")
+            with obs.open_input(files[0]) as fh:
+                sample = pajson.read_json(fh)
+            multi = len(files) > 1 or len(self.paths) > 1
+            nulls = ({n: True for n in sample.column_names} if multi
+                     else _table_null_stats(sample))
+            schema = arrow_schema_to_engine(sample.schema, nulls)
+        self.schema = schema
+
+    def scan(self, projection, filters, target_partitions):
+        from .ops.physical import JsonScanExec
+
+        schema = self.schema if projection is None else self.schema.project(projection)
+        return JsonScanExec(schema, self.paths, target_partitions, filters,
+                            table_schema=self.schema)
+
+
+class AvroTable(TableProvider):
+    """Avro object container files (reference register_avro; codec in
+    utils/avro.py since no avro library ships in this image)."""
+
+    def __init__(self, name: str, paths, schema: Optional[Schema] = None):
+        from .utils import object_store as obs
+
+        self.name = name
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        if schema is None:
+            from .utils.avro import avro_arrow_schema, read_avro_schema
+
+            files = obs.list_files(self.paths[0], (".avro",))
+            if not files:
+                raise PlanningError(f"no avro files in {self.paths[0]}")
+            # header-only: the writer schema (and union nullability) lives
+            # in the container metadata — never decode the file to infer
+            with obs.open_input(files[0]) as fh:
+                avro_schema = read_avro_schema(fh)
+            pa_schema, nulls = avro_arrow_schema(avro_schema)
+            schema = arrow_schema_to_engine(pa_schema, nulls)
+        self.schema = schema
+
+    def scan(self, projection, filters, target_partitions):
+        from .ops.physical import AvroScanExec
+
+        schema = self.schema if projection is None else self.schema.project(projection)
+        return AvroScanExec(schema, self.paths, target_partitions, filters,
+                            table_schema=self.schema)
+
+
 class SchemaCatalog(Catalog):
     """Mutable in-memory catalog of providers (per session)."""
 

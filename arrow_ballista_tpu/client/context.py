@@ -172,6 +172,24 @@ class BallistaContext:
             return
         self.catalog.register(CsvTable(name, path, schema, delimiter, has_header))
 
+    def register_json(self, name: str, path, schema: Optional[Schema] = None) -> None:
+        """Newline-delimited JSON (reference register_json, context.rs)."""
+        if self._remote is not None:
+            self._remote.register_external_table(name, "json", path, schema)
+            return
+        from ..catalog import JsonTable
+
+        self.catalog.register(JsonTable(name, path, schema))
+
+    def register_avro(self, name: str, path, schema: Optional[Schema] = None) -> None:
+        """Avro object container files (reference register_avro)."""
+        if self._remote is not None:
+            self._remote.register_external_table(name, "avro", path, schema)
+            return
+        from ..catalog import AvroTable
+
+        self.catalog.register(AvroTable(name, path, schema))
+
     def deregister_table(self, name: str) -> None:
         if self._remote is not None:
             self._remote.deregister_table(name)

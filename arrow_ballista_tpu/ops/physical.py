@@ -502,23 +502,36 @@ class ParquetScanExec(ScanExec):
                 f"{len(self.groups)} partitions{pruned}")
 
 
-class CsvScanExec(ScanExec):
-    """CSV scan (including TPC-H ``.tbl`` pipe-delimited files)."""
+def _arrow_type_of(dt: DataType):
+    """Engine dtype -> the arrow type file readers should parse into."""
+    import pyarrow as pa
+
+    return {
+        "int32": pa.int32(), "int64": pa.int64(), "float32": pa.float32(),
+        "float64": pa.float64(), "bool": pa.bool_(), "date32": pa.date32(),
+        "decimal": pa.float64(), "string": pa.string(),
+    }[dt.kind]
+
+
+class FileListScanExec(ScanExec):
+    """Shared scaffolding for whole-file scans (csv/json/avro): object-store
+    listing, round-robin file grouping into partitions, per-file read +
+    concat.  Parquet scans stay separate (row-group granularity)."""
+
+    SUFFIXES: Tuple[str, ...] = ()
+    FORMAT = "file"
 
     def __init__(self, schema: Schema, paths: List[str], target_partitions: int,
-                 filters: Sequence[E.Expr] = (), table_schema: Optional[Schema] = None,
-                 delimiter: str = ",", has_header: bool = True):
+                 filters: Sequence[E.Expr] = (), table_schema: Optional[Schema] = None):
         super().__init__(schema, filters)
         from ..utils import object_store as obs
 
         self.table_schema = table_schema or schema
-        self.delimiter = delimiter
-        self.has_header = has_header
         files = []
         for p in paths:
-            files.extend(obs.list_files(p, (".csv", ".tbl")))
+            files.extend(obs.list_files(p, self.SUFFIXES))
         if not files:
-            raise ExecutionError(f"no csv files found in {paths}")
+            raise ExecutionError(f"no {self.FORMAT} files found in {paths}")
         self.files = files
         k = max(1, min(target_partitions, len(files)))
         self.groups = [files[i::k] for i in range(k)]
@@ -526,40 +539,90 @@ class CsvScanExec(ScanExec):
     def output_partition_count(self) -> int:
         return len(self.groups)
 
-    def _arrow_type(self, dt: DataType):
-        import pyarrow as pa
-
-        return {
-            "int32": pa.int32(), "int64": pa.int64(), "float32": pa.float32(),
-            "float64": pa.float64(), "bool": pa.bool_(), "date32": pa.date32(),
-            "decimal": pa.float64(), "string": pa.string(),
-        }[dt.kind]
+    def _read_one(self, path: str):
+        raise NotImplementedError
 
     def _read_partition(self, partition: int):
         import pyarrow as pa
+
+        tables = [self._read_one(f) for f in self.groups[partition]]
+        return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+    def _label(self):
+        return (f"{type(self).__name__}: {len(self.files)} files, "
+                f"{len(self.groups)} partitions")
+
+
+class CsvScanExec(FileListScanExec):
+    """CSV scan (including TPC-H ``.tbl`` pipe-delimited files)."""
+
+    SUFFIXES = (".csv", ".tbl")
+    FORMAT = "csv"
+
+    def __init__(self, schema: Schema, paths: List[str], target_partitions: int,
+                 filters: Sequence[E.Expr] = (), table_schema: Optional[Schema] = None,
+                 delimiter: str = ",", has_header: bool = True):
+        super().__init__(schema, paths, target_partitions, filters, table_schema)
+        self.delimiter = delimiter
+        self.has_header = has_header
+
+    def _read_one(self, path: str):
         import pyarrow.csv as pacsv
 
         from ..utils import object_store as obs
 
         names = self.table_schema.names()
-        column_types = {f.name: self._arrow_type(f.dtype) for f in self.table_schema}
-        tables = []
-        for f in self.groups[partition]:
-            trailing = _has_trailing_delimiter(f, self.delimiter)
-            read_names = None if self.has_header else names + (["__trail"] if trailing else [])
-            ropts = pacsv.ReadOptions(column_names=read_names)
-            popts = pacsv.ParseOptions(delimiter=self.delimiter)
-            copts = pacsv.ConvertOptions(
-                column_types=column_types, include_columns=self._schema.names()
-            )
-            with obs.open_input(f) as fh:
-                tables.append(pacsv.read_csv(fh, read_options=ropts,
-                                             parse_options=popts,
-                                             convert_options=copts))
-        return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        column_types = {f.name: _arrow_type_of(f.dtype) for f in self.table_schema}
+        trailing = _has_trailing_delimiter(path, self.delimiter)
+        read_names = None if self.has_header else names + (["__trail"] if trailing else [])
+        ropts = pacsv.ReadOptions(column_names=read_names)
+        popts = pacsv.ParseOptions(delimiter=self.delimiter)
+        copts = pacsv.ConvertOptions(
+            column_types=column_types, include_columns=self._schema.names()
+        )
+        with obs.open_input(path) as fh:
+            return pacsv.read_csv(fh, read_options=ropts, parse_options=popts,
+                                  convert_options=copts)
 
-    def _label(self):
-        return f"CsvScanExec: {len(self.files)} files, {len(self.groups)} partitions"
+
+class JsonScanExec(FileListScanExec):
+    """Newline-delimited JSON scan (reference reads json via DataFusion's
+    NdJson reader, client context.rs register_json).  Parsing uses the
+    TABLE schema explicitly — per-file type inference would let two files
+    of one table disagree (int vs null vs double) and break the concat."""
+
+    SUFFIXES = (".json", ".jsonl", ".ndjson")
+    FORMAT = "json"
+
+    def _read_one(self, path: str):
+        import pyarrow as pa
+        import pyarrow.json as pajson
+
+        from ..utils import object_store as obs
+
+        explicit = pa.schema([
+            pa.field(f.name, _arrow_type_of(f.dtype))
+            for f in self.table_schema])
+        popts = pajson.ParseOptions(explicit_schema=explicit)
+        with obs.open_input(path) as fh:
+            table = pajson.read_json(fh, parse_options=popts)
+        return table.select(self._schema.names())
+
+
+class AvroScanExec(FileListScanExec):
+    """Avro object-container-file scan (reference reads avro via DataFusion;
+    the container codec lives in utils/avro.py — no external avro library
+    exists in this image)."""
+
+    SUFFIXES = (".avro",)
+    FORMAT = "avro"
+
+    def _read_one(self, path: str):
+        from ..utils import object_store as obs
+        from ..utils.avro import avro_to_arrow
+
+        with obs.open_input(path) as fh:
+            return avro_to_arrow(fh).select(self._schema.names())
 
 
 def _has_trailing_delimiter(path: str, delim: str) -> bool:

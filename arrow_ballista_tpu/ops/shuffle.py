@@ -150,7 +150,8 @@ class ShuffleWriterExec(ExecutionPlan):
                     path = os.path.join(base, f"data-{q}.arrow")
                     rows, nbytes = write_ipc_rows(big.schema, data, big.dicts, path)
                     out.append(ShuffleWritePartition(q, path, rows, nbytes))
-            self.metrics().add("input_rows", big.num_rows)
+            # mask is already on host — never force a device sync for a metric
+            self.metrics().add("input_rows", int(mask_np.sum()))
             self.metrics().add("output_rows", sum(p.num_rows for p in out))
             return out
 

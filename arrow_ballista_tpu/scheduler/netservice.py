@@ -29,6 +29,31 @@ from .types import ExecutorHeartbeat, ExecutorMetadata, TaskDescription
 log = logging.getLogger(__name__)
 
 
+def serialize_tasks_or_fail(scheduler, executor_id: str,
+                            tasks: List[TaskDescription]) -> List[dict]:
+    """Serialize tasks PER TASK; a task whose plan cannot serialize fails
+    identically on every executor, so report it as a fatal task failure
+    (fails its job fast) instead of letting launch retry forever —
+    WITHOUT killing unrelated jobs' tasks sharing the batch.  Shared by
+    the push launcher and the pull poll_work response."""
+    objs: List[dict] = []
+    failed = []
+    for t in tasks:
+        try:
+            objs.append(serde.task_to_obj(t))
+        except Exception as e:  # noqa: BLE001 — deterministic plan defect
+            from .types import EXECUTION_ERROR, FailedReason, TaskStatus
+
+            log.exception("task %s failed to serialize", t.task)
+            failed.append(TaskStatus(t.task, executor_id, "failed",
+                                     failure=FailedReason(
+                                         EXECUTION_ERROR,
+                                         f"plan serialization failed: {e}")))
+    if failed:
+        scheduler.update_task_status(executor_id, failed)
+    return objs
+
+
 class NetTaskLauncher(TaskLauncher):
     """Pushes tasks to executors over the wire (reference
     DefaultTaskLauncher -> ExecutorGrpc.LaunchMultiTask,
@@ -44,9 +69,11 @@ class NetTaskLauncher(TaskLauncher):
         return meta.host, meta.grpc_port or meta.port
 
     def launch_tasks(self, executor_id: str, tasks: List[TaskDescription]) -> None:
+        objs = serialize_tasks_or_fail(self.scheduler, executor_id, tasks)
+        if not objs:
+            return
         host, port = self._addr(executor_id)
-        wire.call(host, port, "launch_multi_task",
-                  {"tasks": [serde.task_to_obj(t) for t in tasks]})
+        wire.call(host, port, "launch_multi_task", {"tasks": objs})
 
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
         try:
@@ -121,6 +148,7 @@ class SchedulerNetService:
         r("executor_stopped", self._executor_stopped)
         r("register_table", self._register_table)
         r("register_external_table", self._register_external_table)
+        r("get_file_metadata", self._get_file_metadata)
         r("list_tables", self._list_tables)
         r("table_schema", self._table_schema)
         r("deregister_table", self._deregister_table)
@@ -275,9 +303,13 @@ class SchedulerNetService:
 
     def _poll_work(self, payload: dict, _bin: bytes):
         statuses = [serde.status_from_obj(s) for s in payload.get("statuses", [])]
-        tasks = self.server.poll_work(payload["executor_id"],
+        executor_id = payload["executor_id"]
+        tasks = self.server.poll_work(executor_id,
                                       payload.get("num_free_slots", 0), statuses)
-        return {"tasks": [serde.task_to_obj(t) for t in tasks]}, b""
+        # per-task guard: an unserializable plan must fail its job, not
+        # strand already-popped tasks as running forever
+        return {"tasks": serialize_tasks_or_fail(self.server, executor_id,
+                                                 tasks)}, b""
 
     def _executor_stopped(self, payload: dict, _bin: bytes):
         self.server.executor_stopped(payload["executor_id"],
@@ -305,9 +337,32 @@ class SchedulerNetService:
             catalog.register(CsvTable(
                 name, path, schema, payload.get("delimiter", ","),
                 payload.get("has_header", True)))
+        elif fmt == "json":
+            from ..catalog import JsonTable
+
+            catalog.register(JsonTable(name, path, schema))
+        elif fmt == "avro":
+            from ..catalog import AvroTable
+
+            catalog.register(AvroTable(name, path, schema))
         else:
             raise PlanningError(f"unsupported format {fmt!r}")
         return {}, b""
+
+    def _get_file_metadata(self, payload: dict, _bin: bytes):
+        """Schema inference for a file path (reference
+        SchedulerGrpc.get_file_metadata, grpc.rs:271-325)."""
+        from ..catalog import AvroTable, CsvTable, JsonTable, ParquetTable
+
+        path = payload["path"]
+        fmt = payload.get("format") or (
+            "parquet" if path.endswith(".parquet") else
+            "avro" if path.endswith(".avro") else
+            "json" if path.endswith((".json", ".jsonl", ".ndjson")) else "csv")
+        provider = {"parquet": ParquetTable, "csv": CsvTable,
+                    "json": JsonTable, "avro": AvroTable}[fmt]
+        schema = provider("__meta", path).schema
+        return {"format": fmt, "schema": serde.schema_to_obj(schema)}, b""
 
     def _list_tables(self, payload: dict, _bin: bytes):
         _session, catalog, _ = self._session_ctx(payload)

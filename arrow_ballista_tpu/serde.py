@@ -197,6 +197,16 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
                 "filters": [expr_to_obj(f) for f in p.filters],
                 "table_schema": schema_to_obj(p.table_schema),
                 "delimiter": p.delimiter, "has_header": p.has_header}
+    if isinstance(p, P.JsonScanExec):
+        return {"t": "jsonscan", "schema": schema_to_obj(p.schema),
+                "files": p.files, "partitions": p.output_partition_count(),
+                "filters": [expr_to_obj(f) for f in p.filters],
+                "table_schema": schema_to_obj(p.table_schema)}
+    if isinstance(p, P.AvroScanExec):
+        return {"t": "avroscan", "schema": schema_to_obj(p.schema),
+                "files": p.files, "partitions": p.output_partition_count(),
+                "filters": [expr_to_obj(f) for f in p.filters],
+                "table_schema": schema_to_obj(p.table_schema)}
     if isinstance(p, O.ProjectionExec):
         return {"t": "proj", "input": plan_to_obj(p.input),
                 "exprs": [[expr_to_obj(e), n] for e, n in p.exprs],
@@ -279,6 +289,16 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
                              [expr_from_obj(f) for f in o["filters"]],
                              table_schema=schema_from_obj(o["table_schema"]),
                              delimiter=o["delimiter"], has_header=o["has_header"])
+    if t == "jsonscan":
+        return P.JsonScanExec(schema_from_obj(o["schema"]), o["files"],
+                              o["partitions"],
+                              [expr_from_obj(f) for f in o["filters"]],
+                              table_schema=schema_from_obj(o["table_schema"]))
+    if t == "avroscan":
+        return P.AvroScanExec(schema_from_obj(o["schema"]), o["files"],
+                              o["partitions"],
+                              [expr_from_obj(f) for f in o["filters"]],
+                              table_schema=schema_from_obj(o["table_schema"]))
     if t == "proj":
         return O.ProjectionExec(plan_from_obj(o["input"]),
                                 [(expr_from_obj(e), n) for e, n in o["exprs"]],

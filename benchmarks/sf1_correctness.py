@@ -59,6 +59,18 @@ def main() -> None:
         table = pq.read_table(os.path.join(DATA_DIR, f"{name}.parquet"))
         _arrow_to_oracle_df(table).to_sql(name, conn, index=False,
                                           chunksize=200_000)
+    # join-key indexes: without them sqlite nested-loops 6M-row joins and
+    # single queries run for hours
+    for idx, (tbl, col) in enumerate([
+            ("lineitem", "l_orderkey"), ("lineitem", "l_partkey"),
+            ("lineitem", "l_suppkey"), ("orders", "o_orderkey"),
+            ("orders", "o_custkey"), ("customer", "c_custkey"),
+            ("customer", "c_nationkey"), ("supplier", "s_suppkey"),
+            ("supplier", "s_nationkey"), ("part", "p_partkey"),
+            ("partsupp", "ps_partkey"), ("partsupp", "ps_suppkey"),
+            ("nation", "n_nationkey"), ("nation", "n_regionkey"),
+            ("region", "r_regionkey")]):
+        conn.execute(f"CREATE INDEX IF NOT EXISTS ix{idx} ON {tbl}({col})")
     conn.commit()
 
     config = BallistaConfig({
@@ -80,8 +92,18 @@ def main() -> None:
             entry["engine_s"] = round(time.time() - t0, 1)
             t0 = time.time()
             import pandas as pd
+            import threading
 
-            want = pd.read_sql_query(to_sqlite(sql), conn)
+            # bounded oracle: conn.interrupt() aborts a runaway sqlite plan
+            # so one pathological query can't eat the whole round
+            timer = threading.Timer(
+                float(os.environ.get("ORACLE_TIMEOUT_S", "900")),
+                conn.interrupt)
+            timer.start()
+            try:
+                want = pd.read_sql_query(to_sqlite(sql), conn)
+            finally:
+                timer.cancel()
             entry["oracle_s"] = round(time.time() - t0, 1)
             compare_content(got.copy(), want.copy())
             check_ordering(sql, got)

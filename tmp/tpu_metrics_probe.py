@@ -1,0 +1,57 @@
+"""Where does steady-state TPU q1 wall time go? Per-operator metrics dump."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax
+
+jax.config.update("jax_enable_x64", True)
+print("backend:", jax.devices()[0].platform, flush=True)
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+from benchmarks.queries import QUERIES as SQL
+from benchmarks.tpch import register_tables
+
+config = BallistaConfig({
+    "ballista.shuffle.partitions": "8",
+    "ballista.batch.size": str(1 << 20),
+    "ballista.job.timeout.seconds": "1800",
+})
+ctx = BallistaContext.standalone(config, concurrent_tasks=4)
+register_tables(ctx, "/root/repo/.bench_data/tpch-sf1")
+
+for it in range(2):
+    t0 = time.perf_counter()
+    res = ctx.sql(SQL[1]).collect()
+    wall = time.perf_counter() - t0
+    print(f"q1 iter{it}: {wall:6.1f} s", flush=True)
+
+# metrics of the last completed job
+sched = ctx._cluster.scheduler
+jobs = list(sched.jobs._status)
+last = jobs[-1]
+graph = sched.jobs.get_graph(last)
+for sid in sorted(graph.stages):
+    s = graph.stages[sid]
+    agg = {}
+    spans = []
+    for t in s.task_infos:
+        if not t or not t.status:
+            continue
+        st = t.status
+        spans.append((st.start_time_ms, st.end_time_ms))
+        for op, mm in (st.metrics or {}).items():
+            for k, v in mm.items():
+                agg.setdefault(f"{op}.{k}", 0.0)
+                agg[f"{op}.{k}"] += v
+    print(f"--- stage {sid} ({len(spans)} tasks)")
+    if spans:
+        lo = min(a for a, _ in spans)
+        hi = max(b for _, b in spans)
+        print(f"    stage span: {(hi-lo)/1000:.1f} s")
+        for a, b in spans:
+            print(f"      task: {(b-a)/1000:6.2f} s")
+    for k in sorted(agg):
+        v = agg[k]
+        if v > 0.05 or k.endswith("rows"):
+            print(f"    {k:60s} {v:10.2f}")
+ctx.shutdown()
