@@ -105,7 +105,9 @@ class SchedulerClient:
         payload, _ = wire.call(self.host, self.port, "poll_work", {
             "executor_id": executor_id, "num_free_slots": num_free_slots,
             "statuses": [serde.status_to_obj(s) for s in statuses]})
-        return [decode(t) for t in payload["tasks"]]
+        from ..scheduler.netservice import ungroup_tasks
+
+        return [decode(t) for t in ungroup_tasks(payload)]
 
     def executor_stopped(self, executor_id: str, reason: str = "") -> None:
         wire.call(self.host, self.port, "executor_stopped",
@@ -304,7 +306,11 @@ class ExecutorServer:
 
     # --- RPC handlers ----------------------------------------------------
     def _launch_multi_task(self, payload: dict, _bin: bytes):
-        tasks = [self._decode_task(t) for t in payload["tasks"]]
+        from ..scheduler.netservice import ungroup_tasks
+
+        # MultiTaskDefinition shape (one plan + N task envelopes) or the
+        # legacy flat shape
+        tasks = [self._decode_task(t) for t in ungroup_tasks(payload)]
         for task in tasks:
             self.executor.submit_task(task, self._report_status)
         return {"accepted": len(tasks)}, b""

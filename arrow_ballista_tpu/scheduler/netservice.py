@@ -35,12 +35,22 @@ def serialize_tasks_or_fail(scheduler, executor_id: str,
     identically on every executor, so report it as a fatal task failure
     (fails its job fast) instead of letting launch retry forever —
     WITHOUT killing unrelated jobs' tasks sharing the batch.  Shared by
-    the push launcher and the pull poll_work response."""
+    the push launcher and the pull poll_work response.
+
+    Same-stage tasks share one plan instance, so the (expensive) plan
+    encoding runs once per stage per batch and is reused across its tasks
+    (reference: MultiTaskDefinition's stage plan is encoded once,
+    task_manager.rs:583-650)."""
     objs: List[dict] = []
     failed = []
+    plan_cache: dict = {}
     for t in tasks:
         try:
-            objs.append(serde.task_to_obj(t))
+            plan_obj = plan_cache.get(id(t.plan))
+            if plan_obj is None:
+                plan_obj = serde.plan_to_obj(t.plan)
+                plan_cache[id(t.plan)] = plan_obj
+            objs.append(serde.task_to_obj(t, plan_obj=plan_obj))
         except Exception as e:  # noqa: BLE001 — deterministic plan defect
             from .types import EXECUTION_ERROR, FailedReason, TaskStatus
 
@@ -52,6 +62,32 @@ def serialize_tasks_or_fail(scheduler, executor_id: str,
     if failed:
         scheduler.update_task_status(executor_id, failed)
     return objs
+
+
+def group_tasks_by_plan(objs: List[dict]) -> List[dict]:
+    """Flat task objects -> MultiTaskDefinition groups (one plan dict + N
+    task envelopes).  Same-stage tasks share the plan OBJECT, so identity
+    grouping is exact and the plan is JSON-encoded onto the wire once."""
+    groups: dict = {}
+    for o in objs:
+        g = groups.setdefault(id(o["plan"]), {"plan": o["plan"], "tasks": []})
+        g["tasks"].append({"task": o["task"],
+                           "internal_id": o["internal_id"],
+                           "scalars": o["scalars"]})
+    return list(groups.values())
+
+
+def ungroup_tasks(payload: dict) -> List[dict]:
+    """Inverse of group_tasks_by_plan; accepts the legacy flat shape too."""
+    if "stages" not in payload:
+        return list(payload.get("tasks", []))
+    out = []
+    for st in payload["stages"]:
+        for env in st["tasks"]:
+            out.append({"task": env["task"], "plan": st["plan"],
+                        "internal_id": env.get("internal_id", 0),
+                        "scalars": env.get("scalars", {})})
+    return out
 
 
 class NetTaskLauncher(TaskLauncher):
@@ -72,8 +108,21 @@ class NetTaskLauncher(TaskLauncher):
         objs = serialize_tasks_or_fail(self.scheduler, executor_id, tasks)
         if not objs:
             return
+        # MultiTaskDefinition wire shape (reference ballista.proto:440-463 +
+        # task_manager.rs:583-650): one encoded stage plan + N task
+        # envelopes, so the plan crosses the wire once per stage, not once
+        # per task
         host, port = self._addr(executor_id)
-        wire.call(host, port, "launch_multi_task", {"tasks": objs})
+        try:
+            wire.call(host, port, "launch_multi_task",
+                      {"stages": group_tasks_by_plan(objs)})
+        except wire.RemoteError as e:
+            if "'tasks'" not in str(e):
+                raise
+            # mixed-version rollout: an executor predating the grouped
+            # shape KeyErrors on payload['tasks'] — resend flat once
+            log.info("executor %s speaks the legacy launch shape", executor_id)
+            wire.call(host, port, "launch_multi_task", {"tasks": objs})
 
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
         try:
@@ -307,9 +356,10 @@ class SchedulerNetService:
         tasks = self.server.poll_work(executor_id,
                                       payload.get("num_free_slots", 0), statuses)
         # per-task guard: an unserializable plan must fail its job, not
-        # strand already-popped tasks as running forever
-        return {"tasks": serialize_tasks_or_fail(self.server, executor_id,
-                                                 tasks)}, b""
+        # strand already-popped tasks as running forever.  Grouped shape:
+        # the stage plan is wire-encoded once, not once per task.
+        objs = serialize_tasks_or_fail(self.server, executor_id, tasks)
+        return {"stages": group_tasks_by_plan(objs)}, b""
 
     def _executor_stopped(self, payload: dict, _bin: bytes):
         self.server.executor_stopped(payload["executor_id"],

@@ -437,8 +437,12 @@ def graph_from_obj(o: dict):
 # task messages
 # --------------------------------------------------------------------------
 
-def task_to_obj(td: TaskDescription) -> dict:
-    return {"task": vars(td.task), "plan": plan_to_obj(td.plan),
+def task_to_obj(td: TaskDescription, plan_obj: dict = None) -> dict:
+    """``plan_obj``: pre-encoded plan to reuse (same-stage tasks share one
+    plan instance; callers encode it once — see
+    netservice.serialize_tasks_or_fail)."""
+    return {"task": vars(td.task),
+            "plan": plan_obj if plan_obj is not None else plan_to_obj(td.plan),
             "internal_id": td.task_internal_id, "scalars": dict(td.scalars)}
 
 
