@@ -209,22 +209,32 @@ def grouped_aggregate(
     return out_keys, out_vals, out_mask, overflow
 
 
-def _grouped_aggregate_dense(
-    key_cols: List[jnp.ndarray],
-    val_cols: List[Tuple[jnp.ndarray, str]],
-    mask: jnp.ndarray,
-    out_capacity: int,
-    key_ranges: Tuple[Tuple[int, int], ...],
-    domain: int,
-):
-    """Dense-domain grouping: every key combination is enumerable, so the
-    fused (row-major packed) key is the segment id directly.  Output groups
-    come out in ascending fused-key order — the same ascending key order the
-    sort path produces."""
+def _dense_strides(key_ranges):
+    """Row-major packing of a dense key domain: per-key sizes and strides.
+    The single owner of the packing convention — dense_group_states encodes
+    fused keys with it and compact_dense_states decodes them."""
     sizes = [hi - lo + 1 for lo, hi in key_ranges]
     strides = [1] * len(sizes)
     for i in range(len(sizes) - 2, -1, -1):
         strides[i] = strides[i + 1] * sizes[i + 1]
+    return sizes, strides
+
+
+def dense_group_states(
+    key_cols: List[jnp.ndarray],
+    val_cols: List[Tuple[jnp.ndarray, str]],
+    mask: jnp.ndarray,
+    key_ranges: Tuple[Tuple[int, int], ...],
+    domain: int,
+):
+    """Slot-aligned dense accumulators: slot d holds key combination d
+    (row-major packing over ``key_ranges``), for EVERY d in the domain.
+
+    Returns (dense_vals: list, exists_cnt: int32[domain], bad_rows: bool).
+    Because slots are positionally aligned, states from different shards
+    merge by pure elementwise reduction (psum/pmin/pmax) — the basis of the
+    mesh reduce-collective aggregate (parallel/distributed.py)."""
+    sizes, strides = _dense_strides(key_ranges)
 
     fused = jnp.zeros(mask.shape, dtype=jnp.int32)
     in_range = mask
@@ -242,7 +252,6 @@ def _grouped_aggregate_dense(
     exists_cnt = jax.ops.segment_sum(
         jnp.where(in_range, 1, 0).astype(jnp.int32), seg,
         num_segments=domain + 1)[:domain]
-    exists = exists_cnt > 0
 
     dense_vals = []
     for arr, how in val_cols:
@@ -265,6 +274,22 @@ def _grouped_aggregate_dense(
         else:
             raise ValueError(f"unknown agg {how}")
         dense_vals.append(v)
+    return dense_vals, exists_cnt, bad_rows
+
+
+def compact_dense_states(
+    key_cols_dtypes,
+    dense_vals: List[jnp.ndarray],
+    exists: jnp.ndarray,
+    out_capacity: int,
+    key_ranges: Tuple[Tuple[int, int], ...],
+    domain: int,
+):
+    """Compact slot-aligned dense states into the (keys, vals, mask,
+    overflow) shape the sort path produces: non-empty groups first, in
+    ascending fused-key order, padded/truncated to ``out_capacity``.
+    ``key_cols_dtypes``: output dtype per key column."""
+    sizes, strides = _dense_strides(key_ranges)
 
     # compact non-empty groups to the front (stable: keeps ascending key
     # order); domain is small, so this sort is trivial
@@ -275,9 +300,10 @@ def _grouped_aggregate_dense(
     out_mask_full = exists[order]
     out_vals = [v[order] for v in dense_vals]
     out_keys = []
-    for i, ((lo, hi), stride, k) in enumerate(zip(key_ranges, strides, key_cols)):
+    for i, ((lo, hi), stride, dt) in enumerate(
+            zip(key_ranges, strides, key_cols_dtypes)):
         dk = lo + (order.astype(jnp.int32) // jnp.int32(stride)) % jnp.int32(sizes[i])
-        out_keys.append(dk.astype(k.dtype))
+        out_keys.append(dk.astype(dt))
 
     # pad up to out_capacity if the domain is smaller
     if domain < out_capacity:
@@ -286,8 +312,28 @@ def _grouped_aggregate_dense(
         out_vals = [jnp.concatenate([v, jnp.zeros(pad, dtype=v.dtype)]) for v in out_vals]
         out_keys = [jnp.concatenate([k, jnp.zeros(pad, dtype=k.dtype)]) for k in out_keys]
 
-    overflow = (num_groups > out_capacity) | bad_rows
+    overflow = num_groups > out_capacity
     return out_keys, out_vals, out_mask_full, overflow
+
+
+def _grouped_aggregate_dense(
+    key_cols: List[jnp.ndarray],
+    val_cols: List[Tuple[jnp.ndarray, str]],
+    mask: jnp.ndarray,
+    out_capacity: int,
+    key_ranges: Tuple[Tuple[int, int], ...],
+    domain: int,
+):
+    """Dense-domain grouping: every key combination is enumerable, so the
+    fused (row-major packed) key is the segment id directly.  Output groups
+    come out in ascending fused-key order — the same ascending key order the
+    sort path produces."""
+    dense_vals, exists_cnt, bad_rows = dense_group_states(
+        key_cols, val_cols, mask, key_ranges, domain)
+    out_keys, out_vals, out_mask, overflow = compact_dense_states(
+        [k.dtype for k in key_cols], dense_vals, exists_cnt > 0,
+        out_capacity, key_ranges, domain)
+    return out_keys, out_vals, out_mask, overflow | bad_rows
 
 
 def _max_ident(dtype):

@@ -25,10 +25,10 @@ import jax.numpy as jnp
 from ..models import expr as E
 from ..models.batch import ColumnBatch, concat_batches
 from ..models.schema import Field, Schema
-from ..utils.config import AGG_CAPACITY, JOIN_OUTPUT_FACTOR
+from ..utils.config import AGG_CAPACITY, JOIN_OUTPUT_FACTOR, MESH_BROADCAST_ROWS
 from ..utils.errors import CapacityError
 from .expressions import ExprCompiler
-from .operators import AggSpec, HashAggregateExec
+from .operators import AggSpec, HashAggregateExec, null_check_of, valid_of
 from .physical import ExecutionPlan, Partitioning, TaskContext
 
 
@@ -48,29 +48,79 @@ def _unshard(x: jnp.ndarray) -> jnp.ndarray:
 # --- shared pieces of the two mesh aggregate operators ---------------------
 
 
+_HIDDEN_PREFIX = "__vld_"
+
+
+def _hidden_name(agg_name: str) -> str:
+    return _HIDDEN_PREFIX + agg_name
+
+
+def _hidden_base(hname: str) -> str:
+    return hname[len(_HIDDEN_PREFIX):]
+
+
 def _compile_agg_exprs(in_schema, group_exprs, aggs):
     comp = ExprCompiler(in_schema, "device")
     key_c = [(comp.compile(e), n) for e, n in group_exprs]
-    val_c = [(comp.compile(a.operand) if a.operand is not None else None, a)
-             for a in aggs]
+    val_c = []
+    for a in aggs:
+        cc = comp.compile(a.operand) if a.operand is not None else None
+        val_c.append((cc, a, null_check_of(cc, a.operand, in_schema)))
     return comp, key_c, val_c
 
 
+def _agg_specs(val_c):
+    """(name, how) pairs to feed the distributed aggregate, plus the hidden
+    per-group valid-count states that let all-NULL sum/min/max groups be
+    restored to NULL after the exchange (SQL semantics; the file path's
+    hidden-count trick in operators.py, carried through the collective
+    here)."""
+    specs, hidden = [], []
+    for cc, a, nc in val_c:
+        if a.func == "count":
+            # count(*) counts live rows (AGG_COUNT ignores values); a
+            # nullable count(col) sums the validity indicator instead
+            specs.append((a.name, "sum" if nc is not None else "count"))
+        else:
+            specs.append((a.name, a.func))
+            if nc is not None:
+                hidden.append((_hidden_name(a.name), "sum"))
+    return specs, hidden
+
+
 def _make_derive(key_c, val_c, aux):
-    """Per-shard projection: group keys + aggregate operand columns
-    (count aggregates count live rows via a ones column)."""
+    """Per-shard projection: group keys + aggregate operand columns.
+    NULL operand rows are neutralized per aggregate (0 for sum, the
+    fold identity for min/max, a 0/1 indicator for count) and tracked via
+    hidden validity columns."""
+
+    from . import kernels as K
 
     def derive(cols, mask):
         out = {}
         for kc, n in key_c:
             out[n] = kc.fn(cols, aux)
-        for cc, a in val_c:
-            if cc is None or a.func == "count":
+        for cc, a, nc in val_c:
+            if cc is None:
                 out[a.name] = jnp.ones(mask.shape, jnp.int64)
-            else:
-                v = cc.fn(cols, aux)
-                out[a.name] = (jnp.broadcast_to(v, mask.shape)
-                               if v.ndim == 0 else v)
+                continue
+            v = cc.fn(cols, aux)
+            v = jnp.broadcast_to(v, mask.shape) if v.ndim == 0 else v
+            if nc is None:
+                out[a.name] = (jnp.ones(mask.shape, jnp.int64)
+                               if a.func == "count" else v)
+                continue
+            valid = valid_of(v, nc)
+            if a.func == "count":
+                out[a.name] = valid.astype(jnp.int64)
+            elif a.func == "sum":
+                out[a.name] = jnp.where(valid, v, jnp.zeros((), v.dtype))
+            elif a.func == "min":
+                out[a.name] = jnp.where(valid, v, K._max_ident(v.dtype))
+            else:  # max
+                out[a.name] = jnp.where(valid, v, K._min_ident(v.dtype))
+            if a.func in ("sum", "min", "max"):
+                out[_hidden_name(a.name)] = valid.astype(jnp.int64)
         return out, mask
 
     return derive
@@ -107,19 +157,31 @@ def _agg_key_ranges(key_c, dicts):
         for kc, _n in key_c)
 
 
-def _finish_states(schema, key_c, val_c, ks, vs, msk, big_dicts):
+def _finish_states(schema, key_c, val_c, ks, vs, msk, big_dicts,
+                   hidden_specs=()):
     """Unshard fused-program outputs into one ordinary ColumnBatch, casting
-    values to the operator's declared schema dtypes."""
+    values to the operator's declared schema dtypes.  ``vs`` carries the
+    main aggregate states followed by the hidden valid-count states
+    (``hidden_specs`` order); all-NULL groups are restored to the output
+    sentinel here, after the exchange."""
+    n_main = len(val_c)
     out_cols: Dict[str, jnp.ndarray] = {}
     dicts: Dict[str, np.ndarray] = {}
     for (kc, name), arr in zip(key_c, ks):
         out_cols[name] = _unshard(arr)
         if kc.dict_fn is not None:
             dicts[name] = kc.dict_fn(big_dicts)
-    for (cc, a), arr in zip(val_c, vs):
+    for (cc, a, _nc), arr in zip(val_c, vs[:n_main]):
         want = schema.field(a.name).dtype.np_dtype
         arr = _unshard(arr)
         out_cols[a.name] = arr.astype(want) if arr.dtype != want else arr
+    for (hname, _how), cnt in zip(hidden_specs, vs[n_main:]):
+        name = _hidden_base(hname)
+        f = schema.field(name)
+        cnt = np.asarray(_unshard(cnt))
+        col = np.asarray(out_cols[name])
+        out_cols[name] = jnp.asarray(
+            np.where(cnt > 0, col, col.dtype.type(f.dtype.null_sentinel)))
     return ColumnBatch(schema, out_cols, _unshard(msk), dicts)
 
 
@@ -153,9 +215,11 @@ class MeshAggregateExec(ExecutionPlan):
             if a.func not in ("sum", "count", "min", "max"):
                 return False
             if a.operand is not None:
-                if isinstance(a.operand, E.Column) and a.operand.name in in_schema \
-                        and in_schema.field(a.operand.name).nullable:
-                    return False  # sentinel-skipping not fused yet
+                # nullable operands ARE fused: derive neutralizes NULL rows
+                # per aggregate and hidden valid counts ride the exchange
+                # (_make_derive/_agg_specs); floats stay off the mesh path
+                # (the partial+merge sum order differs from the file path's,
+                # breaking bit-identical results)
                 try:
                     if a.operand.dtype(in_schema).is_float:
                         return False
@@ -199,8 +263,8 @@ class MeshAggregateExec(ExecutionPlan):
         aux = comp.aux_arrays(big.dicts)  # replicated constants in the program
 
         key_names = [n for _, n in key_c]
-        agg_specs = [(a.name, "count" if a.func == "count" else a.func)
-                     for _, a in val_c]
+        specs, hidden = _agg_specs(val_c)
+        agg_specs = specs + hidden
         derive = _make_derive(key_c, val_c, aux)
         cols, mask, padded = _shard_batch(big, mesh, n_dev)
 
@@ -215,22 +279,34 @@ class MeshAggregateExec(ExecutionPlan):
 
         domain = dense_domain(key_ranges)
         if domain is not None:
-            # dense domain bounds groups exactly on both exchange sides
-            partial_cap = min(partial_cap, domain)
-            final_cap = min(final_cap, domain)
-        run = distributed_filter_aggregate(
-            mesh, derive, key_names, agg_specs,
-            partial_capacity=partial_cap, final_capacity=final_cap,
-            key_ranges=key_ranges)
-        fk, fv, fmask, overflow = run(cols, mask)
-        if bool(overflow):
-            raise CapacityError(
-                f"mesh aggregation exceeded its group capacity "
-                f"(partial {partial_cap}/device, final {final_cap}/device); "
-                f"raise {AGG_CAPACITY}")
+            # dense domain: slot-aligned accumulators merge by ONE
+            # psum/pmin/pmax — the exchange disappears entirely
+            # (distributed_dense_aggregate); overflow here can only mean a
+            # key escaped its declared range
+            from ..parallel.distributed import distributed_dense_aggregate
+
+            run = distributed_dense_aggregate(
+                mesh, derive, key_names, agg_specs, key_ranges, domain)
+            fk, fv, fmask, overflow = run(cols, mask)
+            if bool(overflow):
+                raise CapacityError(
+                    "mesh dense aggregation saw keys outside their declared "
+                    "ranges (dictionary/batch mismatch)")
+            self.metrics().add("dense_reduce_collective", 1)
+        else:
+            run = distributed_filter_aggregate(
+                mesh, derive, key_names, agg_specs,
+                partial_capacity=partial_cap, final_capacity=final_cap,
+                key_ranges=key_ranges)
+            fk, fv, fmask, overflow = run(cols, mask)
+            if bool(overflow):
+                raise CapacityError(
+                    f"mesh aggregation exceeded its group capacity "
+                    f"(partial {partial_cap}/device, final {final_cap}/device); "
+                    f"raise {AGG_CAPACITY}")
 
         result = _finish_states(self._schema, key_c, val_c, fk, fv, fmask,
-                                big.dicts)
+                                big.dicts, hidden_specs=hidden)
         self.metrics().add("output_rows", result.num_rows)
         self.metrics().add("mesh_devices", n_dev)
         return [result]
@@ -295,7 +371,8 @@ class MeshPartialAggregateExec(ExecutionPlan):
             aux = comp.aux_arrays(big.dicts)
 
             key_names = [n for _, n in key_c]
-            agg_specs = [(a.name, a.func) for _, a in val_c]
+            specs, hidden = _agg_specs(val_c)
+            agg_specs = specs + hidden
             cols, mask, padded = _shard_batch(big, mesh, n_dev)
 
             cap = ctx.config.get(AGG_CAPACITY)
@@ -328,8 +405,11 @@ class MeshPartialAggregateExec(ExecutionPlan):
                     f"mesh partial aggregation exceeded {per_dev_cap} "
                     f"groups/device; raise {AGG_CAPACITY}")
 
+        # all-NULL partial states become sentinels here, exactly like the
+        # file partial mode — the downstream final aggregate's value-based
+        # null_check then skips them when merging across hosts
         result = _finish_states(self._schema, key_c, val_c, pk, pv, pmask,
-                                big.dicts)
+                                big.dicts, hidden_specs=hidden)
         self.metrics().add("output_rows", result.num_rows)
         self.metrics().add("mesh_devices", n_dev)
         return [result]
@@ -471,34 +551,64 @@ class MeshJoinExec(ExecutionPlan):
         db, dbm, b_rows = shard_side(bcols, bmask_in)
 
         out_factor = ctx.config.get(JOIN_OUTPUT_FACTOR)
-        # per-device shuffle capacity: worst case every row of a side hashes
-        # to one bucket of one device's send buffer; factor 2 covers skew,
-        # overflow re-runs at the true bound
-        shuf_cap = max(64, 2 * max(p_rows, b_rows) // n_dev)
-        # per-device output bound: a device can receive up to n_dev bucket
-        # blocks of shuf_cap rows; fan-out beyond out_factor per probe row
-        # triggers the overflow-retry doubling below
-        out_cap = max(64, out_factor * shuf_cap)
         rfill = {f.name: f.dtype.null_sentinel for f in rsch}
+        sentinel = int(ExprCompiler.NULL_KEY_SENTINEL)
+        broadcast = build.num_rows <= ctx.config.get(MESH_BROADCAST_ROWS)
 
-        attempts = 0
-        while True:
-            run = distributed_hash_join(
-                mesh, len(self.on), list(lsch.names()), list(rsch.names()),
-                self.join_type, shuf_cap, out_cap, rfill,
-                string_key_flags=sflags,
-                null_key_sentinel=int(ExprCompiler.NULL_KEY_SENTINEL))
-            out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
-            if not bool(overflow):
-                break
-            attempts += 1
-            if attempts > 3:
-                raise CapacityError(
-                    "mesh join overflowed its shuffle/output capacity "
-                    f"(shuffle {shuf_cap}, out {out_cap}) after retries")
-            shuf_cap *= 2
-            out_cap *= 2
-            self.metrics().add("capacity_recompiles", 1)
+        if broadcast:
+            # small build side: all_gather it, probe rows never move
+            # (CollectLeft analog, distributed_broadcast_join); output bound
+            # is per-device probe rows x fan-out factor
+            from ..parallel.distributed import distributed_broadcast_join
+
+            out_cap = max(64, out_factor * (p_rows // n_dev))
+            attempts = 0
+            while True:
+                run = distributed_broadcast_join(
+                    mesh, len(self.on), list(lsch.names()), list(rsch.names()),
+                    self.join_type, out_cap, rfill,
+                    string_key_flags=sflags, null_key_sentinel=sentinel)
+                out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
+                if not bool(overflow):
+                    break
+                attempts += 1
+                if attempts > 3:
+                    raise CapacityError(
+                        f"mesh broadcast join overflowed its output capacity "
+                        f"({out_cap}) after retries")
+                out_cap *= 2
+                self.metrics().add("capacity_recompiles", 1)
+            self.metrics().add("broadcast_joins", 1)
+        else:
+            # per-device shuffle capacity: worst case every row of a side
+            # hashes to one bucket of one device's send buffer; factor 2
+            # covers skew, overflow re-runs at the true bound
+            shuf_cap = max(64, 2 * max(p_rows, b_rows) // n_dev)
+            # per-device output bound: start at the EXPECTED per-device probe
+            # share x fan-out factor, not the worst-case receive bound — a
+            # too-small guess recompiles via the overflow-retry doubling, a
+            # too-large one allocates (and gathers into) multi-GB outputs
+            # every run (measured: q3's old 2x-shuffle-capacity bound put a
+            # 24M-row output gather on a 30k-row result)
+            out_cap = max(64, out_factor * (p_rows // n_dev))
+
+            attempts = 0
+            while True:
+                run = distributed_hash_join(
+                    mesh, len(self.on), list(lsch.names()), list(rsch.names()),
+                    self.join_type, shuf_cap, out_cap, rfill,
+                    string_key_flags=sflags, null_key_sentinel=sentinel)
+                out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
+                if not bool(overflow):
+                    break
+                attempts += 1
+                if attempts > 3:
+                    raise CapacityError(
+                        "mesh join overflowed its shuffle/output capacity "
+                        f"(shuffle {shuf_cap}, out {out_cap}) after retries")
+                shuf_cap *= 2
+                out_cap *= 2
+                self.metrics().add("capacity_recompiles", 1)
 
         dicts = dict(probe.dicts)
         if self.join_type in ("inner", "left"):

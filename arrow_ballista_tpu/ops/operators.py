@@ -65,6 +65,32 @@ def _expr_nullable(e: E.Expr, schema: Schema) -> bool:
     )
 
 
+def null_check_of(cc, operand, in_schema: Schema):
+    """Value-based NULL test spec for an aggregate operand: None when no
+    nullable column feeds the operand; else 'string' (dict code < 0) or the
+    computed dtype's in-band sentinel.  The check is VALUE-based — the
+    computed operand equals its dtype's sentinel — so CASE/IS NULL
+    expressions that launder NULLs into real values still count (a
+    ref-based check would wrongly skip those rows).  Shared by the plain
+    and mesh-fused aggregates so their NULL semantics cannot drift."""
+    if cc is None or operand is None:
+        return None
+    refs_nullable = any(n in in_schema and in_schema.field(n).nullable
+                        for n in operand.column_refs())
+    if not refs_nullable:
+        return None
+    return "string" if cc.dtype.is_string else cc.dtype.null_sentinel
+
+
+def valid_of(v, null_check):
+    """Per-row validity under a ``null_check_of`` spec."""
+    if null_check == "string":
+        return v >= 0
+    if isinstance(null_check, float) and null_check != null_check:  # NaN
+        return ~jnp.isnan(v)
+    return v != jnp.asarray(null_check, dtype=v.dtype)
+
+
 class ProjectionExec(ExecutionPlan):
     """Computes output columns; ``host_mode`` runs in numpy float64 (used for
     tiny post-aggregation projections containing division)."""
@@ -347,33 +373,13 @@ class HashAggregateExec(ExecutionPlan):
                     operand = a.operand if a.operand is not None else None
                     how = a.func
                 cc = comp.compile(_substitute_scalars(operand, ctx.scalars)) if operand is not None else None
-                # SQL NULL semantics: aggregates skip NULL inputs.  The
-                # check is VALUE-based — the computed operand equals its
-                # dtype's in-band sentinel — so CASE/IS NULL expressions
-                # that launder NULLs into real values still count (a
-                # ref-based check would wrongly skip those rows).
-                null_check = None
-                if cc is not None and operand is not None:
-                    refs_nullable = any(
-                        n in in_schema and in_schema.field(n).nullable
-                        for n in operand.column_refs())
-                    if cc.dtype.is_string:
-                        if refs_nullable:
-                            null_check = "string"
-                    elif refs_nullable:
-                        null_check = cc.dtype.null_sentinel
+                # SQL NULL semantics: aggregates skip NULL inputs
+                null_check = null_check_of(cc, operand, in_schema)
                 agg_c.append((cc, how, a.name, null_check))
             # nullable sum/min/max also aggregate a hidden per-group valid
             # count, so an all-NULL group can be restored to NULL afterwards
             tracked = [i for i, (cc, how, _, nc) in enumerate(agg_c)
                        if nc is not None and how in ("sum", "min", "max")]
-
-            def _valid_of(v, null_check):
-                if null_check == "string":
-                    return v >= 0
-                if isinstance(null_check, float) and null_check != null_check:
-                    return ~jnp.isnan(v)
-                return v != jnp.asarray(null_check, dtype=v.dtype)
 
             def agg_fn(cols, mask, aux, out_cap, key_ranges):
                 keys = [c.fn(cols, aux) for c, _ in group_c]
@@ -385,7 +391,7 @@ class HashAggregateExec(ExecutionPlan):
                         continue
                     v = cc.fn(cols, aux)
                     if null_check is not None:
-                        valid = _valid_of(v, null_check)
+                        valid = valid_of(v, null_check)
                         valids[i] = valid
                         if how == "count":
                             vals.append((valid.astype(jnp.int64), K.AGG_SUM))

@@ -202,17 +202,26 @@ def table_to_physical(table, schema: Schema):
                 a = pc.fill_null(a, int(f.dtype.null_sentinel))
             cols[f.name] = a.to_numpy(zero_copy_only=False).astype(np.int32)
         elif f.dtype.is_decimal:
-            if arr.null_count:
-                raise ExecutionError(
-                    f"decimal column {f.name} contains NULLs, which have no "
-                    f"in-band representation yet")
-            fl = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
+            # NULLs can't ride the float64 conversion (the int64-min
+            # sentinel exceeds the 2^52 exact range): remember them, fill
+            # with 0 for conversion, then stamp the sentinel back in
+            nulls = None
+            a = arr
+            if a.null_count:
+                if isinstance(a, pa.ChunkedArray):
+                    a = a.combine_chunks()
+                nulls = pc.is_null(a).to_numpy(zero_copy_only=False)
+                a = pc.fill_null(a, 0)
+            fl = a.cast(pa.float64()).to_numpy(zero_copy_only=False)
             scaled = np.round(fl * (10 ** f.dtype.scale))
             if np.any(np.abs(scaled) > 2**52):
                 raise ExecutionError(
                     f"decimal column {f.name} exceeds exact float64 conversion range"
                 )
-            cols[f.name] = scaled.astype(np.int64)
+            out = scaled.astype(np.int64)
+            if nulls is not None:
+                out[nulls] = np.int64(f.dtype.null_sentinel)
+            cols[f.name] = out
         else:
             a = arr
             if a.null_count:

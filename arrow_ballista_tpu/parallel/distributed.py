@@ -104,6 +104,75 @@ def distributed_filter_aggregate(
     return run
 
 
+def distributed_dense_aggregate(
+    mesh: Mesh,
+    filter_fn,
+    key_names: Sequence[str],
+    agg_specs: Sequence[Tuple[str, str]],
+    key_ranges,
+    domain: int,
+    axis: str = PART_AXIS,
+):
+    """Reduce-collective aggregate for dense key domains: every device
+    reduces its row shard into slot-aligned dense states
+    (kernels.dense_group_states — slot d IS key combination d), then the
+    cross-device merge is ONE elementwise ``psum``/``pmin``/``pmax`` per
+    aggregate over ``[domain]``-element arrays.  No all_to_all, no shuffle
+    capacity, no skew sensitivity; the exchanged payload for TPC-H q1 is
+    6 slots x a few aggregates.
+
+    This is the reduce-collective counterpart of the all_to_all exchange in
+    ``distributed_filter_aggregate`` — where the reference's final-agg stage
+    always consumes hash-partitioned shuffle files
+    (ballista/scheduler/src/planner.rs:80-165), a dense domain lets the TPU
+    path replace the exchange with the collective that actually matches the
+    dataflow (an elementwise reduction over aligned accumulators).
+
+    Returns ``run(cols, mask) -> (keys, vals, mask, overflow)`` with
+    REPLICATED outputs of shape ``[domain]`` (groups compacted to the
+    front in ascending fused-key order, matching the sort path's order).
+    """
+
+    def per_shard(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
+        cols, mask = filter_fn(cols, mask)
+        keys = [cols[k] for k in key_names]
+        vals = [(cols[v], how) for v, how in agg_specs]
+        dense_vals, exists_cnt, bad = K.dense_group_states(
+            keys, vals, mask, key_ranges, domain)
+        merged = []
+        for v, (_, how) in zip(dense_vals, agg_specs):
+            if how in ("sum", "count"):
+                merged.append(lax.psum(v, axis))
+            elif how == "min":
+                merged.append(lax.pmin(v, axis))
+            else:
+                merged.append(lax.pmax(v, axis))
+        exists = lax.psum(exists_cnt, axis) > 0
+        bad = lax.psum(bad.astype(jnp.int32), axis) > 0
+        fk, fv, fmask, ovf = K.compact_dense_states(
+            [k.dtype for k in keys], merged, exists, domain, key_ranges,
+            domain)
+        return fk, fv, fmask, ovf | bad
+
+    row = P(axis)
+    rep = P()
+    compiled: Dict[Tuple[str, ...], object] = {}
+
+    def run(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
+        key = tuple(sorted(cols))
+        fn = compiled.get(key)
+        if fn is None:
+            in_specs = ({name: row for name in cols}, row)
+            out_specs = ([rep] * len(key_names), [rep] * len(agg_specs),
+                         rep, rep)
+            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs))
+            compiled[key] = fn
+        return fn(cols, mask)
+
+    return run
+
+
 def distributed_partial_aggregate(
     mesh: Mesh,
     derive_fn,
@@ -149,6 +218,114 @@ def distributed_partial_aggregate(
     return run
 
 
+def _probe_emit(join_type, key_names, sflags, null_key_sentinel, probe_names,
+                build_names, build_fill, out_capacity,
+                p_cols, p_mask, b_cols, b_mask):
+    """Local half of a hash join, shared by the partitioned and broadcast
+    variants: sorted-build + searchsorted-probe + collision re-verification,
+    then emit by join type.  Both sides are already device-local (either
+    shuffled to the bucket owner, or the build side all_gathered)."""
+    rpk = [p_cols[k] for k in key_names]
+    rbk = [b_cols[k] for k in key_names]
+
+    bh_sorted, border, _ = K.build_side_sort(rbk, b_mask)
+    ph = K.hash64(rpk)
+    pi, bp, pair_valid, total = K.probe_join(ph, p_mask, bh_sorted,
+                                             out_capacity)
+    bidx = border[bp]
+    ok = pair_valid & b_mask[bidx]
+    for i, (a, b) in enumerate(zip(rpk, rbk)):
+        ok = ok & (a[pi] == b[bidx])
+        if sflags[i]:
+            ok = ok & (a[pi] != jnp.asarray(null_key_sentinel,
+                                            dtype=a.dtype))
+    ovf_j = total > out_capacity
+
+    if join_type in ("semi", "anti"):
+        hit = K.segment_any(ok, pi, p_mask.shape[0])
+        out_mask = p_mask & (hit if join_type == "semi" else ~hit)
+        out_cols = {m: p_cols[m] for m in probe_names}
+    else:
+        out_cols = {m: p_cols[m][pi] for m in probe_names}
+        out_cols.update({m: b_cols[m][bidx] for m in build_names})
+        out_mask = ok
+        if join_type == "left":
+            hit = K.segment_any(ok, pi, p_mask.shape[0])
+            miss = p_mask & ~hit
+            out_cols = {
+                m: jnp.concatenate([
+                    out_cols[m],
+                    p_cols[m] if m in probe_names else jnp.full(
+                        p_mask.shape[0], build_fill[m], out_cols[m].dtype),
+                ])
+                for m in out_cols
+            }
+            out_mask = jnp.concatenate([out_mask, miss])
+    return out_cols, out_mask, ovf_j
+
+
+def distributed_broadcast_join(
+    mesh: Mesh,
+    n_keys: int,
+    probe_names: Sequence[str],
+    build_names: Sequence[str],
+    join_type: str,
+    out_capacity: int,
+    build_fill: Dict[str, object],
+    string_key_flags: Sequence[bool] = (),
+    null_key_sentinel: int = 0,
+    axis: str = PART_AXIS,
+):
+    """Broadcast hash join: ``all_gather`` the (small) build side onto every
+    device, probe rows never move.  The TPU analog of DataFusion's
+    CollectLeft hash join, which the reference planner leaves
+    un-repartitioned when one side is small (SURVEY §2.5 exchange
+    inventory; reference planner.rs inserts RepartitionExec only around
+    Partitioned-mode joins).
+
+    vs the partitioned variant: no all_to_all, no shuffle-capacity skew
+    risk (a hot key can land every row of both sides on one device there);
+    the build side costs ``n_devices x build_rows`` HBM, so the planner
+    gates this on build-side size (MESH_BROADCAST_ROWS).
+
+    Returns ``run((pcols, pmask), (bcols, bmask))`` like
+    ``distributed_hash_join``; outputs stay probe-sharded.
+    """
+    key_names = [f"__jk{i}" for i in range(n_keys)]
+    sflags = list(string_key_flags) or [False] * n_keys
+
+    def per_shard(pcols, pmask, bcols, bmask):
+        b_all = {k: lax.all_gather(v, axis, tiled=True)
+                 for k, v in bcols.items()}
+        bm_all = lax.all_gather(bmask, axis, tiled=True)
+        out_cols, out_mask, ovf_j = _probe_emit(
+            join_type, key_names, sflags, null_key_sentinel, probe_names,
+            build_names, build_fill, out_capacity,
+            pcols, pmask, b_all, bm_all)
+        overflow = lax.psum(ovf_j.astype(jnp.int32), axis) > 0
+        return out_cols, out_mask, overflow
+
+    row = P(axis)
+    compiled: Dict[Tuple, object] = {}
+
+    def run(probe, build):
+        pcols, pmask = probe
+        bcols, bmask = build
+        sig = (tuple(sorted(pcols)), tuple(sorted(bcols)))
+        fn = compiled.get(sig)
+        if fn is None:
+            in_specs = ({m: row for m in pcols}, row, {m: row for m in bcols}, row)
+            out_names = (list(probe_names) if join_type in ("semi", "anti")
+                         else list(probe_names) + list(build_names))
+            out_specs = ({m: row for m in out_names}, row, P())
+            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs))
+            compiled[sig] = fn
+        return fn(pcols, pmask, bcols, bmask)
+
+    return run
+
+
 def distributed_hash_join(
     mesh: Mesh,
     n_keys: int,
@@ -181,53 +358,30 @@ def distributed_hash_join(
     sflags = list(string_key_flags) or [False] * n_keys
 
     def per_shard(pcols, pmask, bcols, bmask):
-        pk = [pcols[k] for k in key_names]
-        bk = [bcols[k] for k in key_names]
-        # ship rows to their key-hash bucket owner (both sides agree)
-        pdest = K.bucket_of(pk, n)
-        bdest = K.bucket_of(bk, n)
-        p_recv, p_rmask, ovf_p = shuffle_rows(pcols, pdest, pmask, axis, n,
-                                              shuffle_capacity)
-        b_recv, b_rmask, ovf_b = shuffle_rows(bcols, bdest, bmask, axis, n,
-                                              shuffle_capacity)
-        rpk = [p_recv[k] for k in key_names]
-        rbk = [b_recv[k] for k in key_names]
-
-        bh_sorted, border, _ = K.build_side_sort(rbk, b_rmask)
-        ph = K.hash64(rpk)
-        pi, bp, pair_valid, total = K.probe_join(ph, p_rmask, bh_sorted,
-                                                 out_capacity)
-        bidx = border[bp]
-        ok = pair_valid & b_rmask[bidx]
-        for i, (a, b) in enumerate(zip(rpk, rbk)):
-            ok = ok & (a[pi] == b[bidx])
-            if sflags[i]:
-                ok = ok & (a[pi] != jnp.asarray(null_key_sentinel,
-                                                dtype=a.dtype))
-        ovf_j = total > out_capacity
-
-        if join_type in ("semi", "anti"):
-            hit = K.segment_any(ok, pi, p_rmask.shape[0])
-            out_mask = p_rmask & (hit if join_type == "semi" else ~hit)
-            out_cols = {m: p_recv[m] for m in probe_names}
+        if n == 1:
+            # degenerate mesh (single chip): the exchange is an identity —
+            # skip the dispatch/compaction entirely instead of paying for
+            # worst-case send buffers
+            p_recv, p_rmask = pcols, pmask
+            b_recv, b_rmask = bcols, bmask
+            ovf_exchange = jnp.zeros((), bool)
         else:
-            out_cols = {m: p_recv[m][pi] for m in probe_names}
-            out_cols.update({m: b_recv[m][bidx] for m in build_names})
-            out_mask = ok
-            if join_type == "left":
-                hit = K.segment_any(ok, pi, p_rmask.shape[0])
-                miss = p_rmask & ~hit
-                out_cols = {
-                    m: jnp.concatenate([
-                        out_cols[m],
-                        p_recv[m] if m in probe_names else jnp.full(
-                            p_rmask.shape[0], build_fill[m], out_cols[m].dtype),
-                    ])
-                    for m in out_cols
-                }
-                out_mask = jnp.concatenate([out_mask, miss])
+            pk = [pcols[k] for k in key_names]
+            bk = [bcols[k] for k in key_names]
+            # ship rows to their key-hash bucket owner (both sides agree)
+            pdest = K.bucket_of(pk, n)
+            bdest = K.bucket_of(bk, n)
+            p_recv, p_rmask, ovf_p = shuffle_rows(pcols, pdest, pmask, axis,
+                                                  n, shuffle_capacity)
+            b_recv, b_rmask, ovf_b = shuffle_rows(bcols, bdest, bmask, axis,
+                                                  n, shuffle_capacity)
+            ovf_exchange = ovf_p[0] | ovf_b[0]
+        out_cols, out_mask, ovf_j = _probe_emit(
+            join_type, key_names, sflags, null_key_sentinel, probe_names,
+            build_names, build_fill, out_capacity,
+            p_recv, p_rmask, b_recv, b_rmask)
         overflow = lax.psum(
-            (ovf_p[0] | ovf_b[0] | ovf_j).astype(jnp.int32), axis) > 0
+            (ovf_exchange | ovf_j).astype(jnp.int32), axis) > 0
         return out_cols, out_mask, overflow
 
     row = P(axis)

@@ -20,9 +20,16 @@ log = logging.getLogger(__name__)
 class EventLoop:
     def __init__(self, name: str, on_receive: Callable[[object], None],
                  buffer_size: int = 10000,
-                 slow_event_threshold_s: float = 1.0):
+                 slow_event_threshold_s: float = 1.0,
+                 on_error: Optional[Callable[[object, BaseException], None]] = None):
         self.name = name
         self._on_receive = on_receive
+        # on_error: last-resort hook when a handler raises — the loop itself
+        # must survive, but whoever owns the loop may need to fail the
+        # affected job so clients aren't left polling a forever-"running"
+        # status (observed: a repr() crash inside a handler stranded the
+        # job until its deadline)
+        self._on_error = on_error
         self._queue: "queue.Queue[object]" = queue.Queue(maxsize=buffer_size)
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
@@ -56,8 +63,13 @@ class EventLoop:
             t0 = time.monotonic()
             try:
                 self._on_receive(event)
-            except Exception:  # noqa: BLE001 — the loop must survive
-                log.exception("%s: event handler raised on %r", self.name, event)
+            except Exception as exc:  # noqa: BLE001 — the loop must survive
+                log.exception("%s: event handler raised", self.name)
+                if self._on_error is not None:
+                    try:
+                        self._on_error(event, exc)
+                    except Exception:  # noqa: BLE001
+                        log.exception("%s: on_error hook raised", self.name)
             dt = time.monotonic() - t0
             if dt > self.slow_event_threshold_s:
                 # reference slow-event watchdog

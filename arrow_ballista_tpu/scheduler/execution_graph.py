@@ -59,6 +59,10 @@ class TaskInfo:
     partition: int
     executor_id: str
     state: str  # 'running' | 'success'
+    # last TaskStatus for observability: per-operator metrics + launch/end
+    # timestamps survive absorption (reference keeps the full status stream
+    # in ExecutionGraph for the UI's stage metrics)
+    status: object = None
 
 
 class ExecutionStage:
@@ -82,6 +86,21 @@ class ExecutionStage:
         self.task_failures: List[int] = [0] * self.partitions
         # map partition -> (executor_id, [ShuffleWritePartition])
         self.outputs: Dict[int, Tuple[str, List[ShuffleWritePartition]]] = {}
+
+    def aggregate_metrics(self) -> Dict[str, float]:
+        """Fold every completed task's per-operator metrics into one
+        '<op>.<metric>' -> sum dict (consumed by the REST stage view and
+        the bench profiler)."""
+        agg: Dict[str, float] = {}
+        for t in self.task_infos:
+            st = getattr(t, "status", None)
+            if st is None:
+                continue
+            for op, mm in (st.metrics or {}).items():
+                for k, v in mm.items():
+                    kk = f"{op}.{k}"
+                    agg[kk] = agg.get(kk, 0.0) + v
+        return agg
 
     # --- queries ---------------------------------------------------------
     def pending_partitions(self) -> List[int]:
@@ -256,7 +275,7 @@ class ExecutionGraph:
         info = stage.task_infos[p]
         if info is not None and info.state == "success":
             return  # duplicate
-        stage.task_infos[p] = TaskInfo(p, st.executor_id, "success")
+        stage.task_infos[p] = TaskInfo(p, st.executor_id, "success", st)
         stage.outputs[p] = (st.executor_id, list(st.shuffle_writes))
         if stage.all_successful() and stage.state == RUNNING:
             stage.state = SUCCESSFUL

@@ -156,6 +156,7 @@ class RestApi:
         out = []
         for sid in sorted(graph.stages):
             s = graph.stages[sid]
+            agg = {k: round(v, 3) for k, v in s.aggregate_metrics().items()}
             out.append({
                 "stage_id": sid, "state": s.state,
                 "partitions": s.partitions,
@@ -165,5 +166,6 @@ class RestApi:
                 "producers": s.producer_ids,
                 "consumers": s.output_links,
                 "plan": (s.resolved_plan or s.plan).display(),
+                "metrics": agg,
             })
         return out

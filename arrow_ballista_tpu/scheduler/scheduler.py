@@ -146,7 +146,8 @@ class SchedulerServer:
         self.scheduler_id = scheduler_id or f"scheduler-{uuid.uuid4().hex[:8]}"
         self._queued_at_ms: Dict[str, int] = {}
         self._event_loop = EventLoop("scheduler-events", self._on_event,
-                                     self.config.event_buffer_size)
+                                     self.config.event_buffer_size,
+                                     on_error=self._on_event_error)
         self._launch_pool = ThreadPoolExecutor(max_workers=8,
                                                thread_name_prefix="launch")
         self._reaper: Optional[threading.Thread] = None
@@ -226,6 +227,38 @@ class SchedulerServer:
         return sum(g.available_task_count() for g in self.jobs.active_graphs())
 
     # --- event machine ---------------------------------------------------
+    def _on_event_error(self, event: object, exc: BaseException) -> None:
+        """A handler crash must not strand the affected job in 'running'
+        forever — clients poll status, and without this they wait out the
+        full job deadline on a job no handler will ever touch again."""
+        job_ids = set()
+        jid = getattr(event, "job_id", None)
+        if jid:
+            job_ids.add(jid)
+        # TaskUpdating has no job_id field; its affected jobs ride in the
+        # statuses' task ids
+        for st in getattr(event, "statuses", None) or []:
+            task = getattr(st, "task", None)
+            if task is not None and getattr(task, "job_id", None):
+                job_ids.add(task.job_id)
+        for job_id in job_ids:
+            st = self.jobs.get_status(job_id)
+            if st is not None and st.state in ("successful", "failed",
+                                               "cancelled"):
+                continue
+            # stop the graph too, or the scheduler keeps launching its
+            # remaining tasks and a late 'job_successful' event would
+            # overwrite the failed status the client already saw
+            graph = self.jobs.get_graph(job_id)
+            if graph is not None and graph.status == "running":
+                graph.status = "failed"
+            self._queued_at_ms.pop(job_id, None)
+            self.jobs.set_status(JobStatus(
+                job_id, "failed",
+                error=f"scheduler event handler crashed: "
+                      f"{type(exc).__name__}: {exc}"))
+            self.metrics.record_failed(job_id)
+
     def _on_event(self, event: object) -> None:
         if isinstance(event, JobQueued):
             self._on_job_queued(event)

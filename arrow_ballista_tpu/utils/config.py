@@ -27,6 +27,7 @@ JOIN_MAX_CAPACITY = "ballista.join.max_capacity"  # ceiling for adaptive retry
 COLLECT_STATISTICS = "ballista.collect_statistics"
 MESH_SHUFFLE = "ballista.shuffle.mesh"  # use ICI all-to-all when executors co-located on a mesh
 MESH_HYBRID = "ballista.shuffle.mesh.hybrid"  # mesh WITHIN a host, file shuffle ACROSS hosts
+MESH_BROADCAST_ROWS = "ballista.shuffle.mesh.broadcast_rows"  # build side <= this -> all_gather broadcast join
 TASK_SLOTS = "ballista.executor.task_slots"
 BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides smaller skip the shuffle
 JOB_TIMEOUT_S = "ballista.job.timeout.seconds"  # client-side wait_for_job deadline
@@ -65,6 +66,10 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(MESH_SHUFFLE, False, _parse_bool, "use ICI mesh all-to-all shuffle"),
         ConfigEntry(MESH_HYBRID, False, _parse_bool,
                     "hybrid exchange: mesh-fused partials per host, file shuffle across hosts"),
+        ConfigEntry(MESH_BROADCAST_ROWS, 1 << 18, int,
+                    "mesh joins all_gather the build side instead of "
+                    "all_to_all-ing both sides when its live rows fit here "
+                    "(CollectLeft analog)"),
         ConfigEntry(TASK_SLOTS, 4, int, "concurrent task slots per executor"),
         ConfigEntry(BROADCAST_THRESHOLD, 1_000_000, int,
                     "broadcast join build sides with fewer estimated rows"),

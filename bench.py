@@ -103,17 +103,26 @@ def _worker(platform: str) -> None:
 
     t_c = time.perf_counter()
     out = step(cols, mask)  # compile + warmup
-    jax.block_until_ready(out[1])
+    jax.block_until_ready(out)
     detail["kernel_q1_compile_s"] = round(time.perf_counter() - t_c, 1)
     times = []
-    for _ in range(5):
+    for _ in range(10):
         t0 = time.perf_counter()
         out = step(cols, mask)
-        jax.block_until_ready(out[1])
+        jax.block_until_ready(out)  # the WHOLE output tree, not one leaf
         times.append(time.perf_counter() - t0)
-    kernel_rows_s = KERNEL_ROWS / float(np.median(times))
+    med = float(np.median(times))
+    kernel_rows_s = KERNEL_ROWS / med
+    # sanity companion: effective HBM read bandwidth implied by the input
+    # columns alone — if this exceeds the chip's spec the measurement is
+    # wrong, not the kernel fast
+    in_bytes = sum(v.nbytes for v in cols.values()) + mask.nbytes
     detail["kernel_q1_rows_per_sec"] = round(kernel_rows_s, 1)
-    print(f"[worker] kernel q1: {kernel_rows_s/1e6:.1f}M rows/s", file=sys.stderr)
+    detail["kernel_q1_ms"] = round(med * 1000, 3)
+    detail["kernel_q1_gbps"] = round(in_bytes / med / 1e9, 1)
+    print(f"[worker] kernel q1: {kernel_rows_s/1e6:.1f}M rows/s "
+          f"({med*1000:.2f} ms, {in_bytes/med/1e9:.0f} GB/s implied)",
+          file=sys.stderr)
     del cols, mask, out
 
     # --- engine bench: TPC-H through BallistaContext --------------------
@@ -135,6 +144,38 @@ def _worker(platform: str) -> None:
     lineitem_rows = ctx.catalog.provider("lineitem").row_count()
     detail["lineitem_rows"] = lineitem_rows
 
+    def _job_metrics(ctx):
+        """Aggregate per-operator metrics of the most recent job, per stage —
+        every bench run doubles as a profile (the round-2 lesson: a failed
+        run with no metrics tells you nothing about WHERE the time went)."""
+        try:
+            sched = ctx._standalone.scheduler
+            jobs = list(sched.jobs._status)
+            if not jobs:
+                return {}
+            graph = sched.jobs.get_graph(jobs[-1])
+            out = {}
+            for sid in sorted(graph.stages):
+                s = graph.stages[sid]
+                spans = []
+                for t in s.task_infos:
+                    if not t or not t.status:
+                        continue
+                    st = t.status
+                    if st.start_time_ms and st.end_time_ms:
+                        spans.append((st.start_time_ms, st.end_time_ms))
+                entry = {k: round(v, 2)
+                         for k, v in sorted(s.aggregate_metrics().items())
+                         if v >= 0.05}
+                if spans:
+                    entry["stage_wall_s"] = round(
+                        (max(b for _, b in spans) - min(a for a, _ in spans))
+                        / 1000, 2)
+                out[f"stage{sid}"] = entry
+            return out
+        except Exception as e:  # noqa: BLE001 — profiling must never kill a bench
+            return {"error": str(e)}
+
     def run_queries(ctx, queries, label):
         out = {}
         for q in queries:
@@ -148,12 +189,19 @@ def _worker(platform: str) -> None:
                     print(f"[worker] {label} q{q} iter{it}: {per[-1]*1000:.0f} ms "
                           f"({nrows} rows)", file=sys.stderr)
                 out[f"q{q}_ms"] = round(min(per) * 1000, 1)
+                print(f"[worker] {label} q{q} metrics: "
+                      f"{json.dumps(_job_metrics(ctx))}", file=sys.stderr)
             except Exception as e:  # noqa: BLE001 — record, keep benching
                 out[f"q{q}_error"] = f"{type(e).__name__}: {e}"
                 print(f"[worker] {label} q{q} FAILED: {e}", file=sys.stderr)
         return out
 
+    # q3 rides along on BOTH transports so the join paths are comparable
+    # (round-2 gap: the mesh join had zero perf evidence; a mesh-only q3
+    # number answers nothing without the file-path number next to it)
     queries = [int(x) for x in QUERIES.split(",")]
+    if 3 not in queries:
+        queries = queries + [3]
     engine = run_queries(ctx, queries, "file")
     ctx.shutdown()
     detail["engine"] = engine
@@ -171,7 +219,7 @@ def _worker(platform: str) -> None:
         mctx = BallistaContext.standalone(mesh_config, concurrent_tasks=4)
         try:
             register_tables(mctx, DATA_DIR)
-            detail["engine_mesh"] = run_queries(mctx, queries + [3], "mesh")
+            detail["engine_mesh"] = run_queries(mctx, queries, "mesh")
         finally:
             mctx.shutdown()
     except Exception as e:  # noqa: BLE001 — record, keep the file numbers

@@ -4,6 +4,8 @@ The fused program (partial agg -> ICI all_to_all -> final agg as one XLA
 program, ops/mesh_exec.py) must return exactly what the two-stage shuffle
 path returns — the scheduler may pick either transport per stage boundary.
 """
+from decimal import Decimal
+
 import numpy as np
 import pandas as pd
 import pyarrow as pa
@@ -75,6 +77,47 @@ def test_mesh_standalone_cluster(table):
     ctx.shutdown()
 
 
+def test_mesh_nullable_operands_match_file_shuffle():
+    """NULL-bearing measures stay ON the mesh path (derive neutralizes NULL
+    rows per aggregate; hidden valid counts ride the exchange) and produce
+    the same answers as the file path, including all-NULL groups -> NULL."""
+    rng = np.random.default_rng(7)
+    n = 20_000
+    v = rng.integers(-50, 100, n).astype(np.float64)
+    # group 0: every row NULL (exercises the sentinel restore)
+    g = rng.integers(0, 20, n)
+    null_at = (rng.random(n) < 0.3) | (g == 0)
+    table = pa.table({
+        "g": pa.array(g.astype(np.int64)),
+        "v": pa.array([None if m else int(x) for m, x in zip(null_at, v)],
+                      type=pa.int64()),
+        "d": pa.array([None if m else Decimal(int(x)) / 4
+                       for m, x in zip(null_at, v)],
+                      type=pa.decimal128(12, 2)),
+    })
+    mesh_ctx, file_ctx = contexts(table)
+    sql = ("select g, sum(v) as sv, count(v) as cv, min(v) as lo, "
+           "max(v) as hi, sum(d) as sd, count(*) as n "
+           "from t group by g order by g")
+    from arrow_ballista_tpu.ops.mesh_exec import MeshAggregateExec
+    from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+    from arrow_ballista_tpu.scheduler.planner import collect_nodes
+    from arrow_ballista_tpu.sql.optimizer import optimize
+
+    mesh_df = mesh_ctx.sql(sql)
+    planned = PhysicalPlanner(mesh_ctx.catalog, mesh_ctx.config).plan_query(
+        optimize(mesh_df.logical))
+    assert collect_nodes(planned.plan, MeshAggregateExec), \
+        f"nullable operands fell off the mesh path:\n{planned.plan.display()}"
+    got = mesh_df.to_pandas()
+    want = file_ctx.sql(sql).to_pandas()
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+    # group 0 is all-NULL: sum/min/max NULL, count(v) 0
+    row0 = got[got.g == 0].iloc[0]
+    assert pd.isna(row0.sv) and pd.isna(row0.lo) and pd.isna(row0.hi)
+    assert row0.cv == 0 and row0.n > 0
+
+
 # --------------------------------------------------------------------------
 # mesh-fused partitioned join
 # --------------------------------------------------------------------------
@@ -97,12 +140,17 @@ def join_tables():
     return fact, dim
 
 
-def join_contexts(join_tables):
+def join_contexts(join_tables, strategy="broadcast"):
     fact, dim = join_tables
     # broadcast threshold 0 forces the partitioned path on both contexts
     base = {"ballista.shuffle.partitions": "4",
             "ballista.join.broadcast_threshold": "0"}
-    mesh_ctx = BallistaContext.local(BallistaConfig({**base, "ballista.shuffle.mesh": "true"}))
+    mesh_extra = {"ballista.shuffle.mesh": "true"}
+    if strategy == "partitioned":
+        # force both sides through the all_to_all exchange (the 2k-row dim
+        # side would otherwise take the all_gather broadcast path)
+        mesh_extra["ballista.shuffle.mesh.broadcast_rows"] = "0"
+    mesh_ctx = BallistaContext.local(BallistaConfig({**base, **mesh_extra}))
     file_ctx = BallistaContext.local(BallistaConfig(base))
     for c in (mesh_ctx, file_ctx):
         c.register_table("fact", fact)
@@ -123,14 +171,15 @@ JOIN_QUERIES = [
 ]
 
 
+@pytest.mark.parametrize("strategy", ["partitioned", "broadcast"])
 @pytest.mark.parametrize("q", range(len(JOIN_QUERIES)))
-def test_mesh_join_matches_file_shuffle(join_tables, q):
+def test_mesh_join_matches_file_shuffle(join_tables, q, strategy):
     from arrow_ballista_tpu.ops.mesh_exec import MeshJoinExec
     from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
     from arrow_ballista_tpu.scheduler.planner import collect_nodes
     from arrow_ballista_tpu.sql.optimizer import optimize
 
-    mesh_ctx, file_ctx = join_contexts(join_tables)
+    mesh_ctx, file_ctx = join_contexts(join_tables, strategy)
     sql = JOIN_QUERIES[q]
     mesh_df = mesh_ctx.sql(sql)
     planned = PhysicalPlanner(mesh_ctx.catalog, mesh_ctx.config).plan_query(
@@ -143,12 +192,35 @@ def test_mesh_join_matches_file_shuffle(join_tables, q):
     pd.testing.assert_frame_equal(got, want, check_dtype=False)
 
 
-def test_mesh_semi_join_matches(join_tables):
-    mesh_ctx, file_ctx = join_contexts(join_tables)
+@pytest.mark.parametrize("strategy", ["partitioned", "broadcast"])
+def test_mesh_semi_join_matches(join_tables, strategy):
+    mesh_ctx, file_ctx = join_contexts(join_tables, strategy)
     sql = ("select count(*) as n from fact where fk in (select dk from dim)")
     got = mesh_ctx.sql(sql).to_pandas()
     want = file_ctx.sql(sql).to_pandas()
     pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+def test_mesh_broadcast_join_metric(join_tables):
+    """The size gate actually routes small build sides through the
+    all_gather broadcast variant (and the forced-partitioned config does
+    not)."""
+    from arrow_ballista_tpu.ops.mesh_exec import MeshJoinExec
+    from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+    from arrow_ballista_tpu.scheduler.planner import collect_nodes
+    from arrow_ballista_tpu.sql.optimizer import optimize
+    from arrow_ballista_tpu.ops.physical import TaskContext
+
+    for strategy, want_broadcast in (("broadcast", 1), ("partitioned", 0)):
+        mesh_ctx, _ = join_contexts(join_tables, strategy)
+        df = mesh_ctx.sql(JOIN_QUERIES[0])
+        planned = PhysicalPlanner(mesh_ctx.catalog, mesh_ctx.config).plan_query(
+            optimize(df.logical))
+        joins = collect_nodes(planned.plan, MeshJoinExec)
+        assert joins
+        for p in range(planned.plan.output_partition_count()):
+            planned.plan.execute(p, TaskContext(mesh_ctx.config))
+        got = joins[0].metrics().values.get("broadcast_joins", 0)
+        assert got == want_broadcast, (strategy, got)
 
 
 # --------------------------------------------------------------------------

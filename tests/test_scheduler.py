@@ -188,6 +188,41 @@ def test_job_queued_until_executor_registers():
     server.shutdown()
 
 
+def test_event_handler_crash_fails_job():
+    """A crash INSIDE an event handler must fail the affected job, not
+    strand it in 'running' until the deadline (EventLoop on_error hook)."""
+    server, _ = scheduler_test()
+
+    def exploding_handler(ev):
+        raise RuntimeError("handler exploded")
+
+    server._on_job_planned = exploding_handler
+    server.submit_job("boom2", lambda: (physical_plan(), {}))
+    status = server.wait_for_job("boom2", 10)
+    assert status.state == "failed"
+    assert "handler exploded" in status.error
+    server.shutdown()
+
+
+def test_task_updating_handler_crash_fails_job():
+    """TaskUpdating events carry no job_id field — the on_error hook must
+    recover the affected jobs from the statuses' task ids, and stop the
+    graph so no late event resurrects the job."""
+    server, _ = scheduler_test()
+
+    def exploding_handler(ev):
+        raise RuntimeError("status intake exploded")
+
+    server._on_task_updating = exploding_handler
+    server.submit_job("boom3", lambda: (physical_plan(), {}))
+    status = server.wait_for_job("boom3", 10)
+    assert status.state == "failed"
+    assert "status intake exploded" in status.error
+    graph = server.jobs.get_graph("boom3")
+    assert graph is not None and graph.status == "failed"
+    server.shutdown()
+
+
 def test_planning_failure_fails_job():
     def exploding_plan():
         raise RuntimeError("ExplodingTableProvider")  # test_utils.rs:71-103
