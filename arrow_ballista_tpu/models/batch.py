@@ -25,10 +25,12 @@ with schema + dictionaries.
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from .schema import Schema
@@ -109,10 +111,15 @@ class ColumnBatch:
                     "(e.g. scaled int64 for decimals)"
                 )
             arr = raw.astype(f.dtype.np_dtype, copy=False)
-            cols[f.name] = jnp.asarray(_pad_to(arr, cap))
+            cols[f.name] = _pad_to(arr, cap)
         mask = np.zeros(cap, dtype=np.bool_)
         mask[:n] = True
-        return ColumnBatch(schema, cols, jnp.asarray(mask), dicts, num_rows=n)
+        # ONE transfer call for the whole batch: per-column jnp.asarray would
+        # pay a host->device dispatch round-trip per column, which dominates
+        # on remote-attached accelerators (the axon tunnel) and adds up on
+        # PCIe too
+        cols, mask = jax.device_put((cols, mask))
+        return ColumnBatch(schema, cols, mask, dicts, num_rows=n)
 
     @staticmethod
     def empty(schema: Schema, capacity: int = 1024) -> "ColumnBatch":
@@ -152,21 +159,17 @@ class ColumnBatch:
         target = round_capacity(n)
         if target >= self.capacity:
             return self
-        from ..ops.kernels import compaction_order
-
-        order = compaction_order(self.mask)[:target]
-        cols = {k: v[order] for k, v in self.columns.items()}
-        mask = self.mask[order]
+        cols, mask = _shrink_device(self.columns, self.mask, target)
         return ColumnBatch(self.schema, cols, mask, self.dicts, num_rows=n)
 
     # --- host materialization ------------------------------------------
     def compacted_numpy(self) -> Dict[str, np.ndarray]:
-        """Return host numpy columns containing only live rows, in order."""
-        mask = np.asarray(self.mask)
-        out = {}
-        for f in self.schema:
-            out[f.name] = np.asarray(self.columns[f.name])[mask]
-        return out
+        """Return host numpy columns containing only live rows, in order.
+        One device->host transfer call for the whole batch (per-column
+        np.asarray would pay a dispatch round-trip per column)."""
+        cols, mask = jax.device_get(
+            ({f.name: self.columns[f.name] for f in self.schema}, self.mask))
+        return {k: v[mask] for k, v in cols.items()}
 
     def to_arrow(self):
         """Decode to a pyarrow Table with logical types restored: strings from
@@ -296,19 +299,58 @@ def concat_batches(schema: Schema, batches: Sequence[ColumnBatch], capacity: Opt
     if len(batches) == 1 and (capacity is None or batches[0].capacity == capacity):
         return batches[0]
     batches = _unify_string_dicts(schema, batches)
-    cols = {f.name: jnp.concatenate([b.columns[f.name] for b in batches]) for f in schema}
-    mask = jnp.concatenate([b.mask for b in batches])
-    total_cap = int(mask.shape[0])
+    total_cap = sum(b.capacity for b in batches)
     if capacity is not None and capacity < total_cap:
         raise ValueError(
             f"requested capacity {capacity} < combined batch capacity {total_cap}; "
             "compact batches before concatenating to a smaller shape"
         )
-    if capacity is not None and capacity > total_cap:
-        pad = capacity - total_cap
-        cols = {k: jnp.concatenate([v, jnp.zeros(pad, dtype=v.dtype)]) for k, v in cols.items()}
-        mask = jnp.concatenate([mask, jnp.zeros(pad, dtype=jnp.bool_)])
+    pad = (capacity - total_cap) if capacity is not None else 0
+    cols_list = [{f.name: b.columns[f.name] for f in schema} for b in batches]
+    mask_list = [b.mask for b in batches]
+    if len({b.capacity for b in batches}) == 1:
+        # one fused dispatch for the whole concat (vs one eager op per
+        # column: each eager op is a device dispatch round-trip — ruinous
+        # over a remote-accelerator tunnel).  Gated on equal capacities so
+        # the jit cache keys on (count, capacity, pad) only — mixed-capacity
+        # sequences would compile one program per ORDERED capacity tuple,
+        # trading transfer latency for compile stalls on the slow-compile
+        # TPU backend.
+        cols, mask = _concat_device(cols_list, mask_list, pad)
+    else:
+        cols = {}
+        for f in schema:
+            parts = [c[f.name] for c in cols_list]
+            if pad:
+                parts.append(jnp.zeros(pad, dtype=parts[0].dtype))
+            cols[f.name] = jnp.concatenate(parts)
+        mparts = mask_list + ([jnp.zeros(pad, dtype=jnp.bool_)] if pad else [])
+        mask = jnp.concatenate(mparts)
     dicts = {}
     for b in batches:
         dicts.update(b.dicts)
     return ColumnBatch(schema, cols, mask, dicts)
+
+
+@functools.partial(jax.jit, static_argnames=("pad",))
+def _concat_device(cols_list, mask_list, pad: int):
+    names = cols_list[0].keys()
+    cols = {}
+    for k in names:
+        parts = [c[k] for c in cols_list]
+        if pad:
+            parts.append(jnp.zeros(pad, dtype=parts[0].dtype))
+        cols[k] = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    mparts = list(mask_list)
+    if pad:
+        mparts.append(jnp.zeros(pad, dtype=jnp.bool_))
+    mask = jnp.concatenate(mparts) if len(mparts) > 1 else mparts[0]
+    return cols, mask
+
+
+@functools.partial(jax.jit, static_argnames=("target",))
+def _shrink_device(cols, mask, target: int):
+    from ..ops.kernels import compaction_order
+
+    order = compaction_order(mask)[:target]
+    return {k: v[order] for k, v in cols.items()}, mask[order]

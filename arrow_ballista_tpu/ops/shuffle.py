@@ -134,13 +134,15 @@ class ShuffleWriterExec(ExecutionPlan):
             comp, bfn = self._compiled
             with self.metrics().timer("repart_time"):
                 aux = comp.aux_arrays(big.dicts)
-                buckets = np.asarray(bfn(big.columns, big.mask, aux))
-                mask_np = np.asarray(big.mask)
+                # ONE device->host transfer for buckets+mask+columns (a
+                # per-array np.asarray pays one dispatch round-trip each —
+                # ruinous over a remote-accelerator tunnel)
+                buckets, mask_np, host_cols = jax.device_get(
+                    (bfn(big.columns, big.mask, aux), big.mask, big.columns))
                 tagged = np.where(mask_np, buckets, num_out)
                 order = np.argsort(tagged, kind="stable")
                 counts = np.bincount(tagged, minlength=num_out + 1)[:num_out]
-                host_cols = {k: np.asarray(v)[order]
-                             for k, v in big.columns.items()}
+                host_cols = {k: v[order] for k, v in host_cols.items()}
             offsets = np.concatenate([[0], np.cumsum(counts)])
             out: List[ShuffleWritePartition] = []
             with self.metrics().timer("write_time"):
