@@ -318,22 +318,14 @@ def concat_batches(schema: Schema, batches: Sequence[ColumnBatch], capacity: Opt
         # TPU backend.
         cols, mask = _concat_device(cols_list, mask_list, pad)
     else:
-        cols = {}
-        for f in schema:
-            parts = [c[f.name] for c in cols_list]
-            if pad:
-                parts.append(jnp.zeros(pad, dtype=parts[0].dtype))
-            cols[f.name] = jnp.concatenate(parts)
-        mparts = mask_list + ([jnp.zeros(pad, dtype=jnp.bool_)] if pad else [])
-        mask = jnp.concatenate(mparts)
+        cols, mask = _concat_impl(cols_list, mask_list, pad)  # eager
     dicts = {}
     for b in batches:
         dicts.update(b.dicts)
     return ColumnBatch(schema, cols, mask, dicts)
 
 
-@functools.partial(jax.jit, static_argnames=("pad",))
-def _concat_device(cols_list, mask_list, pad: int):
+def _concat_impl(cols_list, mask_list, pad: int):
     names = cols_list[0].keys()
     cols = {}
     for k in names:
@@ -346,6 +338,9 @@ def _concat_device(cols_list, mask_list, pad: int):
         mparts.append(jnp.zeros(pad, dtype=jnp.bool_))
     mask = jnp.concatenate(mparts) if len(mparts) > 1 else mparts[0]
     return cols, mask
+
+
+_concat_device = functools.partial(jax.jit, static_argnames=("pad",))(_concat_impl)
 
 
 @functools.partial(jax.jit, static_argnames=("target",))

@@ -58,10 +58,44 @@ class PhysicalPlanner:
         self.config = config
         self._scalars: List[Tuple[str, ExecutionPlan]] = []
         self._scalar_seq = 0
+        self._partitions: Optional[int] = None
+
+    @property
+    def partitions(self) -> int:
+        """Effective shuffle partition count.  'auto' (0) derives it from
+        the largest scanned table so each task's batch stays near the
+        configured batch capacity — the memory-control heuristic the
+        reference leaves as TODOs (HBM is small; partition counts are how
+        a static-shape engine bounds per-task footprint)."""
+        if self._partitions is None:
+            self._partitions = self.config.shuffle_partitions or 8
+        return self._partitions
+
+    def _resolve_auto_partitions(self, logical: L.LogicalPlan) -> None:
+        if self.config.shuffle_partitions != 0:
+            self._partitions = self.config.shuffle_partitions
+            return
+        target = max(1, self.config.batch_size)
+        rows = 0
+
+        def walk(node: L.LogicalPlan):
+            nonlocal rows
+            if isinstance(node, L.TableScan):
+                try:
+                    rc = self.catalog.provider(node.table).row_count()
+                except Exception:  # noqa: BLE001 — stats are best-effort
+                    rc = None
+                rows = max(rows, rc or 0)
+            for c in node.children():
+                walk(c)
+
+        walk(logical)
+        self._partitions = min(64, max(1, -(-rows // target))) if rows else 8
 
     # --- entry ----------------------------------------------------------
     def plan_query(self, logical: L.LogicalPlan) -> PlannedQuery:
         self._scalars = []
+        self._resolve_auto_partitions(logical)
         plan = self.create(logical)
         return PlannedQuery(plan, list(self._scalars))
 
@@ -69,7 +103,7 @@ class PhysicalPlanner:
         if isinstance(node, L.TableScan):
             provider = self.catalog.provider(node.table)
             filters = [self._prep_expr(f) for f in node.filters]
-            return provider.scan(node.projection, filters, self.config.shuffle_partitions)
+            return provider.scan(node.projection, filters, self.partitions)
 
         if isinstance(node, L.SubqueryAlias):
             child = self.create(node.input)
@@ -173,7 +207,7 @@ class PhysicalPlanner:
                     exchange = RepartitionExec(
                         partial,
                         Partitioning.hash(key_exprs,
-                                          self.config.shuffle_partitions))
+                                          self.partitions))
                     final_groups = [(E.Column(n), n) for _, n in groups]
                     return O.HashAggregateExec(exchange, final_groups, specs,
                                                mode="final")
@@ -183,7 +217,7 @@ class PhysicalPlanner:
         if groups:
             key_exprs = tuple(E.Column(n) for _, n in groups)
             exchange = RepartitionExec(
-                partial, Partitioning.hash(key_exprs, self.config.shuffle_partitions)
+                partial, Partitioning.hash(key_exprs, self.partitions)
             )
         else:
             exchange = RepartitionExec(partial, Partitioning.single())
@@ -231,7 +265,7 @@ class PhysicalPlanner:
                                      left.schema, right.schema):
                 return MeshJoinExec(left, right, on, node.join_type)
 
-        p = self.config.shuffle_partitions
+        p = self.partitions
         lkeys = tuple(l for l, _ in on)
         rkeys = tuple(r for _, r in on)
         lpart = RepartitionExec(left, Partitioning.hash(lkeys, p))

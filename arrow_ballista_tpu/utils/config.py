@@ -49,10 +49,24 @@ def _parse_bool(s: str) -> bool:
     raise ValueError(f"not a bool: {s!r}")
 
 
+def _parse_partitions(s) -> int:
+    """Shuffle partition count; 0 means 'auto' (derived at plan time from
+    input row counts — the memory-control heuristic the reference leaves as
+    a TODO grid, SURVEY §7 hard-parts)."""
+    if str(s).lower() == "auto":
+        return 0
+    n = int(s)
+    if n < 0:
+        raise ValueError(f"partition count must be >= 0: {s!r}")
+    return n
+
+
 _ENTRIES: Dict[str, ConfigEntry] = {
     e.key: e
     for e in [
-        ConfigEntry(SHUFFLE_PARTITIONS, 16, int, "number of output partitions for shuffles"),
+        ConfigEntry(SHUFFLE_PARTITIONS, 16, _parse_partitions,
+                    "number of output partitions for shuffles, or 'auto' to "
+                    "derive from input row counts at plan time"),
         ConfigEntry(BATCH_SIZE, 1 << 17, int, "static row capacity of a device ColumnBatch"),
         ConfigEntry(JOB_NAME, "", str, "human-readable job name"),
         ConfigEntry(REPARTITION_JOINS, True, _parse_bool, ""),

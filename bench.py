@@ -250,32 +250,67 @@ def _attempt(platform: str, timeout: int, tag: str = ""):
     """Run one worker subprocess.  The FULL stdout/stderr is persisted to a
     log file win or lose (round-2 failure mode: only a 1500-char tail
     survived, losing the TPU kernel number that printed before the engine
-    bench died)."""
+    bench died).
+
+    Backend-init watchdog: the experimental TPU plugin's tunnel grant can
+    wedge for an hour+ (observed), hanging jax.devices() with zero CPU.
+    The worker prints '[worker] backend up' the moment the backend exists;
+    if that marker hasn't appeared within BENCH_INIT_TIMEOUT the attempt
+    is killed early so a wedged tunnel can't eat the whole bench budget —
+    the CPU fallback still produces a number."""
     env = dict(os.environ) if platform == "tpu" else _cpu_env()
     os.makedirs(LOG_DIR, exist_ok=True)
-    log_path = os.path.join(LOG_DIR, f"attempt-{int(time.time())}-{platform}{tag}.log")
+    stamp = int(time.time())
+    log_path = os.path.join(LOG_DIR, f"attempt-{stamp}-{platform}{tag}.log")
+    out_path = log_path + ".stdout"
+    err_path = log_path + ".stderr"
+    init_timeout = int(os.environ.get("BENCH_INIT_TIMEOUT", "900"))
     t0 = time.time()
-    timed_out = False
-    try:
-        proc = subprocess.run(
+    timed_out = None
+    with open(out_path, "w") as out_fh, open(err_path, "w") as err_fh:
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
              "--platform", platform],
-            cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO, env=env, stdout=out_fh, stderr=err_fh, text=True,
         )
-        stdout, stderr, rc = proc.stdout, proc.stderr, proc.returncode
-    except subprocess.TimeoutExpired as e:
-        stdout = (e.stdout or b"").decode("utf-8", "replace") \
-            if isinstance(e.stdout, bytes) else (e.stdout or "")
-        stderr = (e.stderr or b"").decode("utf-8", "replace") \
-            if isinstance(e.stderr, bytes) else (e.stderr or "")
-        rc, timed_out = -1, True
+        backend_up = platform != "tpu"
+        while proc.poll() is None:
+            time.sleep(5)
+            elapsed = time.time() - t0
+            if not backend_up:
+                try:
+                    with open(err_path) as fh:
+                        backend_up = "backend up" in fh.read(65536)
+                except OSError:
+                    pass
+            if not backend_up and elapsed > init_timeout:
+                timed_out = f"backend init exceeded {init_timeout}s"
+                break
+            if elapsed > timeout:
+                timed_out = f"attempt exceeded {timeout}s"
+                break
+        if timed_out is not None:
+            proc.kill()
+            proc.wait()
+    rc = -1 if timed_out else proc.returncode
+    # errors='replace': a kill can truncate mid multi-byte character, and a
+    # decode crash here would abort the bench instead of falling back
+    with open(out_path, errors="replace") as fh:
+        stdout = fh.read()
+    with open(err_path, errors="replace") as fh:
+        stderr = fh.read()
     with open(log_path, "w") as fh:
         fh.write(f"# platform={platform} rc={rc} wall={time.time()-t0:.0f}s "
                  f"timed_out={timed_out}\n--- stdout ---\n{stdout}\n"
                  f"--- stderr ---\n{stderr}\n")
+    for p in (out_path, err_path):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
     print(f"[bench] full log: {log_path}", file=sys.stderr)
     if timed_out:
-        print(f"[bench] {platform} attempt timed out after {timeout}s", file=sys.stderr)
+        print(f"[bench] {platform} attempt killed: {timed_out}", file=sys.stderr)
         return None
     sys.stderr.write(stderr[-4000:])
     if rc != 0:
