@@ -80,6 +80,40 @@ def _worker(platform: str) -> None:
 
     detail: dict = {"platform": dev.platform, "device": str(dev.device_kind)}
 
+    # --- platform characterization: the constants needed to interpret the
+    # engine numbers (the device may sit across a network tunnel where
+    # per-op latency, not FLOPs, dominates) -----------------------------
+    def _med(f, n=5):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    small = np.zeros(128, np.int32)
+    big = np.zeros(8 << 20, np.int64)  # 64 MB
+    d_small = jax.device_put(small)
+    jax.block_until_ready(d_small)
+    tiny = jax.jit(lambda x: x + 1)
+    jax.block_until_ready(tiny(d_small))
+    rtt = _med(lambda: jax.block_until_ready(tiny(d_small)))
+    h2d = _med(lambda: jax.block_until_ready(jax.device_put(big)), 3)
+    # d2h must use a FRESH device array per iteration: ArrayImpl caches the
+    # first host copy (_npy_value), so re-reading the same array measures a
+    # cache hit, not the transfer
+    d_bigs = [jax.device_put(tiny(jax.device_put(big))) for _ in range(3)]
+    jax.block_until_ready(d_bigs)
+    it = iter(d_bigs)
+    d2h = _med(lambda: np.asarray(next(it)), 3)
+    detail["platform_rtt_ms"] = round(rtt * 1000, 2)
+    detail["platform_h2d_gbps"] = round(big.nbytes / h2d / 1e9, 2)
+    detail["platform_d2h_gbps"] = round(big.nbytes / d2h / 1e9, 2)
+    print(f"[worker] platform: rtt {rtt*1000:.2f} ms, "
+          f"h2d {big.nbytes/h2d/1e9:.2f} GB/s, d2h {big.nbytes/d2h/1e9:.2f} GB/s",
+          file=sys.stderr)
+    del d_bigs, big
+
     # --- kernel microbench ---------------------------------------------
     sys.path.insert(0, REPO)
     from __graft_entry__ import _q1_augment, _q1_example, _q1_filter, _Q1_AGGS, _Q1_KEYS
@@ -105,13 +139,8 @@ def _worker(platform: str) -> None:
     out = step(cols, mask)  # compile + warmup
     jax.block_until_ready(out)
     detail["kernel_q1_compile_s"] = round(time.perf_counter() - t_c, 1)
-    times = []
-    for _ in range(10):
-        t0 = time.perf_counter()
-        out = step(cols, mask)
-        jax.block_until_ready(out)  # the WHOLE output tree, not one leaf
-        times.append(time.perf_counter() - t0)
-    med = float(np.median(times))
+    # block on the WHOLE output tree, not one leaf
+    med = _med(lambda: jax.block_until_ready(step(cols, mask)), 10)
     kernel_rows_s = KERNEL_ROWS / med
     # sanity companion: effective HBM read bandwidth implied by the input
     # columns alone — if this exceeds the chip's spec the measurement is
