@@ -137,3 +137,34 @@ def test_plugin_dir_loading(tmp_path, table):
         assert got["s"].iloc[0] == pytest.approx(want)
     finally:
         GLOBAL_UDFS.deregister("halve")
+
+
+def test_udf_inside_mesh_fused_aggregate(udfs, table):
+    """UDF operands compile into the mesh-fused aggregate program (the
+    derive stage runs inside shard_map), and results match the file path."""
+    udfs("sq", lambda x: x * x, INT64, arg_count=1)
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.ops.mesh_exec import MeshAggregateExec
+    from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+    from arrow_ballista_tpu.scheduler.planner import collect_nodes
+    from arrow_ballista_tpu.sql.optimizer import optimize
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    sql = "SELECT k, SUM(sq(v)) AS s FROM t GROUP BY k ORDER BY k"
+    mesh_ctx = BallistaContext.local(BallistaConfig({"ballista.shuffle.mesh": "true"}))
+    file_ctx = BallistaContext.local()
+    try:
+        for c in (mesh_ctx, file_ctx):
+            c.register_table("t", table)
+        df = mesh_ctx.sql(sql)
+        planned = PhysicalPlanner(mesh_ctx.catalog, mesh_ctx.config).plan_query(
+            optimize(df.logical))
+        assert collect_nodes(planned.plan, MeshAggregateExec), \
+            f"UDF operand fell off the mesh path:\n{planned.plan.display()}"
+        got = df.to_pandas()
+        want = file_ctx.sql(sql).to_pandas()
+        pd.testing.assert_frame_equal(got, want, check_dtype=False)
+    finally:
+        mesh_ctx.shutdown()
+        file_ctx.shutdown()
