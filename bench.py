@@ -154,21 +154,59 @@ def _worker(platform: str) -> None:
           file=sys.stderr)
     del cols, mask, out
 
+    # --- kernel: join shape (sorted-build + searchsorted probe) ---------
+    # evidences the device join path: the build argsort is the one program
+    # family measured to compile slowly on this backend, so compile time is
+    # reported separately from steady-state
+    rngj = np.random.default_rng(11)
+    n_probe, n_build = KERNEL_ROWS // 2, KERNEL_ROWS // 8
+    pk = jax.device_put(jnp.asarray(
+        rngj.integers(0, n_build * 2, n_probe).astype(np.int64)))
+    bk = jax.device_put(jnp.asarray(np.arange(n_build, dtype=np.int64)))
+    pmask_j = jax.device_put(jnp.ones(n_probe, bool))
+    bmask_j = jax.device_put(jnp.ones(n_build, bool))
+    out_cap = n_probe
+
+    @jax.jit
+    def join_step(pk, bk, pmask, bmask):
+        bh_sorted, border, _ = K.build_side_sort([bk], bmask)
+        ph = K.hash64([pk])
+        pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
+        bidx = border[bp]
+        ok = pair_valid & bmask[bidx] & (pk[pi] == bk[bidx])
+        return jnp.sum(ok), total
+
+    t_c = time.perf_counter()
+    jax.block_until_ready(join_step(pk, bk, pmask_j, bmask_j))
+    detail["kernel_join_compile_s"] = round(time.perf_counter() - t_c, 1)
+    medj = _med(lambda: jax.block_until_ready(join_step(pk, bk, pmask_j, bmask_j)))
+    detail["kernel_join_rows_per_sec"] = round(n_probe / medj, 1)
+    detail["kernel_join_ms"] = round(medj * 1000, 3)
+    print(f"[worker] kernel join: {n_probe/medj/1e6:.1f}M probe rows/s "
+          f"({medj*1000:.2f} ms, compile {detail['kernel_join_compile_s']}s)",
+          file=sys.stderr)
+    del pk, bk, pmask_j, bmask_j
+
     # --- engine bench: TPC-H through BallistaContext --------------------
     from arrow_ballista_tpu.client.context import BallistaContext
     from arrow_ballista_tpu.utils.config import BallistaConfig
     from benchmarks.queries import QUERIES as SQL
     from benchmarks.tpch import register_tables
 
-    config = BallistaConfig({
-        "ballista.shuffle.partitions": "8",
+    # ONE base config shared by the file and mesh runs so the two transports
+    # stay knob-for-knob comparable
+    base_config = {
+        # auto -> ceil(rows/batch) partitions; measured best on SF1 (6 for
+        # the 12-row-group lineitem: 2 row groups per scan task)
+        "ballista.shuffle.partitions": "auto",
         "ballista.batch.size": str(1 << 20),
         # engine deadline: generous (slow first-compile runs must finish) but
         # below the parent's subprocess timeout so the engine fails first
         # with a real error instead of a SIGKILL
         "ballista.job.timeout.seconds": "1800",
-    })
-    ctx = BallistaContext.standalone(config, concurrent_tasks=4)
+    }
+    ctx = BallistaContext.standalone(BallistaConfig(dict(base_config)),
+                                     concurrent_tasks=4)
     register_tables(ctx, DATA_DIR)
     lineitem_rows = ctx.catalog.provider("lineitem").row_count()
     detail["lineitem_rows"] = lineitem_rows
@@ -239,12 +277,8 @@ def _worker(platform: str) -> None:
     # guarded end to end: a mesh-path failure must never discard the file
     # numbers already measured above
     try:
-        mesh_config = BallistaConfig({
-            "ballista.shuffle.partitions": "8",
-            "ballista.batch.size": str(1 << 20),
-            "ballista.job.timeout.seconds": "1800",
-            "ballista.shuffle.mesh": "true",
-        })
+        mesh_config = BallistaConfig(
+            {**base_config, "ballista.shuffle.mesh": "true"})
         mctx = BallistaContext.standalone(mesh_config, concurrent_tasks=4)
         try:
             register_tables(mctx, DATA_DIR)
@@ -264,6 +298,10 @@ def _worker(platform: str) -> None:
         "vs_baseline": round(value / BASELINE_ROWS_PER_S, 4),
         **detail,
     }
+    if not q1_s:
+        # a 0.0 headline must be distinguishable from a measured zero
+        result["error"] = ("q1 not measured: " +
+                           engine.get("q1_error", "not in BENCH_QUERIES"))
     print(json.dumps(result))
 
 
