@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import datetime
 import re
+import threading
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -140,6 +141,7 @@ class ExprCompiler:
         self.xp = jnp if mode == "device" else np
         self.aux_builders: Dict[str, Callable] = {}
         self._aux_cache: Dict = {}
+        self._aux_lock = threading.Lock()
         self._n = 0
 
     # --- public ---------------------------------------------------------
@@ -188,18 +190,22 @@ class ExprCompiler:
     def aux_arrays(self, dicts: Dict[str, np.ndarray]) -> Dict[str, object]:
         """build_aux + device upload, memoized on dictionary identity (scans
         share one dictionary across all their batches, so LIKE/regex LUTs are
-        computed and uploaded once per operator, not per batch)."""
+        computed and uploaded once per operator, not per batch).  Locked:
+        concurrent same-stage tasks call this outside the operator's
+        xla_lock, and an unguarded miss would rebuild + re-upload the LUTs
+        per task (or clear() away a neighbour's fresh entry)."""
         key = tuple(sorted((k, id(v)) for k, v in dicts.items()))
-        hit = self._aux_cache.get(key)
-        if hit is None:
-            raw = self.build_aux(dicts)
-            if self.mode == "device":
-                hit = {k: jnp.asarray(v) for k, v in raw.items()}
-            else:
-                hit = raw
-            if len(self._aux_cache) > 64:
-                self._aux_cache.clear()
-            self._aux_cache[key] = hit
+        with self._aux_lock:
+            hit = self._aux_cache.get(key)
+            if hit is None:
+                raw = self.build_aux(dicts)
+                if self.mode == "device":
+                    hit = {k: jnp.asarray(v) for k, v in raw.items()}
+                else:
+                    hit = raw
+                if len(self._aux_cache) > 64:
+                    self._aux_cache.clear()
+                self._aux_cache[key] = hit
         return hit
 
     # --- helpers --------------------------------------------------------

@@ -356,10 +356,16 @@ class HashAggregateExec(ExecutionPlan):
         in_schema = self.input.schema
         big = concat_batches(in_schema, batches).shrink()
 
+        # lock covers ONLY the compiled-closure build: concurrent tasks
+        # must not race the lazy build (N duplicate jit objects = N
+        # compiles), but dispatch+sync run outside so one task's transfer
+        # overlaps another's device compute; jax's own jit cache dedupes
+        # concurrent first-calls of the shared jfn
         with self.xla_lock():
-            return self._execute_locked(ctx, cfg_cap, in_schema, big)
+            self._ensure_compiled(ctx, in_schema)
+        return self._execute_device(ctx, cfg_cap, big)
 
-    def _execute_locked(self, ctx, cfg_cap, in_schema, big):
+    def _ensure_compiled(self, ctx, in_schema):
         if self._compiled is None:
             comp = ExprCompiler(in_schema, "device")
             group_c = [(comp.compile(_substitute_scalars(e, ctx.scalars)), n)
@@ -411,6 +417,7 @@ class HashAggregateExec(ExecutionPlan):
             self._compiled = (comp, group_c, agg_c, tracked,
                               jax.jit(agg_fn, static_argnums=(3, 4)))
 
+    def _execute_device(self, ctx, cfg_cap, big):
         comp, group_c, agg_c, tracked, jfn = self._compiled
         # static key ranges enable the dense (sort-free) grouping path:
         # dictionary-coded strings have host-known code ranges, bools are
@@ -559,10 +566,14 @@ class JoinExec(ExecutionPlan):
         lsch, rsch = self.left.schema, self.right.schema
         out_factor = ctx.config.get(JOIN_OUTPUT_FACTOR)
 
+        # lock covers only the jit-closure build (see HashAggregateExec):
+        # concurrent reduce tasks dispatch outside it so transfers overlap
+        # device compute
         with self.xla_lock():
-            return self._join_locked(ctx, probe, build, lsch, rsch, out_factor)
+            self._ensure_compiled(ctx, lsch, rsch)
+        return self._join_device(ctx, probe, build, lsch, rsch, out_factor)
 
-    def _join_locked(self, ctx, probe, build, lsch, rsch, out_factor):
+    def _ensure_compiled(self, ctx, lsch, rsch):
         if self._compiled is None:
             lcomp = ExprCompiler(lsch, "device")
             rcomp = ExprCompiler(rsch, "device")
@@ -653,6 +664,8 @@ class JoinExec(ExecutionPlan):
                 return out_cols, out_mask, total
 
             self._compiled = (lcomp, rcomp, fcomp, jax.jit(join_fn, static_argnums=(7,)))
+
+    def _join_device(self, ctx, probe, build, lsch, rsch, out_factor):
         lcomp, rcomp, fcomp, jfn = self._compiled
 
         laux = lcomp.aux_arrays(probe.dicts)

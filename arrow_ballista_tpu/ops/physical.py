@@ -33,13 +33,17 @@ from .expressions import ExprCompiler
 
 class MetricsSet:
     """Per-operator metrics, the analog of the reference's OperatorMetric
-    proto (reference ballista/core/proto/ballista.proto:248-281)."""
+    proto (reference ballista/core/proto/ballista.proto:248-281).
+    Thread-safe: same-stage tasks share the operator instance and record
+    concurrently once device dispatch runs outside the xla_lock."""
 
     def __init__(self):
         self.values: Dict[str, float] = {}
+        self._lock = threading.Lock()
 
     def add(self, name: str, v: float):
-        self.values[name] = self.values.get(name, 0) + v
+        with self._lock:
+            self.values[name] = self.values.get(name, 0) + v
 
     def timer(self, name: str):
         return _Timer(self, name)
@@ -110,14 +114,17 @@ class ExecutionPlan:
         return self._schema
 
     def xla_lock(self) -> threading.Lock:
-        """Per-operator lock serializing jit-build + device dispatch.
+        """Per-operator lock guarding the lazy jit-closure build.
 
         Same-stage tasks share one operator instance; without this, N pool
         threads race the lazy ``self._compiled`` build and trigger N
-        duplicate XLA compilations (minutes each on TPU).  Serializing the
-        dispatch itself costs nothing on one chip — device work from
-        concurrent tasks queues on the single TPU anyway; host-side scan
-        IO stays parallel (it runs outside this lock)."""
+        duplicate XLA compilations (minutes each on TPU).  Hold it ONLY
+        around the build: device dispatch runs outside so one task's
+        host<->device transfers overlap another's device compute
+        (HashAggregateExec/JoinExec do this) — which also means the lock
+        does NOT protect shared state touched during execution; any such
+        state needs its own synchronization (MetricsSet and the
+        ExprCompiler aux cache carry their own locks)."""
         lock = getattr(self, "_xla_lock", None)
         if lock is None:
             with _LOCK_CREATE:
@@ -139,9 +146,16 @@ class ExecutionPlan:
         raise NotImplementedError
 
     def metrics(self) -> MetricsSet:
-        if not hasattr(self, "_metrics"):
-            self._metrics = MetricsSet()
-        return self._metrics
+        # double-checked under the module lock: concurrent first calls from
+        # same-stage tasks (dispatch runs outside xla_lock) must not create
+        # two MetricsSet instances and lose one task's records
+        ms = getattr(self, "_metrics", None)
+        if ms is None:
+            with _LOCK_CREATE:
+                ms = getattr(self, "_metrics", None)
+                if ms is None:
+                    self._metrics = ms = MetricsSet()
+        return ms
 
     # display
     def display(self, indent: int = 0) -> str:
