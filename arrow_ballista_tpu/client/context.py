@@ -201,6 +201,8 @@ class BallistaContext:
         if self._remote is not None:
             return self._remote_sql(sql)
         stmt = parse_sql(sql)
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt)
         if isinstance(stmt, ast.CreateExternalTable):
             return self._create_external_table(stmt)
         if isinstance(stmt, ast.ShowTables):
@@ -229,6 +231,9 @@ class BallistaContext:
         import pandas as pd
 
         stmt = parse_sql(sql)
+        if isinstance(stmt, ast.Explain):
+            rows = self._remote.explain(sql)
+            return RemoteDataFrame(self, None, static=pd.DataFrame(rows))
         if isinstance(stmt, ast.CreateExternalTable):
             schema = None
             if stmt.columns:
@@ -246,6 +251,28 @@ class BallistaContext:
                 "column_name": [f.name for f in schema],
                 "data_type": [str(f.dtype) for f in schema]}))
         return RemoteDataFrame(self, sql)
+
+    def _explain(self, stmt: "ast.Explain") -> BallistaDataFrame:
+        """EXPLAIN [VERBOSE] <select>: plan rows, DataFusion-shaped
+        (plan_type, plan); VERBOSE adds the distributed stage split.
+        Parity: the reference gets EXPLAIN from DataFusion through
+        ballista-cli; here the physical row shows the exchange/mesh
+        decisions this engine makes (SURVEY §1 ENGINE layer).  The result
+        is a static frame — nothing is registered in the catalog."""
+        import pyarrow as pa
+
+        from ..catalog import MemoryTable
+        from ..scheduler.physical_planner import explain_rows
+
+        rows = explain_rows(self.catalog, self.config, stmt.statement,
+                            verbose=stmt.verbose)
+        t = pa.table({"plan_type": [r["plan_type"] for r in rows],
+                      "plan": [r["plan"] for r in rows]})
+        mt = MemoryTable("__explain", t)
+        plan = mt.scan(None, [], 1)
+        df = BallistaDataFrame(self, None)
+        df.collect = lambda: plan.execute(0, TaskContext(config=self.config))
+        return df
 
     def _create_external_table(self, stmt: ast.CreateExternalTable) -> BallistaDataFrame:
         schema = None

@@ -74,6 +74,10 @@ class RemoteCluster:
     def deregister_table(self, name: str) -> None:
         self._call("deregister_table", {"name": name})
 
+    def explain(self, sql: str) -> List[dict]:
+        payload, _ = self._call("explain", {"sql": sql})
+        return payload["rows"]
+
     # --- query execution -------------------------------------------------
     def execute_sql(self, sql: str, timeout: Optional[float] = None) -> List[ColumnBatch]:
         if timeout is None:

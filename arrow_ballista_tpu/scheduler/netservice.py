@@ -187,6 +187,7 @@ class SchedulerNetService:
         r("update_session", self._update_session)
         r("remove_session", self._remove_session)
         r("prepare", self._prepare)
+        r("explain", self._explain)
         r("execute_query", self._execute_query)
         r("get_job_status", self._get_job_status)
         r("cancel_job", self._cancel_job)
@@ -274,6 +275,21 @@ class SchedulerNetService:
             holder.pop(next(iter(holder)))
         return {"statement_id": stmt_id,
                 "schema": serde.schema_to_obj(logical.schema)}, b""
+
+    def _explain(self, payload: dict, _bin: bytes):
+        """EXPLAIN over the wire: the scheduler owns the catalog in remote
+        deployments, so planning happens here; clients get plan rows."""
+        from ..scheduler.physical_planner import explain_rows
+        from ..sql import ast as sqlast
+        from ..sql.parser import parse_sql
+
+        _session, catalog, config = self._session_ctx(payload)
+        stmt = parse_sql(payload["sql"])
+        verbose = False
+        if isinstance(stmt, sqlast.Explain):
+            verbose = stmt.verbose
+            stmt = stmt.statement
+        return {"rows": explain_rows(catalog, config, stmt, verbose)}, b""
 
     # --- query handling --------------------------------------------------
     def _execute_query(self, payload: dict, _bin: bytes):

@@ -52,6 +52,30 @@ class PlannedQuery:
     scalars: List[Tuple[str, ExecutionPlan]]
 
 
+def explain_rows(catalog, config, statement, verbose: bool = False):
+    """DataFusion-shaped EXPLAIN rows, shared by the local client path and
+    the scheduler's wire handler so the two cannot drift.  ``verbose`` adds
+    the distributed stage decomposition (the exchange boundaries the
+    DistributedPlanner will split at)."""
+    from ..sql.optimizer import optimize
+    from ..sql.planner import SqlToRel
+
+    optimized = optimize(SqlToRel(catalog).plan(statement))
+    planned = PhysicalPlanner(catalog, config).plan_query(optimized)
+    rows = [
+        {"plan_type": "logical_plan", "plan": optimized.display()},
+        {"plan_type": "physical_plan", "plan": planned.plan.display()},
+    ]
+    if verbose:
+        from .planner import DistributedPlanner
+
+        stages = DistributedPlanner().plan_query_stages("explain", planned.plan)
+        text = "\n".join(
+            f"Stage {s.stage_id}:\n{s.plan.display(1)}" for s in stages)
+        rows.append({"plan_type": "distributed_plan", "plan": text})
+    return rows
+
+
 class PhysicalPlanner:
     def __init__(self, catalog: SchemaCatalog, config: BallistaConfig):
         self.catalog = catalog

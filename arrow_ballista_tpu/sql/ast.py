@@ -203,6 +203,14 @@ class CreateExternalTable(Node):
 
 
 @dataclasses.dataclass
+class Explain(Node):
+    """EXPLAIN [VERBOSE] <select> — returns plan rows instead of results
+    (reference: DataFusion's EXPLAIN through ballista-cli)."""
+    statement: Node
+    verbose: bool = False
+
+
+@dataclasses.dataclass
 class ShowTables(Node):
     pass
 

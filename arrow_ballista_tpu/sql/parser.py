@@ -76,7 +76,11 @@ class Parser:
 
     # --- entry ----------------------------------------------------------
     def parse_statement(self) -> ast.Node:
-        if self.at_kw("SELECT"):
+        if self.at_kw("EXPLAIN"):
+            self.expect_kw("EXPLAIN")
+            verbose = self.eat_kw("VERBOSE")
+            stmt = ast.Explain(self.parse_select(), verbose=bool(verbose))
+        elif self.at_kw("SELECT"):
             stmt = self.parse_select()
         elif self.at_kw("CREATE"):
             stmt = self.parse_create_external_table()

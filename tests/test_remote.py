@@ -159,3 +159,10 @@ def test_serde_roundtrip_tpch_plans():
         back = serde.plan_from_obj(obj)
         assert serde.plan_to_obj(back) == obj, f"q{q} serde not stable"
         assert back.schema.names() == planned.plan.schema.names(), f"q{q} schema"
+
+
+def test_explain_over_the_wire(ctx):
+    """EXPLAIN plans on the scheduler (it owns the catalog remotely)."""
+    out = ctx.sql("EXPLAIN select region, sum(amount) s from sales group by region").to_pandas()
+    assert out.plan_type.tolist() == ["logical_plan", "physical_plan"]
+    assert "HashAggregateExec" in out.plan.iloc[1]

@@ -176,3 +176,26 @@ def test_auto_shuffle_partitions():
     # and the query still runs end to end
     out = df.to_pandas()
     assert len(out) == 7 and out.s.sum() == n
+
+
+def test_explain_statement_local():
+    """EXPLAIN <select> returns DataFusion-shaped plan rows."""
+    import numpy as np
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    ctx = BallistaContext.local()
+    ctx.register_table("t", pa.table({"g": np.arange(50) % 3,
+                                      "v": np.ones(50, dtype=np.int64)}))
+    out = ctx.sql("EXPLAIN select g, sum(v) s from t group by g order by g").to_pandas()
+    assert out.plan_type.tolist() == ["logical_plan", "physical_plan"]
+    assert "Aggregate" in out.plan.iloc[0]
+    assert "HashAggregateExec" in out.plan.iloc[1]
+    # catalog stays clean — EXPLAIN must not leak temp tables
+    assert not [n for n in ctx.catalog.table_names() if n.startswith("__")]
+    # VERBOSE adds the distributed stage split
+    out2 = ctx.sql("EXPLAIN VERBOSE select g, sum(v) s from t group by g").to_pandas()
+    assert out2.plan_type.tolist() == [
+        "logical_plan", "physical_plan", "distributed_plan"]
+    assert "Stage" in out2.plan.iloc[2] and "ShuffleWriterExec" in out2.plan.iloc[2]
