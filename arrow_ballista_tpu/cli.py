@@ -77,6 +77,13 @@ def run_command(ctx, line: str, timing: bool) -> bool:
     df = ctx.sql(cmd)
     out = df.to_pandas()
     dt = time.perf_counter() - t0
+    if cmd.upper().startswith("EXPLAIN"):
+        # multi-line plan cells would be mangled by the tabular renderer
+        for _, row in out.iterrows():
+            print(f"== {row.plan_type} ==\n{row.plan}\n")
+        if timing:
+            print(f"Query took {dt:.3f} seconds.")
+        return timing
     if len(out):
         print(out.to_string(index=False))
     print(f"{len(out)} row(s) in set.", end="")

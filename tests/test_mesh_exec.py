@@ -277,6 +277,50 @@ def test_mesh_hybrid_matches_file_shuffle(table):
         pd.testing.assert_frame_equal(got, want, check_dtype=False)
 
 
+def test_mesh_hybrid_nullable_operands():
+    """The hybrid partial restores all-NULL groups to sentinels so the
+    downstream (cross-host) final aggregate's value-based null check skips
+    them — same answers as the file path."""
+    rng = np.random.default_rng(5)
+    n = 30_000
+    g = rng.integers(0, 15, n)
+    null_at = (rng.random(n) < 0.4) | (g == 3)
+    table = pa.table({
+        "g": pa.array(g.astype(np.int64)),
+        "v": pa.array([None if m else int(x)
+                       for m, x in zip(null_at, rng.integers(-9, 99, n))],
+                      type=pa.int64()),
+    })
+    hybrid_cfg = BallistaConfig({"ballista.shuffle.mesh": "true",
+                                 "ballista.shuffle.mesh.hybrid": "true",
+                                 "ballista.shuffle.partitions": "4"})
+    plain_cfg = BallistaConfig({"ballista.shuffle.partitions": "4"})
+    sql = ("select g, sum(v) sv, count(v) cv, min(v) lo, max(v) hi "
+           "from t group by g order by g")
+    hctx = BallistaContext.local(hybrid_cfg)
+    fctx = BallistaContext.local(plain_cfg)
+    try:
+        hctx.register_table("t", table)
+        fctx.register_table("t", table)
+        from arrow_ballista_tpu.ops.mesh_exec import MeshPartialAggregateExec
+        from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+        from arrow_ballista_tpu.scheduler.planner import collect_nodes
+        from arrow_ballista_tpu.sql.optimizer import optimize
+
+        hdf = hctx.sql(sql)
+        planned = PhysicalPlanner(hctx.catalog, hctx.config).plan_query(
+            optimize(hdf.logical))
+        assert collect_nodes(planned.plan, MeshPartialAggregateExec), \
+            f"nullable operands fell off the hybrid path:\n{planned.plan.display()}"
+        got = hdf.to_pandas()
+        want = fctx.sql(sql).to_pandas()
+    finally:
+        hctx.shutdown()
+        fctx.shutdown()
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+    assert pd.isna(got[got.g == 3].sv.iloc[0]) and got[got.g == 3].cv.iloc[0] == 0
+
+
 def test_mesh_hybrid_through_network_scheduler(tmp_path, table):
     """The hybrid exchange runs through SchedulerNetService with TWO
     executors: mesh-fused partial tasks execute on different executors and
