@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from ..models import expr as E
 from ..models.batch import ColumnBatch, concat_batches
 from ..models.schema import BOOL, DataType, Field, INT64, Schema
-from ..utils.config import AGG_CAPACITY, JOIN_MAX_CAPACITY, JOIN_OUTPUT_FACTOR
+from ..utils.config import AGG_CAPACITY, JOIN_MAX_CAPACITY
 from ..utils.errors import CapacityError, ExecutionError, InternalError
 from .expressions import Compiled, ExprCompiler
 from . import kernels as K
@@ -556,22 +556,40 @@ class JoinExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         probe = concat_batches(self.left.schema, self.left.execute(partition, ctx)).shrink()
         if self.dist == "broadcast":
-            build_parts = []
-            for p in range(self.right.output_partition_count()):
-                build_parts.extend(self.right.execute(p, ctx))
-            build = concat_batches(self.right.schema, build_parts).shrink()
+            # materialize the build side ONCE per job: same-stage tasks
+            # share this operator instance, and re-executing the build
+            # subtree (scans included) per probe partition multiplied the
+            # scan volume by the task count (the reference's CollectLeft
+            # shares one built table the same way).  Keyed by job_id so any
+            # cross-job instance reuse can't serve stale rows; dropped once
+            # every probe partition has consumed it so a cached plan can't
+            # pin the materialized table in memory after the job (a late
+            # retry simply rebuilds).
+            with self.xla_lock():
+                cached = getattr(self, "_build_cache", None)
+                if cached is None or cached[0] != ctx.job_id:
+                    build_parts = []
+                    for p in range(self.right.output_partition_count()):
+                        build_parts.extend(self.right.execute(p, ctx))
+                    build = concat_batches(self.right.schema,
+                                           build_parts).shrink()
+                    cached = (ctx.job_id, build, set())
+                    self._build_cache = cached
+                build = cached[1]
+                cached[2].add(partition)
+                if len(cached[2]) >= self.left.output_partition_count():
+                    self._build_cache = None
         else:
             build = concat_batches(self.right.schema, self.right.execute(partition, ctx)).shrink()
 
         lsch, rsch = self.left.schema, self.right.schema
-        out_factor = ctx.config.get(JOIN_OUTPUT_FACTOR)
 
         # lock covers only the jit-closure build (see HashAggregateExec):
         # concurrent reduce tasks dispatch outside it so transfers overlap
         # device compute
         with self.xla_lock():
             self._ensure_compiled(ctx, lsch, rsch)
-        return self._join_device(ctx, probe, build, lsch, rsch, out_factor)
+        return self._join_device(ctx, probe, build, lsch, rsch)
 
     def _ensure_compiled(self, ctx, lsch, rsch):
         if self._compiled is None:
@@ -663,32 +681,65 @@ class JoinExec(ExecutionPlan):
                     out_mask = jnp.concatenate([out_mask, miss_b])
                 return out_cols, out_mask, total
 
-            self._compiled = (lcomp, rcomp, fcomp, jax.jit(join_fn, static_argnums=(7,)))
+            def count_fn(pcols, pmask, bcols, bmask, laux, raux):
+                # candidate-pair count only: the same hi-lo arithmetic the
+                # join performs, none of the gathers — sizes the output
+                # buffers to reality instead of out_factor x probe capacity
+                # (a 1M-row probe batch with 30k matches would otherwise
+                # gather every output column into 2M-row buffers)
+                pk = [c.fn(pcols, laux) for c in lkeys]
+                bk = [c.fn(bcols, raux) for c in rkeys]
+                bh_sorted, _, _ = K.build_side_sort(bk, bmask)
+                ph = K.hash64(pk)
+                lo = jnp.searchsorted(bh_sorted, ph, side="left")
+                hi = jnp.searchsorted(bh_sorted, ph, side="right")
+                return jnp.sum(jnp.where(pmask, hi - lo, 0))
 
-    def _join_device(self, ctx, probe, build, lsch, rsch, out_factor):
-        lcomp, rcomp, fcomp, jfn = self._compiled
+            self._compiled = (lcomp, rcomp, fcomp,
+                              jax.jit(join_fn, static_argnums=(7,)),
+                              jax.jit(count_fn))
+
+    def _join_device(self, ctx, probe, build, lsch, rsch):
+        lcomp, rcomp, fcomp, jfn, cfn = self._compiled
 
         laux = lcomp.aux_arrays(probe.dicts)
         raux = rcomp.aux_arrays(build.dicts)
         faux = fcomp.aux_arrays({**probe.dicts, **build.dicts}) if fcomp is not None else {}
-        out_cap = out_factor * probe.capacity
 
         with self.metrics().timer("join_time"):
+            # count pass -> exact candidate total -> power-of-two capacity
+            # bucket (static shapes stay static per bucket — the
+            # XLA-friendly answer to data-dependent join fan-out,
+            # SURVEY.md §7 hard parts).  Floored at probe.capacity/4 so
+            # same-shaped batches with modest counts share ONE compiled
+            # bucket instead of compiling per data-dependent power of two
+            # (compiles cost minutes on TPU); clamped to the ceiling so
+            # pow2 rounding can never allocate above the configured cap.
+            total_est = int(cfn(probe.columns, probe.mask,
+                                build.columns, build.mask, laux, raux))
+            ceiling = ctx.config.get(JOIN_MAX_CAPACITY)
+            if total_est > ceiling:
+                raise CapacityError(
+                    f"join produced {total_est} candidate pairs, above the "
+                    f"{ceiling}-row ceiling; likely an accidental near-cross "
+                    f"join — check join keys, or raise {JOIN_MAX_CAPACITY}")
+            out_cap = max(64, 1 << max(0, total_est - 1).bit_length(),
+                          probe.capacity // 4)
+            if out_cap > ceiling:
+                out_cap = max(total_est, 64)
             out_cols, out_mask, total = jfn(
-                probe.columns, probe.mask, build.columns, build.mask, laux, raux, faux, out_cap
+                probe.columns, probe.mask, build.columns, build.mask,
+                laux, raux, faux, out_cap
             )
-            # bucketed recompilation: the first pass reports the true pair
-            # count, so one retry at the next power-of-two capacity always
-            # fits.  Static shapes stay static per bucket — the XLA-friendly
-            # answer to data-dependent join fan-out (SURVEY.md §7 hard parts).
+            # the join's own count uses the same hi-lo arithmetic, so the
+            # retry can only fire if something drifts between the two
+            # programs — kept as a safety net
             if int(total) > out_cap:
                 need = 1 << (int(total) - 1).bit_length()
-                ceiling = ctx.config.get(JOIN_MAX_CAPACITY)
                 if need > ceiling:
                     raise CapacityError(
-                        f"join produced {int(total)} candidate pairs, above the "
-                        f"{ceiling}-row ceiling; likely an accidental near-cross "
-                        f"join — check join keys, or raise {JOIN_MAX_CAPACITY}")
+                        f"join produced {int(total)} candidate pairs, above "
+                        f"the {ceiling}-row ceiling; raise {JOIN_MAX_CAPACITY}")
                 self.metrics().add("capacity_recompiles", 1)
                 out_cols, out_mask, total = jfn(
                     probe.columns, probe.mask, build.columns, build.mask,

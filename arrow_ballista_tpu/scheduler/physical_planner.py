@@ -273,8 +273,25 @@ class PhysicalPlanner:
         on = [(self._prep_expr(l), self._prep_expr(r)) for l, r in node.on]
         filt = self._prep_expr(node.filter) if node.filter is not None else None
 
+        # side ordering (inner joins are symmetric; the reference gets this
+        # from DataFusion's join selection): when either side fits the
+        # broadcast threshold, make the SMALLER side the BUILD (right) —
+        # the big probe side then streams partition-parallel with NO
+        # repartition at all.  Both-sides-big partitioned joins keep their
+        # SQL order (output capacity is count-sized, so a swap would only
+        # move the build argsort onto the bigger side).  Column order in
+        # the output schema changes; downstream resolves by name.
+        left_est = self._estimate_rows(node.left)
+        right_est = self._estimate_rows(node.right)
+        if node.join_type == "inner" \
+                and min(left_est, right_est) <= self.config.get(BROADCAST_THRESHOLD) \
+                and left_est < right_est:
+            left, right = right, left
+            on = [(r, l) for l, r in on]
+            left_est, right_est = right_est, left_est
+
         if node.join_type != "full" and \
-                self._estimate_rows(node.right) <= self.config.get(BROADCAST_THRESHOLD):
+                right_est <= self.config.get(BROADCAST_THRESHOLD):
             # full joins can't broadcast: unmatched build rows would be
             # emitted once per probe partition
             right_bc = self._to_single_partition(right)
