@@ -388,7 +388,10 @@ class HashAggregateExec(ExecutionPlan):
                        if nc is not None and how in ("sum", "min", "max")]
 
             def agg_fn(cols, mask, aux, out_cap, key_ranges):
-                keys = [c.fn(cols, aux) for c, _ in group_c]
+                # literal keys/operands compile to scalars; kernels index
+                # per row (GROUP BY 1 with a literal select item is legal)
+                keys = [jnp.broadcast_to(k, mask.shape) if k.ndim == 0 else k
+                        for k in (c.fn(cols, aux) for c, _ in group_c)]
                 vals = []
                 valids = {}
                 for i, (cc, how, _, null_check) in enumerate(agg_c):
@@ -396,6 +399,10 @@ class HashAggregateExec(ExecutionPlan):
                         vals.append((jnp.zeros(mask.shape, jnp.int64), K.AGG_COUNT))
                         continue
                     v = cc.fn(cols, aux)
+                    if v.ndim == 0:
+                        # literal operands (count(1), sum(2)) compile to
+                        # scalars; aggregation kernels index per row
+                        v = jnp.broadcast_to(v, mask.shape)
                     if null_check is not None:
                         valid = valid_of(v, null_check)
                         valids[i] = valid

@@ -1,0 +1,60 @@
+"""Remote SQL: connect a client to a running scheduler over the wire.
+
+Parity: reference examples/src/bin/sql.rs (BallistaContext::remote against
+`ballista-scheduler`/`ballista-executor` daemons).  With no daemons running
+this example starts an in-process pair so it works out of the box:
+
+    python examples/remote_sql.py                # self-contained
+    python examples/remote_sql.py --host H --port P   # against daemons
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pyarrow as pa
+
+from arrow_ballista_tpu.client.context import BallistaContext
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default=None)
+    ap.add_argument("--port", type=int, default=50050)
+    args = ap.parse_args()
+
+    started = []
+    if args.host is None:
+        from arrow_ballista_tpu.executor.server import ExecutorServer
+        from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+        sched = SchedulerNetService("127.0.0.1", 0, rest_port=0)
+        sched.start()
+        ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                            work_dir=tempfile.mkdtemp(prefix="ballista-ex-"))
+        ex.start()
+        started = [ex, sched]
+        args.host, args.port = "127.0.0.1", sched.port
+        print(f"started in-process cluster; web ui at "
+              f"http://127.0.0.1:{sched.rest.port}/")
+
+    ctx = BallistaContext.remote(args.host, args.port)
+    rng = np.random.default_rng(0)
+    ctx.register_table("sales", pa.table({
+        "region": pa.array(rng.integers(0, 4, 10_000).astype(np.int64)),
+        "amount": pa.array(rng.integers(1, 500, 10_000).astype(np.int64)),
+    }))
+    print(ctx.sql("EXPLAIN select region, sum(amount) s from sales "
+                  "group by region").to_pandas().plan.iloc[1])
+    print(ctx.sql("select region, sum(amount) as s, count(*) as n "
+                  "from sales group by region order by region").to_pandas())
+    ctx.shutdown()
+    for s in started:
+        s.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Standalone SQL: in-process scheduler + executor, CSV scan, one query.
+
+Parity: reference examples/examples/standalone-sql.rs (BallistaContext::
+standalone + register_csv + sql + show).  Run:
+
+    python examples/standalone_sql.py
+"""
+import csv
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+
+def main() -> None:
+    config = BallistaConfig({"ballista.shuffle.partitions": "1"})
+    ctx = BallistaContext.standalone(config, concurrent_tasks=2)
+
+    # a tiny csv stand-in for the reference's aggregate_test_100.csv
+    path = os.path.join(tempfile.mkdtemp(prefix="ballista-example-"), "test.csv")
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["c1", "c2"])
+        for i in range(100):
+            w.writerow([f"g{i % 5}", i])
+
+    ctx.sql(
+        f"CREATE EXTERNAL TABLE test (c1 VARCHAR, c2 BIGINT) "
+        f"STORED AS CSV WITH HEADER ROW LOCATION '{path}'"
+    )
+    print(ctx.sql("select count(1) from test").to_pandas())
+    print(ctx.sql(
+        "select c1, count(*) as n, sum(c2) as s from test "
+        "group by c1 order by c1").to_pandas())
+    ctx.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -214,3 +214,19 @@ def test_aggregate_adaptive_capacity():
     out = ctx.sql("select k, sum(v) as s from big group by k").to_pandas()
     assert len(out) == n
     assert out.s.sum() == n
+
+
+def test_count_literal_operand():
+    """count(1) / sum(literal): scalar-compiled operands broadcast to rows
+    (regression: examples/standalone_sql.py hit a 0-dim index error)."""
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    ctx = BallistaContext.local()
+    ctx.register_table("t", pa.table({"g": np.arange(30, dtype=np.int64) % 3}))
+    out = ctx.sql("select g, count(1) as n, sum(2) as s from t "
+                  "group by g order by g").to_pandas()
+    assert out.n.tolist() == [10, 10, 10]
+    assert out.s.tolist() == [20, 20, 20]
+    # literal group keys broadcast too
+    out2 = ctx.sql("select 7 as k, count(*) as n from t group by k").to_pandas()
+    assert out2.k.tolist() == [7] and out2.n.tolist() == [30]
