@@ -179,3 +179,21 @@ def run_query(ctx, oracle, q: int):
 @pytest.mark.parametrize("q", sorted(QUERIES))
 def test_tpch_query(ctx, oracle, q):
     run_query(ctx, oracle, q)
+
+
+@pytest.fixture(scope="module")
+def mesh_ctx(data):
+    config = BallistaConfig({"ballista.shuffle.partitions": "4",
+                             "ballista.shuffle.mesh": "true"})
+    c = BallistaContext.local(config)
+    for name, table in data.items():
+        c.register_table(name, table)
+    return c
+
+
+@pytest.mark.parametrize("q", sorted(QUERIES))
+def test_tpch_query_mesh(mesh_ctx, oracle, q):
+    """All 22 queries under the mesh config: fused operators where the
+    pattern fits, clean fallback elsewhere — the safety net for running
+    the mesh transport across the whole suite, not just q1/q3/q6."""
+    run_query(mesh_ctx, oracle, q)
