@@ -22,7 +22,7 @@ REPARTITION_AGGREGATIONS = "ballista.repartition.aggregations"
 PARQUET_PRUNING = "ballista.parquet.pruning"
 # TPU-native knobs
 AGG_CAPACITY = "ballista.agg.capacity"  # static max distinct groups per batch agg
-JOIN_OUTPUT_FACTOR = "ballista.join.output_factor"  # out_cap = factor * probe_cap
+JOIN_OUTPUT_FACTOR = "ballista.join.output_factor"  # mesh joins: out_cap = factor * per-device probe share
 JOIN_MAX_CAPACITY = "ballista.join.max_capacity"  # ceiling for adaptive retry
 COLLECT_STATISTICS = "ballista.collect_statistics"
 MESH_SHUFFLE = "ballista.shuffle.mesh"  # use ICI all-to-all when executors co-located on a mesh
@@ -73,7 +73,9 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(REPARTITION_AGGREGATIONS, True, _parse_bool, ""),
         ConfigEntry(PARQUET_PRUNING, True, _parse_bool, "row-group pruning on parquet scans"),
         ConfigEntry(AGG_CAPACITY, 1 << 16, int, "static max distinct groups per aggregation"),
-        ConfigEntry(JOIN_OUTPUT_FACTOR, 2, int, "join output capacity = factor * probe capacity"),
+        ConfigEntry(JOIN_OUTPUT_FACTOR, 2, int,
+                    "mesh-join output capacity = factor * per-device probe "
+                    "share (plain joins size outputs by a count pass)"),
         ConfigEntry(JOIN_MAX_CAPACITY, 1 << 26, int,
                     "hard ceiling for adaptive join-capacity growth (rows)"),
         ConfigEntry(COLLECT_STATISTICS, True, _parse_bool, ""),

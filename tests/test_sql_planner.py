@@ -199,3 +199,17 @@ def test_explain_statement_local():
     assert out2.plan_type.tolist() == [
         "logical_plan", "physical_plan", "distributed_plan"]
     assert "Stage" in out2.plan.iloc[2] and "ShuffleWriterExec" in out2.plan.iloc[2]
+
+
+def test_docs_configs_fresh():
+    """docs/user-guide/configs.md must match the live config registry."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "docs", "gen_configs.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=repo)
+    assert r.returncode == 0, r.stderr
