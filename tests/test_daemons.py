@@ -1,0 +1,109 @@
+"""Deployment entry points as REAL processes: scheduler_daemon +
+executor_daemon subprocesses, remote client over the wire, SIGTERM drain.
+
+This is the path docker-compose/helm run (reference scheduler_process.rs /
+executor_process.rs); everything else in the suite exercises the same
+machinery in-process."""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(mod, *args):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-m", mod, *args], cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def _wait_ping(port, deadline_s=60):
+    from arrow_ballista_tpu.net import wire
+
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            wire.call("127.0.0.1", port, "ping", timeout=2.0)
+            return
+        except Exception:  # noqa: BLE001
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.3)
+
+
+def test_daemons_end_to_end(tmp_path):
+    port = _free_port()
+    rest = _free_port()
+    sched = _spawn("arrow_ballista_tpu.scheduler_daemon",
+                   "--bind-host", "127.0.0.1", "--bind-port", str(port),
+                   "--rest-port", str(rest),
+                   "--state-dir", str(tmp_path / "state"))
+    ex = None
+    try:
+        _wait_ping(port)
+        ex = _spawn("arrow_ballista_tpu.executor_daemon",
+                    "--scheduler-port", str(port),
+                    "--work-dir", str(tmp_path / "work"))
+
+        from arrow_ballista_tpu.client.context import BallistaContext
+        from arrow_ballista_tpu.utils.config import BallistaConfig
+
+        ctx = BallistaContext.remote("127.0.0.1", port, BallistaConfig(
+            {"ballista.shuffle.partitions": "2",
+             "ballista.job.timeout.seconds": "120"}))
+        rng = np.random.default_rng(1)
+        ctx.register_table("t", pa.table({
+            "g": pa.array(rng.integers(0, 5, 5000).astype(np.int64)),
+            "v": pa.array(rng.integers(0, 100, 5000).astype(np.int64))}))
+        # executor registration is async — retry until slots exist
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                out = ctx.sql("select g, sum(v) s, count(*) n from t "
+                              "group by g order by g").to_pandas()
+                break
+            except Exception:  # noqa: BLE001
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(1)
+        assert len(out) == 5 and out.n.sum() == 5000
+
+        # web ui + api live on the daemon's rest port
+        import json
+        import urllib.request
+
+        jobs = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{rest}/api/jobs", timeout=10))
+        assert any(j["state"] == "successful" for j in jobs)
+
+        ctx.shutdown()
+    finally:
+        for proc, name in ((ex, "executor"), (sched, "scheduler")):
+            if proc is None:
+                continue
+            proc.send_signal(signal.SIGTERM)
+            try:
+                rc = proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out = proc.communicate()[0]
+                raise AssertionError(
+                    f"{name} did not exit on SIGTERM\n{out[-2000:]}")
+            assert rc == 0, f"{name} exited rc={rc}\n" \
+                            f"{proc.communicate()[0][-2000:]}"
