@@ -139,8 +139,16 @@ def _worker(platform: str) -> None:
     out = step(cols, mask)  # compile + warmup
     jax.block_until_ready(out)
     detail["kernel_q1_compile_s"] = round(time.perf_counter() - t_c, 1)
-    # block on the WHOLE output tree, not one leaf
-    med = _med(lambda: jax.block_until_ready(step(cols, mask)), 10)
+    # block on the WHOLE output tree AND force a 1-element host read: an
+    # experimental remote backend's block_until_ready may not await remote
+    # completion, and a D2H read cannot lie (its cost is one rtt, reported
+    # above for subtraction)
+    def _timed_step():
+        out = step(cols, mask)
+        jax.block_until_ready(out)
+        np.asarray(out[3])  # 0-d overflow scalar: completion proof, no extra op
+
+    med = _med(_timed_step, 10)
     kernel_rows_s = KERNEL_ROWS / med
     # sanity companion: effective HBM read bandwidth implied by the input
     # columns alone — if this exceeds the chip's spec the measurement is
@@ -179,7 +187,13 @@ def _worker(platform: str) -> None:
     t_c = time.perf_counter()
     jax.block_until_ready(join_step(pk, bk, pmask_j, bmask_j))
     detail["kernel_join_compile_s"] = round(time.perf_counter() - t_c, 1)
-    medj = _med(lambda: jax.block_until_ready(join_step(pk, bk, pmask_j, bmask_j)))
+
+    def _timed_join():
+        out = join_step(pk, bk, pmask_j, bmask_j)
+        jax.block_until_ready(out)
+        np.asarray(out[0])  # scalar D2H: forces true remote completion
+
+    medj = _med(_timed_join)
     detail["kernel_join_rows_per_sec"] = round(n_probe / medj, 1)
     detail["kernel_join_ms"] = round(medj * 1000, 3)
     print(f"[worker] kernel join: {n_probe/medj/1e6:.1f}M probe rows/s "
