@@ -37,25 +37,37 @@ from ..utils.errors import PlanningError
 
 class BallistaDataFrame:
     """A planned query, lazily executed (parity: DataFusion DataFrame as
-    returned by BallistaContext::sql)."""
+    returned by BallistaContext::sql).  ``static`` carries an immediate
+    result for statements with no plan to execute (SET / DDL / EXPLAIN),
+    mirroring RemoteDataFrame."""
 
-    def __init__(self, ctx: "BallistaContext", logical: L.LogicalPlan):
+    def __init__(self, ctx: "BallistaContext", logical: Optional[L.LogicalPlan],
+                 static=None):
         self.ctx = ctx
         self.logical = logical
+        self._static = static
 
     @property
     def schema(self) -> Schema:
+        if self.logical is None:
+            return Schema([])
         return self.logical.schema
 
     def explain(self) -> str:
+        if self.logical is None:
+            return ""
         return optimize(self.logical).display()
 
     def collect(self) -> List[ColumnBatch]:
+        if self.logical is None:
+            return []
         return self.ctx._execute_logical(self.logical)
 
     def to_arrow(self):
         import pyarrow as pa
 
+        if self._static is not None:
+            return pa.Table.from_pandas(self._static)
         batches = self.collect()
         tables = [b.to_arrow() for b in batches if b.num_rows > 0]
         if not tables:
@@ -65,6 +77,8 @@ class BallistaDataFrame:
     def to_pandas(self):
         import pandas as pd
 
+        if self._static is not None:
+            return self._static
         batches = self.collect()
         frames = [b.to_pandas() for b in batches]
         out = pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
@@ -201,6 +215,9 @@ class BallistaContext:
         if self._remote is not None:
             return self._remote_sql(sql)
         stmt = parse_sql(sql)
+        if isinstance(stmt, ast.SetVariable):
+            self.config.set(stmt.key, stmt.value)
+            return self._empty_df()
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
         if isinstance(stmt, ast.CreateExternalTable):
@@ -231,6 +248,13 @@ class BallistaContext:
         import pandas as pd
 
         stmt = parse_sql(sql)
+        if isinstance(stmt, ast.SetVariable):
+            # validate locally, then update BOTH ends: the scheduler plans
+            # with the session config, the client uses its copy for
+            # deadlines etc.
+            self.config.set(stmt.key, stmt.value)
+            self._remote.update_session({stmt.key: stmt.value})
+            return RemoteDataFrame(self, None, static=pd.DataFrame())
         if isinstance(stmt, ast.Explain):
             rows = self._remote.explain(sql)
             return RemoteDataFrame(self, None, static=pd.DataFrame(rows))
@@ -252,6 +276,12 @@ class BallistaContext:
                 "data_type": [str(f.dtype) for f in schema]}))
         return RemoteDataFrame(self, sql)
 
+    def _empty_df(self) -> BallistaDataFrame:
+        """DDL-style statements: nothing to collect."""
+        import pandas as pd
+
+        return BallistaDataFrame(self, None, static=pd.DataFrame())
+
     def _explain(self, stmt: "ast.Explain") -> BallistaDataFrame:
         """EXPLAIN [VERBOSE] <select>: plan rows, DataFusion-shaped
         (plan_type, plan); VERBOSE adds the distributed stage split.
@@ -259,20 +289,15 @@ class BallistaContext:
         ballista-cli; here the physical row shows the exchange/mesh
         decisions this engine makes (SURVEY §1 ENGINE layer).  The result
         is a static frame — nothing is registered in the catalog."""
-        import pyarrow as pa
+        import pandas as pd
 
-        from ..catalog import MemoryTable
         from ..scheduler.physical_planner import explain_rows
 
         rows = explain_rows(self.catalog, self.config, stmt.statement,
                             verbose=stmt.verbose)
-        t = pa.table({"plan_type": [r["plan_type"] for r in rows],
-                      "plan": [r["plan"] for r in rows]})
-        mt = MemoryTable("__explain", t)
-        plan = mt.scan(None, [], 1)
-        df = BallistaDataFrame(self, None)
-        df.collect = lambda: plan.execute(0, TaskContext(config=self.config))
-        return df
+        return BallistaDataFrame(
+            self, None,
+            static=pd.DataFrame(rows, columns=["plan_type", "plan"]))
 
     def _create_external_table(self, stmt: ast.CreateExternalTable) -> BallistaDataFrame:
         schema = None
@@ -285,12 +310,7 @@ class BallistaContext:
                               delimiter=stmt.delimiter, has_header=stmt.has_header)
         else:
             raise PlanningError(f"unsupported format {stmt.file_format}")
-        import pyarrow as pa
-
-        df = BallistaDataFrame(self, None)
-        df.collect = lambda: []  # DDL: nothing to collect
-        df.to_pandas = lambda: __import__("pandas").DataFrame()
-        return df
+        return self._empty_df()
 
     # --- execution ------------------------------------------------------
     def _execute_logical(self, logical: L.LogicalPlan) -> List[ColumnBatch]:

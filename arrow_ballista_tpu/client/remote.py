@@ -78,6 +78,10 @@ class RemoteCluster:
         payload, _ = self._call("explain", {"sql": sql})
         return payload["rows"]
 
+    def update_session(self, settings: dict) -> dict:
+        payload, _ = self._call("update_session", {"settings": settings})
+        return payload["settings"]
+
     # --- query execution -------------------------------------------------
     def execute_sql(self, sql: str, timeout: Optional[float] = None) -> List[ColumnBatch]:
         if timeout is None:

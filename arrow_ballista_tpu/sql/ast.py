@@ -203,6 +203,14 @@ class CreateExternalTable(Node):
 
 
 @dataclasses.dataclass
+class SetVariable(Node):
+    """SET <dotted.key> = <value> — session configuration through SQL
+    (reference: DataFusion's SET through ballista-cli / Flight SQL)."""
+    key: str
+    value: str
+
+
+@dataclasses.dataclass
 class Explain(Node):
     """EXPLAIN [VERBOSE] <select> — returns plan rows instead of results
     (reference: DataFusion's EXPLAIN through ballista-cli)."""

@@ -86,6 +86,8 @@ class Parser:
             stmt = self.parse_create_external_table()
         elif self.at_kw("SHOW"):
             stmt = self.parse_show()
+        elif self.at_kw("SET"):
+            stmt = self.parse_set()
         else:
             t = self.peek()
             raise PlanningError(f"unsupported statement starting with {t.value!r}")
@@ -481,6 +483,24 @@ class Parser:
         if loc.kind != "string":
             raise PlanningError("expected string path after LOCATION")
         return ast.CreateExternalTable(name, columns, file_format, loc.value, has_header, delimiter)
+
+    def parse_set(self) -> ast.Node:
+        """SET dotted.key = value  (value: string/number literal or bare
+        word like true/auto)."""
+        self.expect_kw("SET")
+        parts = [self.ident()]
+        while self.eat_op("."):
+            parts.append(self.ident())
+        key = ".".join(parts)
+        if not self.eat_op("="):  # exactly one of '=' or TO
+            self.expect_kw("TO")
+        t = self.peek()
+        if t.kind in ("string", "number", "ident"):
+            self.next()
+            value = str(t.value)
+        else:
+            raise PlanningError(f"expected a value after SET {key}")
+        return ast.SetVariable(key, value)
 
     def parse_show(self) -> ast.Node:
         self.expect_kw("SHOW")

@@ -213,3 +213,51 @@ def test_docs_configs_fresh():
          "--check"],
         capture_output=True, text=True, cwd=repo)
     assert r.returncode == 0, r.stderr
+
+
+def test_set_statement_local_and_remote():
+    """SET key = value configures the session through SQL in both modes
+    (reference: DataFusion SET via ballista-cli)."""
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    ctx = BallistaContext.local()
+    ctx.sql("SET ballista.shuffle.partitions = 3")
+    assert ctx.config.shuffle_partitions == 3
+    ctx.sql("SET ballista.shuffle.partitions = 'auto'")
+    assert ctx.config.shuffle_partitions == 0
+    ctx.sql("SET ballista.shuffle.mesh = true")
+    from arrow_ballista_tpu.utils.config import MESH_SHUFFLE
+    assert ctx.config.get(MESH_SHUFFLE) is True
+    import pytest as _pytest
+    from arrow_ballista_tpu.utils.errors import ConfigurationError
+    with _pytest.raises(ConfigurationError):
+        ctx.sql("SET no.such.key = 1")
+
+    svc = SchedulerNetService("127.0.0.1", 0, rest_port=None)
+    svc.start()
+    ex = ExecutorServer("127.0.0.1", svc.port, "127.0.0.1", 0,
+                        work_dir=tempfile.mkdtemp())
+    ex.start()
+    try:
+        rctx = BallistaContext.remote("127.0.0.1", svc.port)
+        rctx.sql("SET ballista.shuffle.partitions = 2")
+        # the scheduler session planned with the updated value: partition
+        # count shows up in the distributed plan row
+        rctx.register_table("t", pa.table({"a": np.arange(100, dtype=np.int64),
+                                           "g": np.arange(100, dtype=np.int64) % 4}))
+        plan = rctx.sql("EXPLAIN select g, sum(a) s from t group by g"
+                        ).to_pandas().plan.iloc[1]
+        assert "hash[2]" in plan, plan
+        out = rctx.sql("select sum(a) s from t").to_pandas()
+        assert int(out.s.iloc[0]) == 4950
+        rctx.shutdown()
+    finally:
+        ex.stop()
+        svc.stop()
