@@ -196,8 +196,8 @@ class ExprCompiler:
         per task (or clear() away a neighbour's fresh entry)."""
         key = tuple(sorted((k, id(v)) for k, v in dicts.items()))
         with self._aux_lock:
-            hit = self._aux_cache.get(key)
-            if hit is None:
+            entry = self._aux_cache.get(key)
+            if entry is None:
                 raw = self.build_aux(dicts)
                 if self.mode == "device":
                     hit = {k: jnp.asarray(v) for k, v in raw.items()}
@@ -205,8 +205,13 @@ class ExprCompiler:
                     hit = raw
                 if len(self._aux_cache) > 64:
                     self._aux_cache.clear()
-                self._aux_cache[key] = hit
-        return hit
+                # pin the keyed dictionary arrays: the key uses id(), and a
+                # collected dictionary would let an unrelated array reuse
+                # the address and hit a STALE LUT (observed as a flaky
+                # wrong-result under full-suite memory churn)
+                entry = (tuple(dicts.values()), hit)
+                self._aux_cache[key] = entry
+        return entry[1]
 
     # --- helpers --------------------------------------------------------
     def _slot(self, builder: Callable) -> str:
