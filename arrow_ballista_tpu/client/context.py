@@ -218,6 +218,8 @@ class BallistaContext:
         if isinstance(stmt, ast.SetVariable):
             self.config.set(stmt.key, stmt.value)
             return self._empty_df()
+        if isinstance(stmt, ast.ShowSettings):
+            return self._show_settings(stmt.key, self.config.to_dict())
         if isinstance(stmt, ast.Explain):
             return self._explain(stmt)
         if isinstance(stmt, ast.CreateExternalTable):
@@ -255,6 +257,11 @@ class BallistaContext:
             self.config.set(stmt.key, stmt.value)
             self._remote.update_session({stmt.key: stmt.value})
             return RemoteDataFrame(self, None, static=pd.DataFrame())
+        if isinstance(stmt, ast.ShowSettings):
+            # the client config mirrors every SET (both ends update), so
+            # SHOW answers locally — no RPC
+            df = self._show_settings(stmt.key, self.config.to_dict())
+            return RemoteDataFrame(self, None, static=df.to_pandas())
         if isinstance(stmt, ast.Explain):
             rows = self._remote.explain(sql)
             return RemoteDataFrame(self, None, static=pd.DataFrame(rows))
@@ -281,6 +288,17 @@ class BallistaContext:
         import pandas as pd
 
         return BallistaDataFrame(self, None, static=pd.DataFrame())
+
+    def _show_settings(self, key: str, settings: Dict[str, object]) -> BallistaDataFrame:
+        import pandas as pd
+
+        if key:
+            self.config.get(key)  # raises ConfigurationError on unknown keys
+            settings = {key: settings[key]}
+        rows = sorted(settings.items())
+        return BallistaDataFrame(self, None, static=pd.DataFrame(
+            {"name": [k for k, _ in rows],
+             "value": [str(v) for _, v in rows]}))
 
     def _explain(self, stmt: "ast.Explain") -> BallistaDataFrame:
         """EXPLAIN [VERBOSE] <select>: plan rows, DataFusion-shaped

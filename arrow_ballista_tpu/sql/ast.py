@@ -224,5 +224,11 @@ class ShowTables(Node):
 
 
 @dataclasses.dataclass
+class ShowSettings(Node):
+    """SHOW ALL or SHOW <dotted.key> — session configuration values."""
+    key: str = ""  # empty -> all
+
+
+@dataclasses.dataclass
 class ShowColumns(Node):
     table: str

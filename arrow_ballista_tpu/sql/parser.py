@@ -484,14 +484,17 @@ class Parser:
             raise PlanningError("expected string path after LOCATION")
         return ast.CreateExternalTable(name, columns, file_format, loc.value, has_header, delimiter)
 
+    def _dotted_ident(self) -> str:
+        parts = [self.ident()]
+        while self.eat_op("."):
+            parts.append(self.ident())
+        return ".".join(parts)
+
     def parse_set(self) -> ast.Node:
         """SET dotted.key = value  (value: string/number literal or bare
         word like true/auto)."""
         self.expect_kw("SET")
-        parts = [self.ident()]
-        while self.eat_op("."):
-            parts.append(self.ident())
-        key = ".".join(parts)
+        key = self._dotted_ident()
         if not self.eat_op("="):  # exactly one of '=' or TO
             self.expect_kw("TO")
         t = self.peek()
@@ -509,7 +512,12 @@ class Parser:
         if self.eat_kw("COLUMNS"):
             self.expect_kw("FROM")
             return ast.ShowColumns(self.ident())
-        raise PlanningError("expected SHOW TABLES or SHOW COLUMNS")
+        if self.eat_kw("ALL"):
+            return ast.ShowSettings()
+        if self.peek().kind == "ident":
+            return ast.ShowSettings(self._dotted_ident())
+        raise PlanningError(
+            "expected SHOW TABLES, SHOW COLUMNS, SHOW ALL, or SHOW <key>")
 
 
 def parse_sql(sql: str) -> ast.Node:

@@ -255,9 +255,29 @@ def test_set_statement_local_and_remote():
         plan = rctx.sql("EXPLAIN select g, sum(a) s from t group by g"
                         ).to_pandas().plan.iloc[1]
         assert "hash[2]" in plan, plan
+        shown = rctx.sql("SHOW ballista.shuffle.partitions").to_pandas()
+        assert shown.value.tolist() == ["2"]
         out = rctx.sql("select sum(a) s from t").to_pandas()
         assert int(out.s.iloc[0]) == 4950
         rctx.shutdown()
     finally:
         ex.stop()
         svc.stop()
+
+
+def test_show_settings():
+    """SHOW ALL / SHOW <key> pair with SET (DataFusion parity)."""
+    import pytest as _pytest
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.utils.errors import ConfigurationError
+
+    ctx = BallistaContext.local()
+    ctx.sql("SET ballista.shuffle.partitions = 9")
+    out = ctx.sql("SHOW ballista.shuffle.partitions").to_pandas()
+    assert out.value.tolist() == ["9"]
+    allv = ctx.sql("SHOW ALL").to_pandas()
+    assert "ballista.batch.size" in set(allv.name)
+    assert dict(zip(allv.name, allv.value))["ballista.shuffle.partitions"] == "9"
+    with _pytest.raises(ConfigurationError):
+        ctx.sql("SHOW no.such.key")
