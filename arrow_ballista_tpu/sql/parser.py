@@ -86,6 +86,9 @@ class Parser:
             stmt = self.parse_create_external_table()
         elif self.at_kw("SHOW"):
             stmt = self.parse_show()
+        elif self.at_kw("DESCRIBE") or self.at_kw("DESC"):
+            self.next()
+            stmt = ast.ShowColumns(self.ident())
         elif self.at_kw("SET"):
             stmt = self.parse_set()
         else:

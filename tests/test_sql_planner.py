@@ -281,3 +281,17 @@ def test_show_settings():
     assert dict(zip(allv.name, allv.value))["ballista.shuffle.partitions"] == "9"
     with _pytest.raises(ConfigurationError):
         ctx.sql("SHOW no.such.key")
+
+
+def test_describe_statement():
+    """DESCRIBE/DESC t == SHOW COLUMNS FROM t (DataFusion parity)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    ctx = BallistaContext.local()
+    ctx.register_table("t", pa.table({"a": np.arange(5, dtype=np.int64)}))
+    out = ctx.sql("DESCRIBE t").to_pandas()
+    assert out.column_name.tolist() == ["a"] and out.data_type.tolist() == ["int64"]
+    assert ctx.sql("desc t").to_pandas().equals(out)
