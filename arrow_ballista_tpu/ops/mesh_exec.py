@@ -32,6 +32,13 @@ from .operators import AggSpec, HashAggregateExec, null_check_of, valid_of
 from .physical import ExecutionPlan, Partitioning, TaskContext
 
 
+def _pow2(n: int) -> int:
+    """Round a capacity up to a power of two (min 64): skewed partitions
+    would otherwise give every task a distinct capacity signature, missing
+    the shared run cache and compiling per task."""
+    return max(64, 1 << max(0, int(n) - 1).bit_length())
+
+
 def _unshard(x: jnp.ndarray) -> jnp.ndarray:
     """Collapse a mesh-sharded result to one ordinary single-device array.
 
@@ -475,25 +482,35 @@ class MeshJoinExec(ExecutionPlan):
         return Partitioning.single()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
-        from ..parallel.distributed import distributed_hash_join
-        from ..parallel.mesh import make_mesh, row_sharding
-
         assert partition == 0
         lsch, rsch = self.left.schema, self.right.schema
         probe = concat_batches(lsch, [b for p in range(self.left.output_partition_count())
                                       for b in self.left.execute(p, ctx)]).shrink()
         build = concat_batches(rsch, [b for p in range(self.right.output_partition_count())
                                       for b in self.right.execute(p, ctx)]).shrink()
+        return self._join_batches(probe, build, ctx)
 
+    def _join_batches(self, probe: ColumnBatch, build: ColumnBatch,
+                      ctx: TaskContext) -> List[ColumnBatch]:
+        from ..parallel.distributed import distributed_hash_join
+        from ..parallel.mesh import make_mesh, row_sharding
+
+        lsch, rsch = self.left.schema, self.right.schema
         n_dev = len(jax.devices())
         mesh = make_mesh(n_dev)
 
-        if self._compiled is None:
-            lcomp = ExprCompiler(lsch, "device")
-            rcomp = ExprCompiler(rsch, "device")
-            lkeys = [lcomp.compile_key(le) for le, _ in self.on]
-            rkeys = [rcomp.compile_key(re_) for _, re_ in self.on]
-            self._compiled = (lcomp, rcomp, lkeys, rkeys)
+        # compile + run-factory state is shared across a stage's tasks
+        # (MeshTaskJoinExec runs one task per partition); the factories'
+        # inner jits retrace per shape, so one run object per capacity
+        # signature serves every task
+        with self.xla_lock():
+            if self._compiled is None:
+                lcomp = ExprCompiler(lsch, "device")
+                rcomp = ExprCompiler(rsch, "device")
+                lkeys = [lcomp.compile_key(le) for le, _ in self.on]
+                rkeys = [rcomp.compile_key(re_) for _, re_ in self.on]
+                self._compiled = (lcomp, rcomp, lkeys, rkeys)
+                self._runs = {}
         lcomp, rcomp, lkeys, rkeys = self._compiled
         laux = lcomp.aux_arrays(probe.dicts)
         raux = rcomp.aux_arrays(build.dicts)
@@ -561,13 +578,18 @@ class MeshJoinExec(ExecutionPlan):
             # is per-device probe rows x fan-out factor
             from ..parallel.distributed import distributed_broadcast_join
 
-            out_cap = max(64, out_factor * (p_rows // n_dev))
+            out_cap = _pow2(out_factor * (p_rows // n_dev))
             attempts = 0
             while True:
-                run = distributed_broadcast_join(
-                    mesh, len(self.on), list(lsch.names()), list(rsch.names()),
-                    self.join_type, out_cap, rfill,
-                    string_key_flags=sflags, null_key_sentinel=sentinel)
+                with self.xla_lock():
+                    run = self._runs.get(("bc", out_cap))
+                    if run is None:
+                        run = distributed_broadcast_join(
+                            mesh, len(self.on), list(lsch.names()),
+                            list(rsch.names()), self.join_type, out_cap,
+                            rfill, string_key_flags=sflags,
+                            null_key_sentinel=sentinel)
+                        self._runs[("bc", out_cap)] = run
                 out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
                 if not bool(overflow):
                     break
@@ -583,21 +605,26 @@ class MeshJoinExec(ExecutionPlan):
             # per-device shuffle capacity: worst case every row of a side
             # hashes to one bucket of one device's send buffer; factor 2
             # covers skew, overflow re-runs at the true bound
-            shuf_cap = max(64, 2 * max(p_rows, b_rows) // n_dev)
+            shuf_cap = _pow2(2 * max(p_rows, b_rows) // n_dev)
             # per-device output bound: start at the EXPECTED per-device probe
             # share x fan-out factor, not the worst-case receive bound — a
             # too-small guess recompiles via the overflow-retry doubling, a
             # too-large one allocates (and gathers into) multi-GB outputs
             # every run (measured: q3's old 2x-shuffle-capacity bound put a
             # 24M-row output gather on a 30k-row result)
-            out_cap = max(64, out_factor * (p_rows // n_dev))
+            out_cap = _pow2(out_factor * (p_rows // n_dev))
 
             attempts = 0
             while True:
-                run = distributed_hash_join(
-                    mesh, len(self.on), list(lsch.names()), list(rsch.names()),
-                    self.join_type, shuf_cap, out_cap, rfill,
-                    string_key_flags=sflags, null_key_sentinel=sentinel)
+                with self.xla_lock():
+                    run = self._runs.get(("part", shuf_cap, out_cap))
+                    if run is None:
+                        run = distributed_hash_join(
+                            mesh, len(self.on), list(lsch.names()),
+                            list(rsch.names()), self.join_type, shuf_cap,
+                            out_cap, rfill, string_key_flags=sflags,
+                            null_key_sentinel=sentinel)
+                        self._runs[("part", shuf_cap, out_cap)] = run
                 out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
                 if not bool(overflow):
                     break
@@ -624,3 +651,36 @@ class MeshJoinExec(ExecutionPlan):
         on = ", ".join(f"{l} = {r}" for l, r in self.on)
         return (f"MeshJoinExec({self.join_type}, fused all_to_all both sides): "
                 f"on=[{on}]")
+
+
+class MeshTaskJoinExec(MeshJoinExec):
+    """HYBRID join composition: the per-partition join of a file-shuffled
+    stage, fused over the executing host's LOCAL device mesh.
+
+    Where MeshJoinExec fuses the whole exchange in-process (one task, one
+    host), this keeps the reference's partitioned stage structure — both
+    sides hash-repartitioned via the ordinary shuffle, one join task per
+    partition spread over executors — and uses the mesh only WITHIN each
+    task: the partition's probe rows shard across the host's chips and the
+    per-partition build side is all_gathered (or locally all_to_all'd when
+    large).  On a multi-host cluster this is the join half of "ICI within
+    a host, file shuffle across hosts" (BASELINE.json.north_star), joining
+    MeshPartialAggregateExec on the aggregate side."""
+
+    def output_partition_count(self):
+        return self.left.output_partition_count()
+
+    def output_partitioning(self):
+        return self.left.output_partitioning()
+
+    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        probe = concat_batches(
+            self.left.schema, self.left.execute(partition, ctx)).shrink()
+        build = concat_batches(
+            self.right.schema, self.right.execute(partition, ctx)).shrink()
+        return self._join_batches(probe, build, ctx)
+
+    def _label(self):
+        on = ", ".join(f"{l} = {r}" for l, r in self.on)
+        return (f"MeshTaskJoinExec({self.join_type}, per-task mesh, "
+                f"file exchange): on=[{on}]")

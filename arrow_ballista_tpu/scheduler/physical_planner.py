@@ -298,8 +298,11 @@ class PhysicalPlanner:
             return O.JoinExec(left, right_bc, on, node.join_type, filt, dist="broadcast")
 
         # TPU fast path: fuse both hash repartitions + the join into one XLA
-        # program over the local device mesh (ops/mesh_exec.py MeshJoinExec)
-        if self.config.get(MESH_SHUFFLE):
+        # program over the local device mesh (ops/mesh_exec.py MeshJoinExec).
+        # Hybrid mode keeps the partitioned stage structure (file shuffle
+        # across hosts) and meshes only the per-task join — the multi-HOST
+        # composition, mirroring MeshPartialAggregateExec.
+        if self.config.get(MESH_SHUFFLE) and not self.config.get(MESH_HYBRID):
             from ..ops.mesh_exec import MeshJoinExec
 
             if MeshJoinExec.eligible(on, node.join_type, filt,
@@ -311,6 +314,12 @@ class PhysicalPlanner:
         rkeys = tuple(r for _, r in on)
         lpart = RepartitionExec(left, Partitioning.hash(lkeys, p))
         rpart = RepartitionExec(right, Partitioning.hash(rkeys, p))
+        if self.config.get(MESH_SHUFFLE) and self.config.get(MESH_HYBRID):
+            from ..ops.mesh_exec import MeshTaskJoinExec
+
+            if MeshTaskJoinExec.eligible(on, node.join_type, filt,
+                                         left.schema, right.schema):
+                return MeshTaskJoinExec(lpart, rpart, on, node.join_type)
         return O.JoinExec(lpart, rpart, on, node.join_type, filt, dist="partitioned")
 
     def _estimate_rows(self, node: L.LogicalPlan) -> int:

@@ -17,7 +17,11 @@ from typing import Dict, List, Optional
 from .models import expr as E
 from .models.schema import DataType, Field, Schema
 from .ops import operators as O
-from .ops.mesh_exec import MeshAggregateExec, MeshPartialAggregateExec
+from .ops.mesh_exec import (
+    MeshAggregateExec,
+    MeshPartialAggregateExec,
+    MeshTaskJoinExec,
+)
 from .ops import physical as P
 from .ops import shuffle as SH
 from .ops.shuffle import PartitionLocation, ShuffleWritePartition
@@ -237,6 +241,11 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
         return {"t": "limit", "input": plan_to_obj(p.input), "n": p.n}
     if isinstance(p, O.CoalescePartitionsExec):
         return {"t": "coalesce", "input": plan_to_obj(p.input)}
+    if isinstance(p, MeshTaskJoinExec):
+        return {"t": "meshtaskjoin", "left": plan_to_obj(p.left),
+                "right": plan_to_obj(p.right),
+                "on": [[expr_to_obj(l), expr_to_obj(r)] for l, r in p.on],
+                "jt": p.join_type}
     if isinstance(p, MeshPartialAggregateExec):
         return {"t": "meshpartial", "input": plan_to_obj(p.input),
                 "groups": [[expr_to_obj(e), n] for e, n in p.group_exprs],
@@ -327,6 +336,11 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
         return O.LimitExec(plan_from_obj(o["input"]), o["n"])
     if t == "coalesce":
         return O.CoalescePartitionsExec(plan_from_obj(o["input"]))
+    if t == "meshtaskjoin":
+        return MeshTaskJoinExec(
+            plan_from_obj(o["left"]), plan_from_obj(o["right"]),
+            [(expr_from_obj(l), expr_from_obj(r)) for l, r in o["on"]],
+            o["jt"])
     if t == "meshpartial":
         return MeshPartialAggregateExec(
             plan_from_obj(o["input"]),

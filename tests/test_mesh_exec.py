@@ -355,3 +355,77 @@ def test_mesh_hybrid_through_network_scheduler(tmp_path, table):
         for ex in exes:
             ex.stop(notify=False)
         sched.stop()
+
+
+def test_mesh_hybrid_join_matches_file_shuffle(join_tables):
+    """Hybrid mode: joins keep the partitioned stage structure but each
+    task's join fuses over the local mesh (MeshTaskJoinExec) — identical
+    results to the plain file path."""
+    from arrow_ballista_tpu.ops.mesh_exec import MeshTaskJoinExec
+    from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+    from arrow_ballista_tpu.scheduler.planner import collect_nodes
+    from arrow_ballista_tpu.sql.optimizer import optimize
+
+    fact, dim = join_tables
+    base = {"ballista.shuffle.partitions": "4",
+            "ballista.join.broadcast_threshold": "0"}
+    hctx = BallistaContext.local(BallistaConfig({
+        **base, "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.hybrid": "true"}))
+    fctx = BallistaContext.local(BallistaConfig(base))
+    for c in (hctx, fctx):
+        c.register_table("fact", fact)
+        c.register_table("dim", dim)
+    for sql in JOIN_QUERIES:
+        df = hctx.sql(sql)
+        planned = PhysicalPlanner(hctx.catalog, hctx.config).plan_query(
+            optimize(df.logical))
+        joins = collect_nodes(planned.plan, MeshTaskJoinExec)
+        assert joins, f"hybrid plan missing task-mesh join:\n{planned.plan.display()}"
+        got = df.to_pandas()
+        want = fctx.sql(sql).to_pandas()
+        pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_mesh_hybrid_join_through_standalone_cluster(join_tables):
+    """The task-mesh join ships over the wire (serde) and runs as N
+    partition tasks through the real scheduler."""
+    fact, dim = join_tables
+    cfg = BallistaConfig({"ballista.shuffle.partitions": "4",
+                          "ballista.join.broadcast_threshold": "0",
+                          "ballista.shuffle.mesh": "true",
+                          "ballista.shuffle.mesh.hybrid": "true"})
+    ctx = BallistaContext.standalone(cfg, concurrent_tasks=4)
+    try:
+        ctx.register_table("fact", fact)
+        ctx.register_table("dim", dim)
+        got = ctx.sql(JOIN_QUERIES[0]).to_pandas()
+    finally:
+        ctx.shutdown()
+    pdf = fact.to_pandas().merge(dim.to_pandas(), left_on="fk", right_on="dk")
+    want = pdf.groupby("name").agg(sv=("val", "sum"), n=("val", "size")).reset_index()
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_mesh_task_join_serde_roundtrip(join_tables):
+    """MeshTaskJoinExec survives the wire encoding."""
+    from arrow_ballista_tpu import serde
+    from arrow_ballista_tpu.ops.mesh_exec import MeshTaskJoinExec
+    from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+    from arrow_ballista_tpu.scheduler.planner import collect_nodes
+    from arrow_ballista_tpu.sql.optimizer import optimize
+
+    fact, dim = join_tables
+    ctx = BallistaContext.local(BallistaConfig({
+        "ballista.shuffle.partitions": "4",
+        "ballista.join.broadcast_threshold": "0",
+        "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.hybrid": "true"}))
+    ctx.register_table("fact", fact)
+    ctx.register_table("dim", dim)
+    planned = PhysicalPlanner(ctx.catalog, ctx.config).plan_query(
+        optimize(ctx.sql(JOIN_QUERIES[0]).logical))
+    obj = serde.plan_to_obj(planned.plan)
+    back = serde.plan_from_obj(obj)
+    assert collect_nodes(back, MeshTaskJoinExec)
+    assert back.display() == planned.plan.display()
