@@ -16,6 +16,7 @@ transport per stage boundary.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Dict, Sequence, Tuple
 
 import jax
@@ -218,6 +219,46 @@ def distributed_partial_aggregate(
     return run
 
 
+def _make_join_runner(per_shard, mesh, probe_names, build_names, join_type,
+                      axis):
+    """Shared runner for the two join variants: a per-signature jit cache
+    whose FIRST invocation happens under a lock.  jax.jit compiles lazily
+    at the first call, and concurrent same-stage tasks (MeshTaskJoinExec)
+    would otherwise both trace+compile the same minutes-long TPU program;
+    the signature includes shapes/dtypes so every distinct compile is
+    first-called exactly once, and steady-state calls bypass the lock's
+    critical work."""
+    row = P(axis)
+    compiled: Dict[Tuple, object] = {}
+    lock = threading.Lock()
+
+    def _sig_of(cols, mask):
+        return (tuple((k, v.shape, str(v.dtype)) for k, v in sorted(cols.items())),
+                mask.shape)
+
+    def run(probe, build):
+        pcols, pmask = probe
+        bcols, bmask = build
+        sig = (_sig_of(pcols, pmask), _sig_of(bcols, bmask))
+        with lock:
+            fn = compiled.get(sig)
+            if fn is None:
+                in_specs = ({m: row for m in pcols}, row,
+                            {m: row for m in bcols}, row)
+                out_names = (list(probe_names) if join_type in ("semi", "anti")
+                             else list(probe_names) + list(build_names))
+                out_specs = ({m: row for m in out_names}, row, P())
+                fn = jax.jit(jax.shard_map(per_shard, mesh=mesh,
+                                           in_specs=in_specs,
+                                           out_specs=out_specs))
+                compiled[sig] = fn
+                # first call (the trace+compile) stays under the lock
+                return fn(pcols, pmask, bcols, bmask)
+        return fn(pcols, pmask, bcols, bmask)
+
+    return run
+
+
 def _probe_emit(join_type, key_names, sflags, null_key_sentinel, probe_names,
                 build_names, build_fill, out_capacity,
                 p_cols, p_mask, b_cols, b_mask):
@@ -305,25 +346,8 @@ def distributed_broadcast_join(
         overflow = lax.psum(ovf_j.astype(jnp.int32), axis) > 0
         return out_cols, out_mask, overflow
 
-    row = P(axis)
-    compiled: Dict[Tuple, object] = {}
-
-    def run(probe, build):
-        pcols, pmask = probe
-        bcols, bmask = build
-        sig = (tuple(sorted(pcols)), tuple(sorted(bcols)))
-        fn = compiled.get(sig)
-        if fn is None:
-            in_specs = ({m: row for m in pcols}, row, {m: row for m in bcols}, row)
-            out_names = (list(probe_names) if join_type in ("semi", "anti")
-                         else list(probe_names) + list(build_names))
-            out_specs = ({m: row for m in out_names}, row, P())
-            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                                       out_specs=out_specs))
-            compiled[sig] = fn
-        return fn(pcols, pmask, bcols, bmask)
-
-    return run
+    return _make_join_runner(per_shard, mesh, probe_names, build_names,
+                             join_type, axis)
 
 
 def distributed_hash_join(
@@ -384,25 +408,8 @@ def distributed_hash_join(
             (ovf_exchange | ovf_j).astype(jnp.int32), axis) > 0
         return out_cols, out_mask, overflow
 
-    row = P(axis)
-    compiled: Dict[Tuple, object] = {}
-
-    def run(probe, build):
-        pcols, pmask = probe
-        bcols, bmask = build
-        sig = (tuple(sorted(pcols)), tuple(sorted(bcols)))
-        fn = compiled.get(sig)
-        if fn is None:
-            in_specs = ({m: row for m in pcols}, row, {m: row for m in bcols}, row)
-            out_names = (list(probe_names) if join_type in ("semi", "anti")
-                         else list(probe_names) + list(build_names))
-            out_specs = ({m: row for m in out_names}, row, P())
-            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                                       out_specs=out_specs))
-            compiled[sig] = fn
-        return fn(pcols, pmask, bcols, bmask)
-
-    return run
+    return _make_join_runner(per_shard, mesh, probe_names, build_names,
+                             join_type, axis)
 
 
 def distributed_grouped_aggregate(
