@@ -89,20 +89,12 @@ def distributed_filter_aggregate(
         return fk, fv, fmask, overflow
 
     row = P(axis)
-    compiled: Dict[Tuple[str, ...], object] = {}  # col-name set -> jitted fn
 
-    def run(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
-        key = tuple(sorted(cols))
-        fn = compiled.get(key)
-        if fn is None:
-            in_specs = ({name: row for name in cols}, row)
-            out_specs = ([row] * len(key_names), [row] * len(agg_specs), row, P())
-            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                                       out_specs=out_specs))
-            compiled[key] = fn
-        return fn(cols, mask)
+    def make_specs(cols, mask):
+        return ({name: row for name in cols}, row), \
+               ([row] * len(key_names), [row] * len(agg_specs), row, P())
 
-    return run
+    return _make_runner(per_shard, mesh, make_specs)
 
 
 def distributed_dense_aggregate(
@@ -157,21 +149,12 @@ def distributed_dense_aggregate(
 
     row = P(axis)
     rep = P()
-    compiled: Dict[Tuple[str, ...], object] = {}
 
-    def run(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
-        key = tuple(sorted(cols))
-        fn = compiled.get(key)
-        if fn is None:
-            in_specs = ({name: row for name in cols}, row)
-            out_specs = ([rep] * len(key_names), [rep] * len(agg_specs),
-                         rep, rep)
-            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                                       out_specs=out_specs))
-            compiled[key] = fn
-        return fn(cols, mask)
+    def make_specs(cols, mask):
+        return ({name: row for name in cols}, row), \
+               ([rep] * len(key_names), [rep] * len(agg_specs), rep, rep)
 
-    return run
+    return _make_runner(per_shard, mesh, make_specs)
 
 
 def distributed_partial_aggregate(
@@ -203,58 +186,95 @@ def distributed_partial_aggregate(
         return pk, pv, pmask, overflow
 
     row = P(axis)
-    compiled: Dict[Tuple[str, ...], object] = {}
 
-    def run(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
-        key = tuple(sorted(cols))
-        fn = compiled.get(key)
-        if fn is None:
-            in_specs = ({name: row for name in cols}, row)
-            out_specs = ([row] * len(key_names), [row] * len(agg_specs), row, P())
-            fn = jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                                       out_specs=out_specs))
-            compiled[key] = fn
-        return fn(cols, mask)
+    def make_specs(cols, mask):
+        return ({name: row for name in cols}, row), \
+               ([row] * len(key_names), [row] * len(agg_specs), row, P())
 
-    return run
+    return _make_runner(per_shard, mesh, make_specs)
+
+
+def _sig_of(cols, mask):
+    return (tuple((k, v.shape, str(v.dtype)) for k, v in sorted(cols.items())),
+            mask.shape)
+
+
+def _compile_once(cache: Dict, lock: threading.Lock, sig, build, args):
+    """Run ``build()(*args)`` exactly once per signature across threads.
+
+    jax.jit compiles lazily at the FIRST call; concurrent same-stage tasks
+    (MeshTaskJoinExec spreads one runner over N partition tasks) would
+    otherwise both trace+compile the same minutes-long TPU program.  The
+    global lock covers only the cache lookup/registration — the owner
+    compiles OFF the lock (waiters for that signature block on its event;
+    callers of already-compiled signatures proceed immediately)."""
+    with lock:
+        entry = cache.get(sig)
+        owner = entry is None
+        if owner:
+            entry = [None, threading.Event()]
+            cache[sig] = entry
+    if owner:
+        try:
+            fn = build()
+            out = fn(*args)  # lazy trace+compile happens here
+        except BaseException:
+            with lock:
+                cache.pop(sig, None)
+            entry[1].set()
+            raise
+        entry[0] = fn
+        entry[1].set()
+        return out
+    entry[1].wait()
+    fn = entry[0]
+    if fn is None:
+        # the owner failed; retry as a fresh owner
+        return _compile_once(cache, lock, sig, build, args)
+    return fn(*args)
+
+
+def _make_runner(per_shard, mesh, make_specs):
+    """Per-signature compile-once runner shared by every distributed
+    factory.  ``args`` is a flat sequence of (cols, mask) pairs;
+    ``make_specs(*args) -> (in_specs, out_specs)``."""
+
+    cache: Dict[Tuple, object] = {}
+    lock = threading.Lock()
+
+    def call(*args):
+        sig = tuple(_sig_of(args[i], args[i + 1])
+                    for i in range(0, len(args), 2))
+
+        def build():
+            in_specs, out_specs = make_specs(*args)
+            return jax.jit(jax.shard_map(per_shard, mesh=mesh,
+                                         in_specs=in_specs,
+                                         out_specs=out_specs))
+
+        return _compile_once(cache, lock, sig, build, args)
+
+    return call
 
 
 def _make_join_runner(per_shard, mesh, probe_names, build_names, join_type,
                       axis):
-    """Shared runner for the two join variants: a per-signature jit cache
-    whose FIRST invocation happens under a lock.  jax.jit compiles lazily
-    at the first call, and concurrent same-stage tasks (MeshTaskJoinExec)
-    would otherwise both trace+compile the same minutes-long TPU program;
-    the signature includes shapes/dtypes so every distinct compile is
-    first-called exactly once, and steady-state calls bypass the lock's
-    critical work."""
+    """Runner for the two join variants (see _compile_once)."""
     row = P(axis)
-    compiled: Dict[Tuple, object] = {}
-    lock = threading.Lock()
 
-    def _sig_of(cols, mask):
-        return (tuple((k, v.shape, str(v.dtype)) for k, v in sorted(cols.items())),
-                mask.shape)
+    def make_specs(pcols, pmask, bcols, bmask):
+        in_specs = ({m: row for m in pcols}, row, {m: row for m in bcols}, row)
+        out_names = (list(probe_names) if join_type in ("semi", "anti")
+                     else list(probe_names) + list(build_names))
+        out_specs = ({m: row for m in out_names}, row, P())
+        return in_specs, out_specs
+
+    call = _make_runner(per_shard, mesh, make_specs)
 
     def run(probe, build):
         pcols, pmask = probe
         bcols, bmask = build
-        sig = (_sig_of(pcols, pmask), _sig_of(bcols, bmask))
-        with lock:
-            fn = compiled.get(sig)
-            if fn is None:
-                in_specs = ({m: row for m in pcols}, row,
-                            {m: row for m in bcols}, row)
-                out_names = (list(probe_names) if join_type in ("semi", "anti")
-                             else list(probe_names) + list(build_names))
-                out_specs = ({m: row for m in out_names}, row, P())
-                fn = jax.jit(jax.shard_map(per_shard, mesh=mesh,
-                                           in_specs=in_specs,
-                                           out_specs=out_specs))
-                compiled[sig] = fn
-                # first call (the trace+compile) stays under the lock
-                return fn(pcols, pmask, bcols, bmask)
-        return fn(pcols, pmask, bcols, bmask)
+        return call(pcols, pmask, bcols, bmask)
 
     return run
 
