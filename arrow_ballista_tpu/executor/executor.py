@@ -35,6 +35,25 @@ from .execution_engine import DefaultExecutionEngine, ExecutionEngine
 log = logging.getLogger(__name__)
 
 
+def remove_job_data(work_dir: str, job_id: str) -> None:
+    """Delete ``<work_dir>/<job_id>`` (path-traversal guarded) and drop the
+    job's cached broadcast build tables.  Shared by the executor server's
+    remove_job_data RPC, its TTL janitor, and the standalone launcher's
+    scheduler-driven cleanup (reference executor_server.rs remove_job_data
+    with is_subdirectory guard)."""
+    import os
+    import shutil
+
+    from ..ops.operators import clear_job_build_caches
+
+    root = os.path.realpath(work_dir)
+    job_dir = os.path.realpath(os.path.join(work_dir, job_id))
+    if job_dir != root and os.path.commonpath([job_dir, root]) == root \
+            and os.path.isdir(job_dir):
+        shutil.rmtree(job_dir, ignore_errors=True)
+    clear_job_build_caches(job_id)
+
+
 class Executor:
     def __init__(self, metadata: ExecutorMetadata, work_dir: str,
                  config: Optional[BallistaConfig] = None,

@@ -225,7 +225,9 @@ class ExecutorServer:
                                 pass
                     if now - newest > self.job_data_ttl_s:
                         log.info("janitor removing stale job data %s", entry.path)
-                        shutil.rmtree(entry.path, ignore_errors=True)
+                        from .executor import remove_job_data
+
+                        remove_job_data(self.work_dir, entry.name)
             except Exception:  # noqa: BLE001 — janitor must survive
                 log.exception("shuffle janitor iteration failed")
 
@@ -377,9 +379,9 @@ class ExecutorServer:
         return {"num_bytes": len(data)}, data
 
     def _remove_job_data(self, payload: dict, _bin: bytes):
-        job_dir = os.path.join(self.work_dir, payload["job_id"])
-        if self._is_under_work_dir(job_dir) and os.path.isdir(job_dir):
-            shutil.rmtree(job_dir, ignore_errors=True)
+        from .executor import remove_job_data
+
+        remove_job_data(self.work_dir, payload["job_id"])
         return {}, b""
 
     def _stop_executor(self, payload: dict, _bin: bytes):

@@ -219,6 +219,12 @@ class MeshAggregateExec(ExecutionPlan):
         if not group_exprs:
             return False  # global aggregates: the plain path is already cheap
         for a in aggs:
+            if a.name.startswith(_HIDDEN_PREFIX):
+                # the hidden validity columns ride in-band under this prefix;
+                # a user aggregate aliased into it would collide with the
+                # hidden state and silently corrupt results — keep such
+                # plans on the (name-agnostic) file path
+                return False
             if a.func not in ("sum", "count", "min", "max"):
                 return False
             if a.operand is not None:

@@ -9,6 +9,8 @@ is an XLA program over fixed-capacity batches; data-dependent cardinalities
 from __future__ import annotations
 
 import dataclasses
+import threading
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -24,6 +26,37 @@ from ..utils.errors import CapacityError, ExecutionError, InternalError
 from .expressions import Compiled, ExprCompiler
 from . import kernels as K
 from .physical import ExecutionPlan, Partitioning, TaskContext
+
+
+# job-keyed weakref registry of join operators holding a materialized
+# broadcast build side.  The executor calls clear_job_build_caches() when a
+# job's shuffle data is removed (scheduler-driven cleanup or TTL janitor) so
+# a cached stage plan can't pin the build table in memory after the job.
+_build_cache_registry: Dict[str, list] = {}
+_build_cache_lock = threading.Lock()
+
+
+def _register_build_cache(job_id: str, op) -> None:
+    with _build_cache_lock:
+        _build_cache_registry.setdefault(job_id, []).append(weakref.ref(op))
+
+
+def clear_job_build_caches(job_id: str) -> None:
+    """Drop materialized broadcast build sides cached for ``job_id``."""
+    with _build_cache_lock:
+        refs = _build_cache_registry.pop(job_id, [])
+    for r in refs:
+        op = r()
+        if op is None:
+            continue
+        # the operator reads/installs its cache only under xla_lock — take
+        # it here too so the check-then-null can't race a concurrent task
+        # installing a DIFFERENT job's cache between the check and the
+        # assignment
+        with op.xla_lock():
+            cached = getattr(op, "_build_cache", None)
+            if cached is not None and cached[0] == job_id:
+                op._build_cache = None
 
 
 def _substitute_scalars(e: E.Expr, scalars: Dict[str, object]) -> E.Expr:
@@ -568,10 +601,13 @@ class JoinExec(ExecutionPlan):
             # subtree (scans included) per probe partition multiplied the
             # scan volume by the task count (the reference's CollectLeft
             # shares one built table the same way).  Keyed by job_id so any
-            # cross-job instance reuse can't serve stale rows; dropped once
-            # every probe partition has consumed it so a cached plan can't
-            # pin the materialized table in memory after the job (a late
-            # retry simply rebuilds).
+            # cross-job instance reuse can't serve stale rows.  Eviction is
+            # job-scoped, not partition-counted: in a multi-executor
+            # deployment each process runs only a subset of probe
+            # partitions, so a local consumption counter would never reach
+            # the plan-wide partition count and the table would stay pinned.
+            # The executor drops the cache when the job's data is cleaned
+            # (remove_job_data / janitor) via clear_job_build_caches().
             with self.xla_lock():
                 cached = getattr(self, "_build_cache", None)
                 if cached is None or cached[0] != ctx.job_id:
@@ -580,12 +616,10 @@ class JoinExec(ExecutionPlan):
                         build_parts.extend(self.right.execute(p, ctx))
                     build = concat_batches(self.right.schema,
                                            build_parts).shrink()
-                    cached = (ctx.job_id, build, set())
+                    cached = (ctx.job_id, build)
                     self._build_cache = cached
+                    _register_build_cache(ctx.job_id, self)
                 build = cached[1]
-                cached[2].add(partition)
-                if len(cached[2]) >= self.left.output_partition_count():
-                    self._build_cache = None
         else:
             build = concat_batches(self.right.schema, self.right.execute(partition, ctx)).shrink()
 

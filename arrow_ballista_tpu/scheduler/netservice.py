@@ -131,6 +131,10 @@ class NetTaskLauncher(TaskLauncher):
         except Exception:  # noqa: BLE001 — best effort
             log.warning("cancel_tasks on %s failed", executor_id, exc_info=True)
 
+    def clean_job_data(self, executor_id: str, job_id: str) -> None:
+        host, port = self._addr(executor_id)
+        wire.call(host, port, "remove_job_data", {"job_id": job_id})
+
 
 class SchedulerNetService:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,

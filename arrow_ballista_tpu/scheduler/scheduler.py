@@ -54,6 +54,11 @@ class TaskLauncher:
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
         """Best-effort cancellation of a job's running tasks."""
 
+    def clean_job_data(self, executor_id: str, job_id: str) -> None:
+        """Best-effort removal of a finished job's shuffle data on one
+        executor (reference ExecutorGrpc.remove_job_data fanout,
+        executor_manager.rs:231-253)."""
+
     def stop(self) -> None:
         pass
 
@@ -113,13 +118,22 @@ class SchedulerConfig:
                  executor_timeout_s: float = 180.0,
                  reaper_interval_s: float = 15.0,
                  event_buffer_size: int = 10000,
-                 policy: str = "push"):
+                 policy: str = "push",
+                 job_data_cleanup_delay_s: float = 30.0):
         assert policy in ("push", "pull")  # reference TaskSchedulingPolicy
         self.task_distribution = task_distribution
         self.executor_timeout_s = executor_timeout_s
         self.reaper_interval_s = reaper_interval_s
         self.event_buffer_size = event_buffer_size
         self.policy = policy
+        # delay before the remove_job_data fanout for a finished job: long
+        # enough for the client to fetch final-stage partitions, short
+        # enough that shuffle files don't pile up (reference delayed
+        # clean_up_job_data, executor_manager.rs:231-253).  <0 disables;
+        # in daemon deployments the executor TTL janitor remains as
+        # backstop, in standalone mode the work dir dies with the cluster
+        # (StandaloneCluster.shutdown).
+        self.job_data_cleanup_delay_s = job_data_cleanup_delay_s
 
 
 class SchedulerServer:
@@ -152,6 +166,8 @@ class SchedulerServer:
                                                thread_name_prefix="launch")
         self._reaper: Optional[threading.Thread] = None
         self._stopped = threading.Event()
+        self._cleanup_timers: Dict[str, threading.Timer] = {}
+        self._cleanup_lock = threading.Lock()
 
     # --- lifecycle -------------------------------------------------------
     def init(self, start_reaper: bool = True) -> None:
@@ -167,6 +183,11 @@ class SchedulerServer:
         # pool.shutdown (round-2 bench crash: "cannot schedule new futures
         # after shutdown" killed the event loop mid-run)
         self._stopped.set()
+        with self._cleanup_lock:
+            timers = list(self._cleanup_timers.values())
+            self._cleanup_timers.clear()
+        for t in timers:
+            t.cancel()
         self._event_loop.stop()
         self._launch_pool.shutdown(wait=False)
         self.launcher.stop()
@@ -362,6 +383,43 @@ class SchedulerServer:
         self.metrics.record_cancelled(ev.job_id)
         self._queued_at_ms.pop(ev.job_id, None)
         self._cancel_running(graph)
+        self._schedule_job_data_cleanup(graph)
+
+    # --- job-data cleanup ------------------------------------------------
+    def _schedule_job_data_cleanup(self, graph: ExecutionGraph) -> None:
+        """Schedule a delayed remove_job_data fanout to every executor
+        holding shuffle output for this finished job (reference
+        clean_up_job_data, executor_manager.rs:231-253).  The TTL janitor
+        on each executor remains the backstop for fanouts that miss."""
+        delay = self.config.job_data_cleanup_delay_s
+        if delay < 0 or self._stopped.is_set():
+            return
+        executors = sorted({eid for stage in graph.stages.values()
+                            for (eid, _w) in stage.outputs.values()})
+        if not executors:
+            return
+        job_id = graph.job_id
+
+        def fanout():
+            with self._cleanup_lock:
+                self._cleanup_timers.pop(job_id, None)
+            if self._stopped.is_set():
+                return
+            for eid in executors:
+                try:
+                    self.launcher.clean_job_data(eid, job_id)
+                except Exception:  # noqa: BLE001 — best effort
+                    log.warning("clean_job_data on %s failed", eid,
+                                exc_info=True)
+
+        timer = threading.Timer(delay, fanout)
+        timer.daemon = True
+        with self._cleanup_lock:
+            old = self._cleanup_timers.pop(job_id, None)
+            self._cleanup_timers[job_id] = timer
+        if old is not None:
+            old.cancel()
+        timer.start()
 
     def _cancel_running(self, graph: ExecutionGraph) -> None:
         executors = {eid for _, _, eid in graph.running_tasks()}
@@ -415,29 +473,58 @@ class SchedulerServer:
             graph = self.jobs.get_graph(job_id)
             if graph is None:
                 continue
-            checkpointed = False
-            for kind, payload in graph.update_task_status(sts):
-                if kind == "job_successful":
-                    # terminal state must be durable BEFORE waiters wake:
-                    # set_status releases wait_for_job, and a restarted
-                    # scheduler must never see a completed job as running
-                    self._checkpoint(graph)
-                    checkpointed = True
-                    self.jobs.set_status(
-                        JobStatus(job_id, "successful", locations=payload))
-                    self.metrics.record_completed(
-                        job_id, self._queued_at_ms.pop(job_id, 0),
-                        int(time.time() * 1000))
-                elif kind == "job_failed":
-                    self._checkpoint(graph)
-                    checkpointed = True
-                    self.jobs.set_status(
-                        JobStatus(job_id, "failed", error=str(payload)))
-                    self.metrics.record_failed(job_id)
-                    self._queued_at_ms.pop(job_id, None)
-                    self._cancel_running(graph)
-            if not checkpointed:
+            try:
+                self._absorb_job_statuses(job_id, graph, sts)
+            except Exception as e:  # noqa: BLE001 — scope the blast radius
+                # a crash absorbing ONE job's statuses must not fail the
+                # other jobs in the batch (their updates were already
+                # applied, or will be, independently)
+                log.exception("status absorption crashed for job %s", job_id)
+                st = self.jobs.get_status(job_id)
+                if st is not None and st.state in ("successful", "failed",
+                                                   "cancelled"):
+                    # the crash happened AFTER a terminal status was
+                    # published (e.g. in metrics/cleanup scheduling) —
+                    # don't overwrite what clients already saw
+                    continue
+                if graph.status == "running":
+                    graph.status = "failed"
+                self._queued_at_ms.pop(job_id, None)
+                # durable before visible, same as the success path below
                 self._checkpoint(graph)
+                self.jobs.set_status(JobStatus(
+                    job_id, "failed",
+                    error=f"status absorption crashed: "
+                          f"{type(e).__name__}: {e}"))
+                self.metrics.record_failed(job_id)
+
+    def _absorb_job_statuses(self, job_id: str, graph,
+                             sts: List[TaskStatus]) -> None:
+        checkpointed = False
+        for kind, payload in graph.update_task_status(sts):
+            if kind == "job_successful":
+                # terminal state must be durable BEFORE waiters wake:
+                # set_status releases wait_for_job, and a restarted
+                # scheduler must never see a completed job as running
+                self._checkpoint(graph)
+                checkpointed = True
+                self.jobs.set_status(
+                    JobStatus(job_id, "successful", locations=payload))
+                self.metrics.record_completed(
+                    job_id, self._queued_at_ms.pop(job_id, 0),
+                    int(time.time() * 1000))
+                self._schedule_job_data_cleanup(graph)
+            elif kind == "job_failed":
+                self._checkpoint(graph)
+                checkpointed = True
+                self.jobs.set_status(
+                    JobStatus(job_id, "failed", error=str(payload)))
+                self.metrics.record_failed(job_id)
+                self._queued_at_ms.pop(job_id, None)
+                self._cancel_running(graph)
+                self._schedule_job_data_cleanup(graph)
+        if not checkpointed:
+            self._checkpoint(graph)
 
     def _resolve_addr(self, executor_id: str):
         meta = self.cluster.get_executor(executor_id)

@@ -47,6 +47,11 @@ class InProcessTaskLauncher(TaskLauncher):
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
         self.executors[executor_id].cancel_job_tasks(job_id)
 
+    def clean_job_data(self, executor_id: str, job_id: str) -> None:
+        from ..executor.executor import remove_job_data
+
+        remove_job_data(self.executors[executor_id].work_dir, job_id)
+
     def stop(self) -> None:
         for ex in self.executors.values():
             ex.shutdown()

@@ -30,6 +30,10 @@ def main(argv=None) -> None:
     ap.add_argument("--scheduling-policy", choices=["push", "pull"],
                     default="push")
     ap.add_argument("--executor-timeout-s", type=float, default=180.0)
+    ap.add_argument("--job-data-cleanup-delay-s", type=float, default=30.0,
+                    help="delay before finished jobs' shuffle data is "
+                         "removed from executors (<0 disables; the "
+                         "executor TTL janitor remains as backstop)")
     ap.add_argument("--shuffle-partitions", type=int, default=16)
     ap.add_argument("--log-level", default="INFO")
     args = ap.parse_args(argv)
@@ -49,7 +53,8 @@ def main(argv=None) -> None:
         scheduler_config=SchedulerConfig(
             task_distribution=args.task_distribution,
             executor_timeout_s=args.executor_timeout_s,
-            policy=args.scheduling_policy),
+            policy=args.scheduling_policy,
+            job_data_cleanup_delay_s=args.job_data_cleanup_delay_s),
         rest_port=None if args.rest_port < 0 else args.rest_port,
         state_dir=args.state_dir,
         cluster_url=args.cluster_backend)

@@ -500,10 +500,16 @@ class Parser:
         key = self._dotted_ident()
         if not self.eat_op("="):  # exactly one of '=' or TO
             self.expect_kw("TO")
+        sign = ""
+        if self.peek().kind == "op" and self.peek().value in ("-", "+"):
+            # signed numeric values: SET ballista.x = -1
+            sign = self.next().value
+            if self.peek().kind != "number":
+                raise PlanningError(f"expected a number after SET {key} = {sign}")
         t = self.peek()
         if t.kind in ("string", "number", "ident"):
             self.next()
-            value = str(t.value)
+            value = ("" if sign == "+" else sign) + str(t.value)
         else:
             raise PlanningError(f"expected a value after SET {key}")
         return ast.SetVariable(key, value)

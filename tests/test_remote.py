@@ -166,3 +166,50 @@ def test_explain_over_the_wire(ctx):
     out = ctx.sql("EXPLAIN select region, sum(amount) s from sales group by region").to_pandas()
     assert out.plan_type.tolist() == ["logical_plan", "physical_plan"]
     assert "HashAggregateExec" in out.plan.iloc[1]
+
+
+def test_scheduler_driven_job_data_cleanup(tmp_path):
+    """Finished jobs' shuffle dirs are removed by the scheduler's delayed
+    remove_job_data fanout (reference executor_manager.rs:231-253 +
+    grpc.rs clean_job_data) — well before the executor TTL janitor."""
+    import os
+    import time
+
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+    from arrow_ballista_tpu.scheduler.scheduler import SchedulerConfig
+
+    sched = SchedulerNetService(
+        "127.0.0.1", 0,
+        config=BallistaConfig({"ballista.shuffle.partitions": "2"}),
+        scheduler_config=SchedulerConfig(job_data_cleanup_delay_s=0.5))
+    sched.start()
+    work = str(tmp_path / "work")
+    ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                        work_dir=work, concurrent_tasks=2,
+                        executor_id="cleanup-exec")
+    ex.start()
+    try:
+        c = BallistaContext.remote("127.0.0.1", sched.port,
+                                   BallistaConfig(
+                                       {"ballista.shuffle.partitions": "2"}))
+        rng = np.random.default_rng(7)
+        c.register_table("t", pa.table({
+            "g": pa.array(rng.integers(0, 4, 2000).astype(np.int64)),
+            "v": pa.array(rng.integers(0, 9, 2000).astype(np.int64))}))
+        out = c.sql("select g, sum(v) s from t group by g order by g").to_pandas()
+        assert len(out) == 4
+        # the group-by produced shuffle files under <work>/<job>/...
+        # the fanout fires ~0.5 s after completion
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            leftovers = [d for d in os.listdir(work)
+                         if os.path.isdir(os.path.join(work, d))]
+            if not leftovers:
+                break
+            time.sleep(0.2)
+        assert not leftovers, f"job dirs survived cleanup: {leftovers}"
+        c.shutdown()
+    finally:
+        ex.stop(notify=False)
+        sched.stop()

@@ -235,6 +235,10 @@ def test_set_statement_local_and_remote():
     ctx.sql("SET ballista.shuffle.mesh = true")
     from arrow_ballista_tpu.utils.config import MESH_SHUFFLE
     assert ctx.config.get(MESH_SHUFFLE) is True
+    # signed numeric values lex as op + number — must parse (advisor find)
+    from arrow_ballista_tpu.sql.parser import parse_sql as _parse
+    assert _parse("SET ballista.x = -1").value == "-1"
+    assert _parse("SET ballista.x = +120").value == "120"
     import pytest as _pytest
     from arrow_ballista_tpu.utils.errors import ConfigurationError
     with _pytest.raises(ConfigurationError):
