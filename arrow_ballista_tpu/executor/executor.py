@@ -34,6 +34,11 @@ from .execution_engine import DefaultExecutionEngine, ExecutionEngine
 
 log = logging.getLogger(__name__)
 
+# one id per executor PROCESS: in-proc standalone executors share plan
+# instances (and so cumulative MetricsSets) — stage metric aggregation keys
+# snapshots by this, not by executor_id
+PROCESS_ID = __import__("uuid").uuid4().hex[:12]
+
 
 def remove_job_data(work_dir: str, job_id: str) -> None:
     """Delete ``<work_dir>/<job_id>`` (path-traversal guarded) and drop the
@@ -100,7 +105,8 @@ class Executor:
                               shuffle_writes=writes,
                               launch_time_ms=launch_ms,
                               start_time_ms=start_ms, end_time_ms=end_ms,
-                              metrics=stage_exec.collect_plan_metrics())
+                              metrics=stage_exec.collect_plan_metrics(),
+                              process_id=PROCESS_ID)
         except FetchFailedError as e:
             return TaskStatus(tid, self.metadata.executor_id, "failed",
                               failure=FailedReason(

@@ -56,6 +56,21 @@ def physical_table_from_numpy(schema: Schema, data: Dict[str, np.ndarray],
                 if len(arr) and arr.max(initial=-1) >= 0:
                     raise InternalError(f"string column {f.name!r} missing dictionary")
                 dic = np.array([], dtype=object)
+            elif len(dic) > 2 * max(len(arr), 16):
+                # prune the dictionary to codes actually present: a hash
+                # shuffle slices a batch 46 ways, and writing the parent's
+                # full dictionary into every slice multiplied shuffle bytes
+                # ~50x on dictionary-heavy stages (q18: a 150k-entry c_name
+                # dictionary per 32k-row slice).  The read side re-unifies
+                # and re-sorts dictionaries, so the reordering is invisible.
+                codes = np.asarray(arr)
+                used = np.unique(codes[codes >= 0])
+                if len(used) < len(dic):
+                    remap = np.full(len(dic), -1, dtype=np.int32)
+                    remap[used] = np.arange(len(used), dtype=np.int32)
+                    arr = np.where(codes >= 0,
+                                   remap[np.clip(codes, 0, None)], codes)
+                    dic = np.asarray(dic, dtype=object)[used]
             idx = pa.array(arr, type=pa.int32())
             arrays.append(pa.DictionaryArray.from_arrays(idx, pa.array(dic, type=pa.string())))
         else:

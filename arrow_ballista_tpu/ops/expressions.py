@@ -89,12 +89,57 @@ def fold_constants(e: E.Expr) -> E.Expr:
 # --------------------------------------------------------------------------
 
 
+def _pad_pow2(v: np.ndarray, minimum: int = 16) -> np.ndarray:
+    """Pad a 1-D LUT to the next power-of-two length (shape bucketing; the
+    pad values are never read — LUTs are indexed by dictionary codes which
+    are always < the original length)."""
+    from ..models.batch import round_capacity
+
+    if v.ndim != 1:
+        return v
+    cap = round_capacity(v.shape[0], minimum)
+    if cap == v.shape[0]:
+        return v
+    return np.concatenate([v, np.zeros(cap - v.shape[0], dtype=v.dtype)])
+
+
 def _fnv1a64(s) -> int:
     """Deterministic 64-bit string hash (stable across processes/hosts —
     python's builtin hash() is salted and unusable for shuffles)."""
     h = 0xCBF29CE484222325
     for b in str(s).encode("utf-8"):
         h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _fnv1a64_many(strings) -> np.ndarray:
+    """Vectorized _fnv1a64 over a sequence of strings: bit-identical to the
+    scalar version, but O(max_len) numpy passes instead of a Python loop
+    per character.  Matters because hash LUTs are rebuilt per merged
+    dictionary — a 150k-entry c_name dictionary took ~3 s/task scalar
+    (measured dominating q18's shuffle write)."""
+    enc = [str(s).encode("utf-8") for s in strings]
+    n = len(enc)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    lens = np.fromiter((len(b) for b in enc), dtype=np.int64, count=n)
+    if int(lens.max()) == 0:
+        return np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    flat = np.frombuffer(b"".join(enc), dtype=np.uint8)
+    offsets = np.zeros(n, dtype=np.int64)
+    np.cumsum(lens[:-1], out=offsets[1:])
+    h = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    prime = np.uint64(0x100000001B3)
+    live = np.arange(n)
+    pos = 0
+    with np.errstate(over="ignore"):
+        while live.size:
+            sel = live[lens[live] > pos]
+            if sel.size == 0:
+                break
+            h[sel] = (h[sel] ^ flat[offsets[sel] + pos].astype(np.uint64)) * prime
+            live = sel
+            pos += 1
     return h
 
 
@@ -171,7 +216,7 @@ class ExprCompiler:
             dic = df(d)
             if len(dic) == 0:
                 return np.zeros(1, dtype=np.uint64)
-            return np.array([_fnv1a64(s) for s in dic], dtype=np.uint64)
+            return _fnv1a64_many(dic)
 
         slot = self._slot(hash_lut)
         sent = self.NULL_KEY_SENTINEL
@@ -200,7 +245,15 @@ class ExprCompiler:
             if entry is None:
                 raw = self.build_aux(dicts)
                 if self.mode == "device":
-                    hit = {k: jnp.asarray(v) for k, v in raw.items()}
+                    # pad LUTs to power-of-two lengths: every distinct aux
+                    # shape is a distinct XLA program, and per-task
+                    # dictionaries (shuffled string columns) vary in size —
+                    # unpadded, a 46-task stage compiled its repartition
+                    # kernel 46 times (measured 157 task-seconds on q18's
+                    # 58-row agg output).  Safe: every builder's array is
+                    # only indexed by codes < len.
+                    hit = {k: jnp.asarray(_pad_pow2(np.asarray(v)))
+                           for k, v in raw.items()}
                 else:
                     hit = raw
                 if len(self._aux_cache) > 64:

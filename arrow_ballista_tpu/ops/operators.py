@@ -57,6 +57,9 @@ def clear_job_build_caches(job_id: str) -> None:
             cached = getattr(op, "_build_cache", None)
             if cached is not None and cached[0] == job_id:
                 op._build_cache = None
+            pc = getattr(op, "_prep_cache", None)
+            if pc is not None and pc[0] == job_id:
+                op._prep_cache = None
 
 
 def _substitute_scalars(e: E.Expr, scalars: Dict[str, object]) -> E.Expr:
@@ -389,6 +392,16 @@ class HashAggregateExec(ExecutionPlan):
         in_schema = self.input.schema
         big = concat_batches(in_schema, batches).shrink()
 
+        if self.mode == "partial" and self.group_exprs \
+                and getattr(self, "_passthrough", False):
+            # adaptive partial-agg skip (DataFusion does the same): when a
+            # sibling task observed near-no reduction (high-cardinality
+            # keys like l_orderkey), aggregating before the shuffle burns
+            # kernel time for nothing — emit per-row states instead.  Any
+            # mix of aggregated and passthrough partials merges correctly
+            # at the final (sum of sums == sum of values, etc.).
+            return self._execute_passthrough(ctx, big, in_schema)
+
         # lock covers ONLY the compiled-closure build: concurrent tasks
         # must not race the lazy build (N duplicate jit objects = N
         # compiles), but dispatch+sync run outside so one task's transfer
@@ -397,6 +410,61 @@ class HashAggregateExec(ExecutionPlan):
         with self.xla_lock():
             self._ensure_compiled(ctx, in_schema)
         return self._execute_device(ctx, cfg_cap, big)
+
+    def _execute_passthrough(self, ctx, big, in_schema):
+        with self.xla_lock():
+            if getattr(self, "_pt_compiled", None) is None:
+                comp = ExprCompiler(in_schema, "device")
+                group_c = [(comp.compile(_substitute_scalars(e, ctx.scalars)), n)
+                           for e, n in self.group_exprs]
+                agg_items = []
+                for a in self.aggs:
+                    f = self._schema.field(a.name)
+                    cc = comp.compile(_substitute_scalars(a.operand, ctx.scalars)) \
+                        if a.operand is not None else None
+                    nc = null_check_of(cc, a.operand, in_schema)
+                    agg_items.append((cc, a.func, a.name, nc, f.dtype))
+
+                def pt_fn(cols, mask, aux):
+                    out = {}
+                    for c, n in group_c:
+                        k = c.fn(cols, aux)
+                        out[n] = jnp.broadcast_to(k, mask.shape) if k.ndim == 0 else k
+                    for cc, how, name, nc, dt in agg_items:
+                        np_dt = dt.np_dtype
+                        if cc is None:  # count(*): one per row
+                            out[name] = jnp.ones(mask.shape, np_dt)
+                            continue
+                        v = cc.fn(cols, aux)
+                        if v.ndim == 0:
+                            v = jnp.broadcast_to(v, mask.shape)
+                        valid = valid_of(v, nc) if nc is not None else None
+                        if how == "count":
+                            ones = jnp.ones(mask.shape, np_dt)
+                            out[name] = (jnp.where(valid, ones, 0)
+                                         if valid is not None else ones)
+                        else:  # sum/min/max state = the value (NULL -> sentinel)
+                            v = v.astype(np_dt)
+                            if valid is not None:
+                                sent = jnp.asarray(dt.null_sentinel, dtype=np_dt)
+                                v = jnp.where(valid, v, sent)
+                            out[name] = v
+                    return out
+
+                self._pt_compiled = (comp, group_c, jax.jit(pt_fn))
+        comp, group_c, ptfn = self._pt_compiled
+        with self.metrics().timer("agg_time"):
+            aux = comp.aux_arrays(big.dicts)
+            cols = ptfn(big.columns, big.mask, aux)
+        dicts = {}
+        for cc, name in group_c:
+            if cc.dict_fn is not None:
+                dicts[name] = cc.dict_fn(big.dicts)
+        result = ColumnBatch(self._schema, dict(cols), big.mask, dicts,
+                             num_rows=big.num_rows)
+        self.metrics().add("passthrough_partials", 1)
+        self.metrics().add("output_rows", result.num_rows)
+        return [result]
 
     def _ensure_compiled(self, ctx, in_schema):
         if self._compiled is None:
@@ -467,7 +535,15 @@ class HashAggregateExec(ExecutionPlan):
         for cc, _n in group_c:
             if cc.dtype.is_string and cc.dict_fn is not None:
                 dic = cc.dict_fn(big.dicts)
-                key_ranges.append((-1, int(len(dic)) - 1))
+                # round the code range up to a power of two: key_ranges is a
+                # static jit argument, and per-task dictionary sizes (pruned
+                # shuffle dicts) would otherwise compile one program per
+                # task.  Codes stay < len(dic), so the wider range only
+                # over-allocates the dense domain by <2x.  Same bucketing
+                # rule as the aux-LUT padding (expressions._pad_pow2).
+                from ..models.batch import round_capacity
+
+                key_ranges.append((-1, round_capacity(len(dic), 16) - 1))
             elif cc.dtype.kind == "bool":
                 key_ranges.append((0, 1))
             else:
@@ -478,6 +554,13 @@ class HashAggregateExec(ExecutionPlan):
         # groups can never exceed live rows).  Mirrors the join's bucketed
         # recompilation; static shapes stay static per bucket.
         out_cap = min(cfg_cap, big.capacity)
+        # same-stage tasks see similar cardinality and share this operator
+        # instance: once one task discovers the real group count, the rest
+        # start at that capacity instead of re-paying the overflow-retry
+        # ladder (observed: 24 full kernel re-runs for q17's group-by on
+        # l_partkey at SF1 without this)
+        out_cap = min(max(out_cap, getattr(self, "_cap_hint", 0)),
+                      big.capacity)
         # dense domain bounds distinct groups exactly: don't allocate (or
         # device->host transfer) a 64k-row output for 12 possible groups
         domain = K.dense_domain(key_ranges)
@@ -497,6 +580,8 @@ class HashAggregateExec(ExecutionPlan):
                     )
                 out_cap = min(out_cap * 2, big.capacity)
                 self.metrics().add("capacity_recompiles", 1)
+        if out_cap > getattr(self, "_cap_hint", 0):
+            self._cap_hint = out_cap
 
         cols: Dict[str, jnp.ndarray] = {}
         dicts: Dict[str, np.ndarray] = {}
@@ -530,6 +615,12 @@ class HashAggregateExec(ExecutionPlan):
                     data[a.name] = np.zeros(1, dtype=f.dtype.np_dtype)
             result = ColumnBatch.from_numpy(self._schema, data, dicts={})
         self.metrics().add("output_rows", result.num_rows)
+        # poor reduction on a large input => sibling tasks (same cardinality
+        # profile) skip partial aggregation entirely and emit per-row states
+        if self.mode == "partial" and self.group_exprs \
+                and big.num_rows >= (1 << 17) \
+                and result.num_rows > 0.6 * big.num_rows:
+            self._passthrough = True
         return [result]
 
     def _label(self):
@@ -654,10 +745,19 @@ class JoinExec(ExecutionPlan):
             rfill = {f.name: f.dtype.null_sentinel for f in rsch}
             lfill = {f.name: f.dtype.null_sentinel for f in lsch}
 
-            def join_fn(pcols, pmask, bcols, bmask, laux, raux, faux, out_cap):
-                pk = [c.fn(pcols, laux) for c in lkeys]
+            def prep_fn(bcols, bmask, raux):
+                # build-side hash + sort, hoisted out of the per-task probe:
+                # a broadcast build is shared by every probe partition, and
+                # re-sorting a 1.5M-row build inside all 12 task dispatches
+                # was measured at 61 task-seconds on q21's l1/orders join
                 bk = [c.fn(bcols, raux) for c in rkeys]
                 bh_sorted, border, _ = K.build_side_sort(bk, bmask)
+                return bh_sorted, border
+
+            def join_fn(pcols, pmask, bcols, bmask, bh_sorted, border,
+                        laux, raux, faux, out_cap):
+                pk = [c.fn(pcols, laux) for c in lkeys]
+                bk = [c.fn(bcols, raux) for c in rkeys]
                 ph = K.hash64(pk)
                 pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
                 bidx = border[bp]
@@ -722,32 +822,52 @@ class JoinExec(ExecutionPlan):
                     out_mask = jnp.concatenate([out_mask, miss_b])
                 return out_cols, out_mask, total
 
-            def count_fn(pcols, pmask, bcols, bmask, laux, raux):
+            def count_fn(pcols, pmask, bh_sorted, laux):
                 # candidate-pair count only: the same hi-lo arithmetic the
                 # join performs, none of the gathers — sizes the output
                 # buffers to reality instead of out_factor x probe capacity
                 # (a 1M-row probe batch with 30k matches would otherwise
                 # gather every output column into 2M-row buffers)
                 pk = [c.fn(pcols, laux) for c in lkeys]
-                bk = [c.fn(bcols, raux) for c in rkeys]
-                bh_sorted, _, _ = K.build_side_sort(bk, bmask)
                 ph = K.hash64(pk)
                 lo = jnp.searchsorted(bh_sorted, ph, side="left")
                 hi = jnp.searchsorted(bh_sorted, ph, side="right")
                 return jnp.sum(jnp.where(pmask, hi - lo, 0))
 
             self._compiled = (lcomp, rcomp, fcomp,
-                              jax.jit(join_fn, static_argnums=(7,)),
-                              jax.jit(count_fn))
+                              jax.jit(join_fn, static_argnums=(9,)),
+                              jax.jit(count_fn), jax.jit(prep_fn))
 
     def _join_device(self, ctx, probe, build, lsch, rsch):
-        lcomp, rcomp, fcomp, jfn, cfn = self._compiled
+        lcomp, rcomp, fcomp, jfn, cfn, pfn = self._compiled
 
         laux = lcomp.aux_arrays(probe.dicts)
         raux = rcomp.aux_arrays(build.dicts)
         faux = fcomp.aux_arrays({**probe.dicts, **build.dicts}) if fcomp is not None else {}
 
         with self.metrics().timer("join_time"):
+            # build-side hash+sort: computed once per broadcast build and
+            # shared by every probe task (cache keyed like _build_cache);
+            # partitioned builds differ per task and prep inline
+            prep = None
+            if self.dist == "broadcast":
+                pc = getattr(self, "_prep_cache", None)
+                if pc is not None and pc[0] == ctx.job_id and pc[1] is build:
+                    prep = pc[2]
+            if prep is None:
+                bh_sorted, border = pfn(build.columns, build.mask, raux)
+                prep = (bh_sorted, border)
+                if self.dist == "broadcast":
+                    # install under xla_lock and only while the build cache
+                    # for this job is still alive: a concurrent
+                    # clear_job_build_caches (which pops the registry entry)
+                    # must not be followed by a re-install nothing would
+                    # ever evict
+                    with self.xla_lock():
+                        bc = getattr(self, "_build_cache", None)
+                        if bc is not None and bc[0] == ctx.job_id:
+                            self._prep_cache = (ctx.job_id, build, prep)
+            bh_sorted, border = prep
             # count pass -> exact candidate total -> power-of-two capacity
             # bucket (static shapes stay static per bucket — the
             # XLA-friendly answer to data-dependent join fan-out,
@@ -756,8 +876,7 @@ class JoinExec(ExecutionPlan):
             # bucket instead of compiling per data-dependent power of two
             # (compiles cost minutes on TPU); clamped to the ceiling so
             # pow2 rounding can never allocate above the configured cap.
-            total_est = int(cfn(probe.columns, probe.mask,
-                                build.columns, build.mask, laux, raux))
+            total_est = int(cfn(probe.columns, probe.mask, bh_sorted, laux))
             ceiling = ctx.config.get(JOIN_MAX_CAPACITY)
             if total_est > ceiling:
                 raise CapacityError(
@@ -770,7 +889,7 @@ class JoinExec(ExecutionPlan):
                 out_cap = max(total_est, 64)
             out_cols, out_mask, total = jfn(
                 probe.columns, probe.mask, build.columns, build.mask,
-                laux, raux, faux, out_cap
+                bh_sorted, border, laux, raux, faux, out_cap
             )
             # the join's own count uses the same hi-lo arithmetic, so the
             # retry can only fire if something drifts between the two
@@ -784,7 +903,7 @@ class JoinExec(ExecutionPlan):
                 self.metrics().add("capacity_recompiles", 1)
                 out_cols, out_mask, total = jfn(
                     probe.columns, probe.mask, build.columns, build.mask,
-                    laux, raux, faux, need
+                    bh_sorted, border, laux, raux, faux, need
                 )
 
         dicts = dict(probe.dicts)

@@ -88,18 +88,35 @@ class ExecutionStage:
         self.outputs: Dict[int, Tuple[str, List[ShuffleWritePartition]]] = {}
 
     def aggregate_metrics(self) -> Dict[str, float]:
-        """Fold every completed task's per-operator metrics into one
-        '<op>.<metric>' -> sum dict (consumed by the REST stage view and
-        the bench profiler)."""
-        agg: Dict[str, float] = {}
+        """Fold completed tasks' per-operator metrics into one
+        '<op>.<metric>' -> total dict (consumed by the REST stage view and
+        the bench profiler).
+
+        Same-stage tasks in one executor process share operator instances,
+        so each task status snapshots the *cumulative* counters at its
+        completion time — summing snapshots would overcount quadratically
+        (observed: a 6M-row scan reported as 49M).  The stage total is the
+        LAST snapshot per PROCESS (counters are monotone; in-proc
+        standalone executors share one process and one plan instance),
+        summed across processes (separate processes decode separate plan
+        instances)."""
+        per_exec: Dict[str, Dict[str, float]] = {}
         for t in self.task_infos:
             st = getattr(t, "status", None)
             if st is None:
                 continue
+            dst = per_exec.setdefault(
+                getattr(st, "process_id", "") or getattr(t, "executor_id", ""),
+                {})
             for op, mm in (st.metrics or {}).items():
                 for k, v in mm.items():
                     kk = f"{op}.{k}"
-                    agg[kk] = agg.get(kk, 0.0) + v
+                    if v > dst.get(kk, float("-inf")):
+                        dst[kk] = v
+        agg: Dict[str, float] = {}
+        for mm in per_exec.values():
+            for kk, v in mm.items():
+                agg[kk] = agg.get(kk, 0.0) + v
         return agg
 
     # --- queries ---------------------------------------------------------

@@ -267,7 +267,65 @@ class PhysicalPlanner:
         outer_aggs = [(E.Agg(a.func, E.Column(dkey)), n) for a, n in distincts]
         return L.Aggregate(inner, outer_groups, outer_aggs)
 
+    def _reorder_inner_chain(self, node: L.Join) -> L.Join:
+        """Reorder a left-deep chain of INNER equi-joins so the most
+        selective builds apply first (greedy ascending build-size estimate,
+        subject to key-column availability).  Inner joins commute; applying
+        a 25-row filtered dimension before a 1.5M-row one cuts the probe
+        early (q21: nation's n_name filter reduced 3.7M rows to 155k but
+        ran LAST in SQL order — 28 task-seconds probing orders for rows
+        the nation join was about to discard).  The reference inherits the
+        analogous join selection from DataFusion's optimizer."""
+        chain = []  # (right, on, filter) from the top down
+        cur: L.LogicalPlan = node
+        while isinstance(cur, L.Join) and cur.join_type == "inner" \
+                and cur.on:
+            chain.append((cur.right, cur.on, cur.filter))
+            cur = cur.left
+        if len(chain) < 2:
+            return node
+        base = cur
+        chain.reverse()  # original application order
+
+        def deps(on, filt, right_names):
+            refs = set()
+            for le, _re in on:
+                refs |= le.column_refs()
+            if filt is not None:
+                refs |= filt.column_refs() - right_names
+            return refs
+
+        items = []
+        for right, on, filt in chain:
+            rnames = {f.name for f in right.schema}
+            items.append({"right": right, "on": on, "filter": filt,
+                          "names": rnames,
+                          "deps": deps(on, filt, rnames),
+                          "est": self._estimate_rows(right)})
+        available = {f.name for f in base.schema}
+        order = []
+        remaining = list(items)
+        while remaining:
+            ready = [it for it in remaining if it["deps"] <= available]
+            if not ready:
+                return node  # cross-dependency we don't model: keep SQL order
+            pick = min(ready, key=lambda it: it["est"])
+            order.append(pick)
+            available |= pick["names"]
+            remaining.remove(pick)
+        # identity comparison: the logical nodes are field-less dataclasses
+        # whose generated __eq__ compares nothing (all same-class instances
+        # are "equal"), so == would always report the order unchanged
+        if all(a["right"] is b["right"] for a, b in zip(order, items)):
+            return node
+        out: L.LogicalPlan = base
+        for it in order:
+            out = L.Join(out, it["right"], it["on"], "inner", it["filter"])
+        return out
+
     def _plan_join(self, node: L.Join) -> ExecutionPlan:
+        if node.join_type == "inner":
+            node = self._reorder_inner_chain(node)
         left = self.create(node.left)
         right = self.create(node.right)
         on = [(self._prep_expr(l), self._prep_expr(r)) for l, r in node.on]

@@ -77,6 +77,10 @@ class TaskStatus:
     start_time_ms: int = 0
     end_time_ms: int = 0
     metrics: Dict[str, Dict[str, int]] = dataclasses.field(default_factory=dict)
+    # identity of the executing PROCESS (not executor: in-proc standalone
+    # executors share one process and thus one plan instance / MetricsSet;
+    # stage metric aggregation must dedupe cumulative snapshots per process)
+    process_id: str = ""
 
 
 @dataclasses.dataclass

@@ -472,6 +472,7 @@ def status_to_obj(st: TaskStatus) -> dict:
         "failure": vars(st.failure) if st.failure else None,
         "launch_ms": st.launch_time_ms, "start_ms": st.start_time_ms,
         "end_ms": st.end_time_ms, "metrics": st.metrics,
+        "process_id": st.process_id,
     }
 
 
@@ -481,4 +482,4 @@ def status_from_obj(o: dict) -> TaskStatus:
         [ShuffleWritePartition(**w) for w in o["writes"]],
         FailedReason(**o["failure"]) if o.get("failure") else None,
         o.get("launch_ms", 0), o.get("start_ms", 0), o.get("end_ms", 0),
-        o.get("metrics", {}))
+        o.get("metrics", {}), o.get("process_id", ""))
