@@ -106,7 +106,13 @@ class Executor:
                               launch_time_ms=launch_ms,
                               start_time_ms=start_ms, end_time_ms=end_ms,
                               metrics=stage_exec.collect_plan_metrics(),
-                              process_id=PROCESS_ID)
+                              # key = plan INSTANCE: cumulative MetricsSets
+                              # are monotone per decoded plan object, and a
+                              # process can host several instances of one
+                              # stage (fetch-failure re-resolve changes the
+                              # plan blob; LRU eviction re-decodes) — see
+                              # ExecutionStage.aggregate_metrics
+                              process_id=f"{PROCESS_ID}-{id(task.plan):x}")
         except FetchFailedError as e:
             return TaskStatus(tid, self.metadata.executor_id, "failed",
                               failure=FailedReason(

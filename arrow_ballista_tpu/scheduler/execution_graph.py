@@ -96,10 +96,13 @@ class ExecutionStage:
         so each task status snapshots the *cumulative* counters at its
         completion time — summing snapshots would overcount quadratically
         (observed: a 6M-row scan reported as 49M).  The stage total is the
-        LAST snapshot per PROCESS (counters are monotone; in-proc
-        standalone executors share one process and one plan instance),
-        summed across processes (separate processes decode separate plan
-        instances)."""
+        LAST snapshot per PLAN INSTANCE (statuses carry a
+        process+instance id; counters are monotone per decoded plan
+        object), summed across instances — correct across processes,
+        in-proc multi-executor standalone mode, fetch-failure re-resolves
+        and plan-cache evictions alike (id() reuse after GC could in
+        principle alias two instances; metrics are observability, not
+        correctness)."""
         per_exec: Dict[str, Dict[str, float]] = {}
         for t in self.task_infos:
             st = getattr(t, "status", None)

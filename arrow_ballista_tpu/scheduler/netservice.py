@@ -142,7 +142,8 @@ class SchedulerNetService:
                  scheduler_config: Optional[SchedulerConfig] = None,
                  rest_port: Optional[int] = None,
                  state_dir: Optional[str] = None,
-                 cluster_url: Optional[str] = None):
+                 cluster_url: Optional[str] = None,
+                 flight_port: Optional[int] = None):
         self.config = config or BallistaConfig()
         self.catalog = SchemaCatalog()
         launcher = NetTaskLauncher()
@@ -214,6 +215,13 @@ class SchedulerNetService:
 
             self.rest = RestApi(self.server, host, rest_port)
 
+        # Arrow Flight (SQL) front door (reference flight_sql.rs:83-911)
+        self.flight = None
+        if flight_port is not None:
+            from .flight_service import BallistaFlightServer
+
+            self.flight = BallistaFlightServer(self, host, flight_port)
+
     def start(self) -> None:
         import time as _time
 
@@ -222,6 +230,8 @@ class SchedulerNetService:
         self.rpc.start()
         if self.rest is not None:
             self.rest.start()
+        if self.flight is not None:
+            self.flight.start()
         if self.server.job_backend is not None:
             self.server.recover_jobs()
 
@@ -230,6 +240,8 @@ class SchedulerNetService:
         self.rpc.stop()
         if self.rest is not None:
             self.rest.stop()
+        if self.flight is not None:
+            self.flight.stop()
 
     # --- sessions (the Flight SQL handshake analog) -----------------------
     def _session_ctx(self, payload: dict):

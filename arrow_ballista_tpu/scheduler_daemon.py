@@ -18,6 +18,10 @@ def main(argv=None) -> None:
     ap.add_argument("--bind-port", type=int, default=50050)
     ap.add_argument("--rest-port", type=int, default=50051,
                     help="HTTP REST API port (-1 disables)")
+    ap.add_argument("--flight-port", type=int, default=-1,
+                    help="Arrow Flight (SQL) port (-1 disables; 0 = any). "
+                         "JDBC-class Flight SQL clients and stock "
+                         "pyarrow.flight clients connect here")
     ap.add_argument("--state-dir", default=None,
                     help="persist job graphs here for crash recovery / "
                          "multi-scheduler adoption")
@@ -57,7 +61,8 @@ def main(argv=None) -> None:
             job_data_cleanup_delay_s=args.job_data_cleanup_delay_s),
         rest_port=None if args.rest_port < 0 else args.rest_port,
         state_dir=args.state_dir,
-        cluster_url=args.cluster_backend)
+        cluster_url=args.cluster_backend,
+        flight_port=None if args.flight_port < 0 else args.flight_port)
     svc.start()
     logging.info("scheduler listening on %s:%s (rest: %s)", svc.host, svc.port,
                  svc.rest.port if svc.rest else "disabled")
