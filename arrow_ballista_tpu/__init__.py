@@ -14,6 +14,19 @@ Arrow/DataFusion, reference at /root/reference) re-designed for TPU:
 from __future__ import annotations
 
 import os as _os
+import sys as _sys
+
+# pyarrow's bundled mimalloc pool was observed corrupting memory when it
+# shares a process with XLA's runtime (scheduler daemon SIGSEGV inside
+# ipc write_table, ~60% of runs; 10/10 clean with the system allocator).
+# Force the system pool before pyarrow first allocates.
+_os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+if "pyarrow" in _sys.modules:  # imported before us: switch the pool live
+    try:
+        _sys.modules["pyarrow"].set_memory_pool(
+            _sys.modules["pyarrow"].system_memory_pool())
+    except Exception:  # noqa: BLE001 — allocator choice is a mitigation
+        pass
 
 import jax as _jax
 
@@ -28,7 +41,15 @@ _jax.config.update("jax_enable_x64", True)
 # queries AND processes.  Opt out with BALLISTA_XLA_CACHE=0 or point it
 # elsewhere with BALLISTA_XLA_CACHE=<dir>.
 _cache = _os.environ.get("BALLISTA_XLA_CACHE", "")
-if _cache != "0":
+if _cache != "0" and not (
+        not _cache
+        and _os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu"):
+    # cpu-forced processes skip the implicit cache: CPU compiles are cheap,
+    # and the cache's AOT entries are machine-feature-stamped — loading
+    # them emits a ~3KB LOG(ERROR) per entry (enough to fill a captured
+    # stdout pipe and freeze a daemon) and risks SIGILL when the host
+    # changes generations.  TPU keeps it (sort compiles cost 30-110s);
+    # set BALLISTA_XLA_CACHE=<dir> to opt a cpu process back in.
     if not _cache:
         # per-platform dirs: entries carry machine-specific AOT artifacts
         # (a TPU-tunnel process compiles host programs on the REMOTE
@@ -37,6 +58,22 @@ if _cache != "0":
         # never share a cache
         _plat = (_os.environ.get("JAX_PLATFORMS", "").split(",")[0]
                  or "default")
+        # fingerprint the host CPU into the cache path: AOT entries encode
+        # machine features, and this host can change generations across
+        # runs (observed: entries compiled with amx-complex loaded on a
+        # host without it — "could lead to execution errors such as
+        # SIGILL", and one executor daemon did abort)
+        try:
+            import hashlib as _hl
+
+            with open("/proc/cpuinfo") as _f:
+                for _line in _f:
+                    if _line.startswith("flags"):
+                        _plat += "-" + _hl.sha256(
+                            _line.encode()).hexdigest()[:8]
+                        break
+        except OSError:
+            pass
         _cache = _os.path.join(
             _os.environ.get("XDG_CACHE_HOME",
                             _os.path.expanduser("~/.cache")),

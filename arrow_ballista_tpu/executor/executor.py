@@ -19,7 +19,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..ops.physical import TaskContext
 from ..utils.config import BallistaConfig
-from ..utils.errors import FetchFailedError, IOError_
+from ..utils.errors import CancelledError, FetchFailedError, IOError_
 from ..scheduler.types import (
     EXECUTION_ERROR,
     FETCH_PARTITION_ERROR,
@@ -95,7 +95,8 @@ class Executor:
             ctx = TaskContext(config=self.config, scalars=dict(task.scalars),
                               work_dir=self.work_dir, job_id=tid.job_id,
                               stage_id=tid.stage_id,
-                              executor_id=self.metadata.executor_id)
+                              executor_id=self.metadata.executor_id,
+                              cancelled=lambda: tid.job_id in self._cancelled_jobs)
             start_ms = int(time.time() * 1000)
             writes = stage_exec.execute_query_stage(tid.partition, ctx)
             end_ms = int(time.time() * 1000)
@@ -113,6 +114,11 @@ class Executor:
                               # plan blob; LRU eviction re-decodes) — see
                               # ExecutionStage.aggregate_metrics
                               process_id=f"{PROCESS_ID}-{id(task.plan):x}")
+        except CancelledError:
+            # the operator noticed the job's cancel flag between batches
+            # (reference abortable execution, executor.rs:114-144): the
+            # slot frees without waiting out the plan
+            return TaskStatus(tid, self.metadata.executor_id, "killed")
         except FetchFailedError as e:
             return TaskStatus(tid, self.metadata.executor_id, "failed",
                               failure=FailedReason(

@@ -35,6 +35,10 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=args.log_level,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # native-crash forensics: a SIGSEGV in a daemon otherwise dies silently
+    import faulthandler
+
+    faulthandler.enable()
 
     from .executor.server import ExecutorServer
     from .net import wire

@@ -177,9 +177,10 @@ class Schema:
     def merge(self, other: "Schema") -> "Schema":
         return Schema(list(self.fields) + list(other.fields))
 
-    def to_arrow_empty(self):
-        """An empty pyarrow table with this schema's logical arrow types
-        (used by scans whose every row group was pruned)."""
+    def to_arrow_schema(self):
+        """This schema's logical arrow types (strings as plain utf8,
+        decimals as decimal128(38, scale)) — shared by pruned-scan empty
+        tables and the Flight stream schema."""
         import pyarrow as pa
 
         mapping = {
@@ -187,13 +188,21 @@ class Schema:
             "float64": pa.float64(), "bool": pa.bool_(), "date32": pa.date32(),
             "string": pa.string(),
         }
-        arrays, fields = [], []
+        fields = []
         for f in self.fields:
             t = (pa.decimal128(38, f.dtype.scale) if f.dtype.is_decimal
                  else mapping[f.dtype.kind])
-            arrays.append(pa.array([], type=t))
             fields.append(pa.field(f.name, t, nullable=f.nullable))
-        return pa.table(arrays, schema=pa.schema(fields))
+        return pa.schema(fields)
+
+    def to_arrow_empty(self):
+        """An empty pyarrow table with this schema's logical arrow types
+        (used by scans whose every row group was pruned)."""
+        import pyarrow as pa
+
+        schema = self.to_arrow_schema()
+        return pa.table([pa.array([], type=f.type) for f in schema],
+                        schema=schema)
 
     def __eq__(self, other):
         return isinstance(other, Schema) and self.fields == other.fields

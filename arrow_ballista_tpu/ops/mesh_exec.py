@@ -257,7 +257,7 @@ class MeshAggregateExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         from ..parallel.distributed import distributed_filter_aggregate
-        from ..parallel.mesh import make_mesh, row_sharding
+        from ..parallel.mesh import MESH_DISPATCH_LOCK, make_mesh, row_sharding
 
         assert partition == 0
         in_schema = self.input.schema
@@ -300,7 +300,8 @@ class MeshAggregateExec(ExecutionPlan):
 
             run = distributed_dense_aggregate(
                 mesh, derive, key_names, agg_specs, key_ranges, domain)
-            fk, fv, fmask, overflow = run(cols, mask)
+            with MESH_DISPATCH_LOCK:
+                fk, fv, fmask, overflow = run(cols, mask)
             if bool(overflow):
                 raise CapacityError(
                     "mesh dense aggregation saw keys outside their declared "
@@ -311,7 +312,8 @@ class MeshAggregateExec(ExecutionPlan):
                 mesh, derive, key_names, agg_specs,
                 partial_capacity=partial_cap, final_capacity=final_cap,
                 key_ranges=key_ranges)
-            fk, fv, fmask, overflow = run(cols, mask)
+            with MESH_DISPATCH_LOCK:
+                fk, fv, fmask, overflow = run(cols, mask)
             if bool(overflow):
                 raise CapacityError(
                     f"mesh aggregation exceeded its group capacity "
@@ -367,7 +369,7 @@ class MeshPartialAggregateExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         from ..parallel.distributed import distributed_partial_aggregate
-        from ..parallel.mesh import make_mesh, row_sharding
+        from ..parallel.mesh import MESH_DISPATCH_LOCK, make_mesh, row_sharding
 
         in_schema = self.input.schema
         big = concat_batches(in_schema, self.input.execute(partition, ctx))
@@ -412,7 +414,8 @@ class MeshPartialAggregateExec(ExecutionPlan):
                     mesh, _make_derive(key_c, val_c, aux), key_names,
                     agg_specs, per_dev_cap, key_ranges=key_ranges)
                 self._runs[run_key] = run
-            pk, pv, pmask, overflow = run(cols, mask)
+            with MESH_DISPATCH_LOCK:
+                pk, pv, pmask, overflow = run(cols, mask)
             if bool(overflow):
                 raise CapacityError(
                     f"mesh partial aggregation exceeded {per_dev_cap} "
@@ -499,7 +502,7 @@ class MeshJoinExec(ExecutionPlan):
     def _join_batches(self, probe: ColumnBatch, build: ColumnBatch,
                       ctx: TaskContext) -> List[ColumnBatch]:
         from ..parallel.distributed import distributed_hash_join
-        from ..parallel.mesh import make_mesh, row_sharding
+        from ..parallel.mesh import MESH_DISPATCH_LOCK, make_mesh, row_sharding
 
         lsch, rsch = self.left.schema, self.right.schema
         n_dev = len(jax.devices())
@@ -596,7 +599,8 @@ class MeshJoinExec(ExecutionPlan):
                             rfill, string_key_flags=sflags,
                             null_key_sentinel=sentinel)
                         self._runs[("bc", out_cap)] = run
-                out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
+                with MESH_DISPATCH_LOCK:
+                    out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
                 if not bool(overflow):
                     break
                 attempts += 1
@@ -631,7 +635,8 @@ class MeshJoinExec(ExecutionPlan):
                             out_cap, rfill, string_key_flags=sflags,
                             null_key_sentinel=sentinel)
                         self._runs[("part", shuf_cap, out_cap)] = run
-                out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
+                with MESH_DISPATCH_LOCK:
+                    out_cols, out_mask, overflow = run((dp, dpm), (db, dbm))
                 if not bool(overflow):
                     break
                 attempts += 1

@@ -387,6 +387,7 @@ class HashAggregateExec(ExecutionPlan):
         return Partitioning.unknown(self.output_partition_count())
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        ctx.check_cancelled()
         cfg_cap = ctx.config.get(AGG_CAPACITY)
         batches = self.input.execute(partition, ctx)
         in_schema = self.input.schema
@@ -685,7 +686,9 @@ class JoinExec(ExecutionPlan):
         return self.left.output_partitioning()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        ctx.check_cancelled()
         probe = concat_batches(self.left.schema, self.left.execute(partition, ctx)).shrink()
+        ctx.check_cancelled()
         if self.dist == "broadcast":
             # materialize the build side ONCE per job: same-stage tasks
             # share this operator instance, and re-executing the build
@@ -950,6 +953,7 @@ class SortExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         parts = []
         for p in range(self.input.output_partition_count()):
+            ctx.check_cancelled()
             parts.extend(self.input.execute(p, ctx))
         big = concat_batches(self.input.schema, parts).shrink()
 

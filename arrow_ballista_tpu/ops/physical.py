@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -74,6 +74,17 @@ class TaskContext:
     executor_id: str = ""  # identity of the executing node (shuffle locality)
     # shuffle partition locations: (stage_id, partition) -> list of paths/addrs
     shuffle_locations: Dict = dataclasses.field(default_factory=dict)
+    # cooperative cancellation probe (executor wires the job's cancel flag);
+    # operators call check_cancelled() at batch/operator boundaries so a
+    # cancelled job frees its slot without waiting out the whole plan
+    # (reference: abortable execution, executor.rs:114-144)
+    cancelled: Optional[Callable[[], bool]] = None
+
+    def check_cancelled(self) -> None:
+        if self.cancelled is not None and self.cancelled():
+            from ..utils.errors import CancelledError
+
+            raise CancelledError(f"job {self.job_id} cancelled")
 
 
 # --------------------------------------------------------------------------
@@ -294,8 +305,10 @@ class ScanExec(ExecutionPlan):
         import jax
         import jax.numpy as jnp
 
+        ctx.check_cancelled()
         with self.metrics().timer("scan_read_time"):
             table = self._read_partition(partition)
+        ctx.check_cancelled()
         capacity = ctx.config.batch_size
         with self.metrics().timer("scan_convert_time"):
             batches = table_to_batches(table, self._schema, capacity)

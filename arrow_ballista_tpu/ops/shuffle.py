@@ -94,7 +94,9 @@ class ShuffleWriterExec(ExecutionPlan):
 
     def execute_write(self, partition: int, ctx: TaskContext) -> List[ShuffleWritePartition]:
         """Run the child for ``partition`` and write shuffle files."""
+        ctx.check_cancelled()
         batches = self.input.execute(partition, ctx)
+        ctx.check_cancelled()
         big = concat_batches(self.input.schema, batches).shrink()
         base = os.path.join(ctx.work_dir, ctx.job_id, str(self.stage_id), str(partition))
 

@@ -16,6 +16,7 @@ Axis naming convention:
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -24,6 +25,14 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PART_AXIS = "part"
+
+# process-global serialization of COLLECTIVE program dispatch: two mesh
+# programs interleaved from different task threads deadlock XLA's CPU
+# collective rendezvous ("Expected 8 threads to join ... only 6 arrived"
+# -> hard abort / hang; observed again as a 180s job timeout when two
+# warm-cache hybrid-join tasks dispatched concurrently).  Collectives
+# already use every local device, so serializing them costs nothing.
+MESH_DISPATCH_LOCK = threading.Lock()
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = PART_AXIS,

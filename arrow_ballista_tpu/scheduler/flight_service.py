@@ -113,23 +113,9 @@ def logical_arrow_schema(schema):
     """Our Schema -> the (stable) pyarrow schema Flight streams use:
     strings as plain utf8 (not per-batch dictionaries), decimals as
     decimal128(38, scale) — matching ColumnBatch.to_arrow after the
-    dictionary cast."""
-    import pyarrow as pa
-
-    out = []
-    for f in schema:
-        if f.dtype.is_string:
-            t = pa.string()
-        elif f.dtype.is_decimal:
-            t = pa.decimal128(38, f.dtype.scale)
-        elif f.dtype.kind == "date32":
-            t = pa.date32()
-        else:
-            t = {"int32": pa.int32(), "int64": pa.int64(),
-                 "float32": pa.float32(), "float64": pa.float64(),
-                 "bool": pa.bool_()}[f.dtype.kind]
-        out.append(pa.field(f.name, t))
-    return pa.schema(out)
+    dictionary cast.  One mapping for the whole engine
+    (Schema.to_arrow_schema)."""
+    return schema.to_arrow_schema()
 
 
 # --------------------------------------------------------------------------
@@ -227,10 +213,15 @@ class BallistaFlightServer:
 
     # --- planning / execution -------------------------------------------
     def _plan_schema(self, sql: str):
-        payload, _ = self.svc._prepare({"sql": sql}, b"")
-        from .. import serde
+        # plan directly (the _prepare RPC would store a statement in the
+        # sessionless prepared holder — leaking one entry per Flight
+        # schema probe and evicting real RPC-prepared statements)
+        from ..sql.optimizer import optimize
+        from ..sql.parser import parse_sql
+        from ..sql.planner import SqlToRel
 
-        return logical_arrow_schema(serde.schema_from_obj(payload["schema"]))
+        logical = optimize(SqlToRel(self.svc.catalog).plan(parse_sql(sql)))
+        return logical_arrow_schema(logical.schema)
 
     def _get_flight_info(self, descriptor):
         fl = self._fl
@@ -267,6 +258,10 @@ class BallistaFlightServer:
             raise ExecutionError(f"job {job_id} {status.state}: {status.error}")
         with self.svc._lock:
             schema = self.svc._final_schemas.get(job_id)
+        if schema is None:  # LRU-evicted under heavy concurrent load
+            raise ExecutionError(
+                f"result schema for job {job_id} no longer cached; re-run "
+                f"the query")
         target = logical_arrow_schema(schema)
         batches: List[ColumnBatch] = []
         for part in sorted(status.locations):

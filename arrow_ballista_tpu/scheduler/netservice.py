@@ -28,6 +28,9 @@ from .types import ExecutorHeartbeat, ExecutorMetadata, TaskDescription
 
 log = logging.getLogger(__name__)
 
+# guards plan encoding (see serialize_tasks_or_fail)
+_ENCODE_LOCK = threading.Lock()
+
 
 def serialize_tasks_or_fail(scheduler, executor_id: str,
                             tasks: List[TaskDescription]) -> List[dict]:
@@ -48,7 +51,13 @@ def serialize_tasks_or_fail(scheduler, executor_id: str,
         try:
             plan_obj = plan_cache.get(id(t.plan))
             if plan_obj is None:
-                plan_obj = serde.plan_to_obj(t.plan)
+                # ONE encode at a time process-wide: two launch-pool
+                # threads serializing the same plan concurrently segfaulted
+                # inside pyarrow's IPC writer (same MemoryScanExec table
+                # from two threads); encoding is cheap host work, so the
+                # lock costs nothing measurable
+                with _ENCODE_LOCK:
+                    plan_obj = serde.plan_to_obj(t.plan)
                 plan_cache[id(t.plan)] = plan_obj
             objs.append(serde.task_to_obj(t, plan_obj=plan_obj))
         except Exception as e:  # noqa: BLE001 — deterministic plan defect

@@ -45,6 +45,10 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=args.log_level,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # native-crash forensics: a SIGSEGV in a daemon otherwise dies silently
+    import faulthandler
+
+    faulthandler.enable()
 
     from .scheduler.netservice import SchedulerNetService
     from .scheduler.scheduler import SchedulerConfig

@@ -136,11 +136,19 @@ def cmd_loadtest(args) -> None:
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    lat = sorted(latencies)
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1000, 1) \
+            if lat else 0.0
+
     print(json.dumps({
         "command": "loadtest", "concurrency": args.concurrency,
         "queries": queries, "total_queries": len(latencies),
         "errors": len(errors), "wall_s": round(wall, 2),
+        "queries_per_s": round(len(latencies) / wall, 2) if wall else 0.0,
         "avg_latency_ms": round(sum(latencies) / max(1, len(latencies)) * 1000, 1),
+        "p50_ms": pct(0.50), "p95_ms": pct(0.95),
     }))
     for e in errors[:5]:
         print(e, file=sys.stderr)
