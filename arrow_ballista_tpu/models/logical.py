@@ -73,12 +73,28 @@ class SubqueryAlias(LogicalPlan):
         return f"SubqueryAlias: {self.alias}"
 
 
+def _expr_nullable(e: Expr, schema: Schema) -> bool:
+    """Output nullability of an expression: any referenced nullable column
+    (bool outputs excluded — predicates are two-valued).  Mirrors the
+    physical layer's rule (ops/operators._expr_nullable) so the logical
+    schema Flight advertises matches the stream."""
+    try:
+        if e.dtype(schema).kind == "bool":
+            return False
+    except PlanningError:
+        pass
+    return any(n in schema and schema.field(n).nullable
+               for n in e.column_refs())
+
+
 @dataclasses.dataclass(init=False)
 class Projection(LogicalPlan):
     def __init__(self, input: LogicalPlan, exprs: List[Tuple[Expr, str]]):
         self.input = input
         self.exprs = exprs
-        self.schema = Schema(Field(name, e.dtype(input.schema)) for e, name in exprs)
+        self.schema = Schema(
+            Field(name, e.dtype(input.schema),
+                  _expr_nullable(e, input.schema)) for e, name in exprs)
 
     def children(self):
         return [self.input]
@@ -110,8 +126,18 @@ class Aggregate(LogicalPlan):
         self.input = input
         self.group_exprs = group_exprs
         self.agg_exprs = agg_exprs
-        fields = [Field(n, e.dtype(input.schema)) for e, n in group_exprs]
-        fields += [Field(n, a.dtype(input.schema)) for a, n in agg_exprs]
+        fields = [Field(n, e.dtype(input.schema),
+                        _expr_nullable(e, input.schema))
+                  for e, n in group_exprs]
+        # SQL: sum/min/max are NULL for an all-NULL group (nullable
+        # operand) and for a global aggregate over empty input; count
+        # never is (matches HashAggregateExec._agg_nullable)
+        fields += [Field(n, a.dtype(input.schema),
+                         a.func != "count"
+                         and (not group_exprs
+                              or (a.operand is not None
+                                  and _expr_nullable(a.operand, input.schema))))
+                   for a, n in agg_exprs]
         self.schema = Schema(fields)
 
     def children(self):

@@ -237,6 +237,12 @@ class SqlToRel:
             or bool(group_exprs)
         )
 
+        # keep the pre-aggregation resolution of each select item: ORDER BY
+        # matches by expression key, and _plan_aggregate rewrites
+        # select_exprs to reference agg output columns (so e.g.
+        # ``select d.w ... group by d.w order by d.w`` would otherwise not
+        # find d.w in the rewritten list)
+        orig_select_exprs = list(select_exprs)
         if has_aggs:
             plan, select_exprs, having_expr = self._plan_aggregate(
                 plan, select_exprs, group_exprs, having_expr
@@ -269,7 +275,7 @@ class SqlToRel:
                 # that the projection renamed): try matching a projected expr
                 e = self.resolve_expr(oi.expr, scope)
                 matched = None
-                for pe, name in select_exprs:
+                for pe, name in list(select_exprs) + orig_select_exprs:
                     if _expr_key(pe) == _expr_key(e):
                         matched = E.Column(name)
                         break

@@ -299,3 +299,26 @@ def test_describe_statement():
     out = ctx.sql("DESCRIBE t").to_pandas()
     assert out.column_name.tolist() == ["a"] and out.data_type.tolist() == ["int64"]
     assert ctx.sql("desc t").to_pandas().equals(out)
+
+
+def test_order_by_qualified_grouped_column():
+    """ORDER BY a qualified column that the select list exposes unaliased
+    (``select d.w ... group by d.w order by d.w``): the aggregate rewrite
+    renames select exprs to agg outputs, so matching must also consult the
+    pre-aggregation resolution."""
+    import numpy as np
+    import pyarrow as pa
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    ctx = BallistaContext.local()
+    rng = np.random.default_rng(0)
+    ctx.register_table("t", pa.table({
+        "k": pa.array(rng.integers(0, 5, 100).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 9, 100).astype(np.int64))}))
+    ctx.register_table("d", pa.table({
+        "k": pa.array(np.arange(5, dtype=np.int64)),
+        "w": pa.array(np.arange(5, dtype=np.int64) * 2)}))
+    out = ctx.sql("select d.w, sum(t.v) s from t join d on t.k = d.k "
+                  "group by d.w order by d.w desc").to_pandas()
+    assert out.w.tolist() == [8, 6, 4, 2, 0]
