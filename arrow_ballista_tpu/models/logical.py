@@ -73,11 +73,11 @@ class SubqueryAlias(LogicalPlan):
         return f"SubqueryAlias: {self.alias}"
 
 
-def _expr_nullable(e: Expr, schema: Schema) -> bool:
+def expr_nullable(e: Expr, schema: Schema) -> bool:
     """Output nullability of an expression: any referenced nullable column
-    (bool outputs excluded — predicates are two-valued).  Mirrors the
-    physical layer's rule (ops/operators._expr_nullable) so the logical
-    schema Flight advertises matches the stream."""
+    (bool outputs excluded — predicates are two-valued).  THE one
+    definition — the physical layer (ops/operators) imports it, so the
+    logical schema Flight advertises cannot drift from the stream."""
     try:
         if e.dtype(schema).kind == "bool":
             return False
@@ -85,6 +85,9 @@ def _expr_nullable(e: Expr, schema: Schema) -> bool:
         pass
     return any(n in schema and schema.field(n).nullable
                for n in e.column_refs())
+
+
+_expr_nullable = expr_nullable  # internal alias
 
 
 @dataclasses.dataclass(init=False)

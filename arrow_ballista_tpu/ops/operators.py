@@ -89,16 +89,9 @@ def _null_transparent(e: E.Expr) -> bool:
     return all(_null_transparent(c) for c in e.children())
 
 
-def _expr_nullable(e: E.Expr, schema: Schema) -> bool:
-    """Whether an expression's output can be NULL: any referenced column is
-    nullable.  Boolean outputs are excluded (predicates compile to two-valued
-    logic; NULL comparisons are already false)."""
-    dt = e.dtype(schema)
-    if dt.kind == "bool":
-        return False
-    return any(
-        n in schema and schema.field(n).nullable for n in e.column_refs()
-    )
+# the single nullability rule lives next to the logical schemas so the
+# Flight-advertised schema cannot drift from the physical stream
+from ..models.logical import expr_nullable as _expr_nullable  # noqa: E402
 
 
 def null_check_of(cc, operand, in_schema: Schema):

@@ -410,6 +410,17 @@ class SqlToRel:
                 base = L.Filter(base, E.and_all(member_filters))
             plans[f"g{gi}"] = base
 
+        # semi/anti pushdown: subquery predicates constraining ONE group
+        # apply to it before the joins (see _subquery_pred_group)
+        deferred_subquery_preds: List[E.Expr] = []
+        for pred in subquery_preds:
+            gi = self._subquery_pred_group(pred, group_of)
+            if gi is not None:
+                plans[f"g{gi}"] = self._apply_subquery_pred(
+                    plans[f"g{gi}"], pred, scope)
+            else:
+                deferred_subquery_preds.append(pred)
+
         # greedy left-deep join over groups
         joined_groups = [0]
         plan = plans["g0"]
@@ -443,12 +454,37 @@ class SqlToRel:
             for (a, b, ea, eb) in edges:
                 post_filters.append(E.BinOp("=", ea, eb))
 
-        for pred in subquery_preds:
+        for pred in deferred_subquery_preds:
             plan = self._apply_subquery_pred(plan, pred, scope)
 
         if post_filters:
             plan = L.Filter(plan, E.and_all(post_filters))
         return plan, True
+
+    def _subquery_pred_group(self, pred: E.Expr,
+                             group_of: Dict[str, int]) -> Optional[int]:
+        """The single relation group a semi/anti subquery predicate
+        constrains, or None.  IN/EXISTS predicates whose outer references
+        all live in one group can apply BEFORE the joins (semi joins keep
+        the left schema, and inner joins commute with them) — q18's IN
+        subquery keeps 57 of 15M orders, and applying it after the
+        customer x orders x lineitem pipeline materialized 60M rows that
+        were about to be discarded."""
+        if isinstance(pred, _InSubqueryPred):
+            refs = pred.operand.column_refs()
+        elif isinstance(pred, _ExistsPred):
+            refs = set()
+            for le, _re in pred.on_pairs:
+                refs |= le.column_refs()
+            if pred.residual is not None:
+                sub_names = {f.name for f in pred.subplan.schema}
+                refs |= pred.residual.column_refs() - sub_names
+        else:
+            return None  # scalar comparisons add columns; keep placement
+        aliases = {r.split(".", 1)[0] for r in refs}
+        if len(aliases) == 1:
+            return group_of.get(next(iter(aliases)))
+        return None
 
     @staticmethod
     def _flat(relations: List[Relation]) -> List[Relation]:
