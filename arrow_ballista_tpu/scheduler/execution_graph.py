@@ -26,7 +26,12 @@ import dataclasses
 import itertools
 from typing import Dict, List, Optional, Tuple
 
-from ..ops.shuffle import PartitionLocation, ShuffleWritePartition, ShuffleWriterExec
+from ..ops.shuffle import (
+    PartitionLocation,
+    ShuffleReaderExec,
+    ShuffleWritePartition,
+    ShuffleWriterExec,
+)
 from ..utils.errors import InternalError
 from .planner import (
     DistributedPlanner,
@@ -147,6 +152,52 @@ class ExecutionStage:
                                       host, port))
         return locs
 
+    # --- adaptive exchange coalescing ------------------------------------
+    # When the producers' REAL output is tiny, running the planned N reduce
+    # tasks is pure overhead (q1: 46 final-agg tasks over 48 partial rows
+    # cost ~1.9 s of launch/fetch/dispatch).  The scheduler knows the exact
+    # shuffle sizes before launching the consumer — a static planner never
+    # does — so the stage collapses to one task reading every bucket.
+    # Correct for any hash exchange: the union of buckets is the full
+    # input, and aggregates/joins re-group/re-match within the task.
+    COALESCE_INPUT_ROWS = 8192
+
+    def maybe_coalesce(self) -> None:
+        if self.partitions <= 1 or self.resolved_plan is None:
+            return
+        leaves = []
+
+        def walk(p):
+            kids = p.children()
+            if not kids:
+                leaves.append(p)
+            for c in kids:
+                walk(c)
+
+        walk(self.resolved_plan)
+        readers = [p for p in leaves if isinstance(p, ShuffleReaderExec)]
+        if len(readers) != len(leaves):
+            return  # a scan leaf owns the partition count; leave it alone
+        total = sum(loc.num_rows for r in readers
+                    for locs in r.locations.values() for loc in locs)
+        if total > self.COALESCE_INPUT_ROWS:
+            return
+        for r in readers:
+            merged = [loc for q in sorted(r.locations)
+                      for loc in r.locations[q]]
+            r.locations = {0: merged}
+            # remember the planned count: resolve mutates the plan tree in
+            # place, and a rollback rebuilds UnresolvedShuffleExec from
+            # this reader — it must restore the ORIGINAL partitioning
+            r._orig_partition_count = r.partition_count
+            r.partition_count = 1
+        self._orig_partitions = self.partitions
+        self.partitions = 1
+        self.task_infos = [None]
+        # task_failures keeps its planned length: only index 0 is touched
+        # while coalesced, and rollback restores the full partition count
+        # with per-partition budgets intact
+
     # --- transitions -----------------------------------------------------
     def rollback(self, count_failure: bool = True) -> None:
         """RUNNING/RESOLVED -> UNRESOLVED (reference execution_stage.rs
@@ -161,6 +212,11 @@ class ExecutionStage:
         self.plan = rollback_resolved_shuffles(self.plan)
         self.state = UNRESOLVED
         self.resolved_plan = None
+        # undo adaptive coalescing: the fresh resolve re-decides from the
+        # new attempt's real shuffle sizes
+        if getattr(self, "_orig_partitions", None):
+            self.partitions = self._orig_partitions
+            self._orig_partitions = None
         self.task_infos = [None] * self.partitions
         self.outputs.clear()
         self.stage_attempt += 1
@@ -237,6 +293,8 @@ class ExecutionGraph:
                              for p in stage.producer_ids}
                 stage.resolved_plan = remove_unresolved_shuffles(stage.plan, locations) \
                     if stage.producer_ids else stage.plan
+                if stage.producer_ids:
+                    stage.maybe_coalesce()
                 stage.state = RUNNING
                 changed = True
         return changed

@@ -115,7 +115,10 @@ def rollback_resolved_shuffles(plan: ExecutionPlan) -> ExecutionPlan:
     def walk(p: ExecutionPlan) -> ExecutionPlan:
         p = map_children(p, walk)
         if isinstance(p, ShuffleReaderExec):
-            return UnresolvedShuffleExec(p.stage_id, p.schema, p.partition_count)
+            # adaptive coalescing may have collapsed the reader to one
+            # partition; the re-run must restore the PLANNED partitioning
+            count = getattr(p, "_orig_partition_count", None) or p.partition_count
+            return UnresolvedShuffleExec(p.stage_id, p.schema, count)
         return p
 
     return walk(plan)
