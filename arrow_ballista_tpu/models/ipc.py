@@ -74,8 +74,23 @@ def physical_table_from_numpy(schema: Schema, data: Dict[str, np.ndarray],
             idx = pa.array(arr, type=pa.int32())
             arrays.append(pa.DictionaryArray.from_arrays(idx, pa.array(dic, type=pa.string())))
         else:
-            arrays.append(pa.array(arr, type=pa_schema.field(f.name).type))
-    return pa.table(arrays, schema=pa_schema)
+            want = pa_schema.field(f.name).type
+            if want == pa.int64() and len(arr):
+                # narrow int64 physical columns (decimals included) to
+                # int32 on the wire when the slice's values fit: halves
+                # shuffle bytes for the dominant column class.  The read
+                # side upcasts via .astype and concat_tables promotes
+                # mixed-width files, so this is purely a wire format.
+                # NULL sentinels are int64-min, so null-bearing slices
+                # never pass the range check.
+                lo, hi = arr.min(), arr.max()
+                if -(2**31) < lo and hi < 2**31 - 1:
+                    want = pa.int32()
+                    arr = arr.astype(np.int32)
+            arrays.append(pa.array(arr, type=want))
+    fields = [pa.field(f.name, a.type, metadata=pa_schema.field(f.name).metadata)
+              for f, a in zip(schema, arrays)]
+    return pa.table(arrays, schema=pa.schema(fields))
 
 
 def batch_to_physical_table(batch: ColumnBatch):

@@ -128,6 +128,12 @@ class ExecutionStage:
         return agg
 
     # --- queries ---------------------------------------------------------
+    @property
+    def planned_partitions(self) -> int:
+        """The partition count the planner asked for, regardless of
+        adaptive coalescing (observability/tests read this)."""
+        return getattr(self, "_orig_partitions", None) or self.partitions
+
     def pending_partitions(self) -> List[int]:
         if self.state != RUNNING:
             return []

@@ -219,17 +219,21 @@ def _worker(platform: str) -> None:
         # with a real error instead of a SIGKILL
         "ballista.job.timeout.seconds": "1800",
     }
-    # warm the OS page cache first: whichever transport runs first would
-    # otherwise pay cold disk reads the second one doesn't (observed: file
-    # q1 7.3 s cold vs 3.0 s warm on the same code)
-    t_w = time.perf_counter()
-    for fname in sorted(os.listdir(DATA_DIR)):
-        if fname.endswith(".parquet"):
-            with open(os.path.join(DATA_DIR, fname), "rb") as fh:
+    def _warm_cache(paths, label):
+        # warm the OS page cache first: whichever run goes first would
+        # otherwise pay cold disk reads the others don't (observed: file
+        # q1 7.3 s cold vs 3.0 s warm on the same code)
+        t_w = time.perf_counter()
+        for path in paths:
+            with open(path, "rb") as fh:
                 while fh.read(1 << 24):
                     pass
-    print(f"[worker] page-cache warmup: {time.perf_counter()-t_w:.1f}s",
-          file=sys.stderr)
+        print(f"[worker] {label} page-cache warmup: "
+              f"{time.perf_counter()-t_w:.1f}s", file=sys.stderr)
+
+    _warm_cache([os.path.join(DATA_DIR, f)
+                 for f in sorted(os.listdir(DATA_DIR))
+                 if f.endswith(".parquet")], "sf1")
 
     ctx = BallistaContext.standalone(BallistaConfig(dict(base_config)),
                                      concurrent_tasks=4)
@@ -340,13 +344,7 @@ def _worker(platform: str) -> None:
     sf10_dir = os.path.join(REPO, ".bench_data", "tpch-sf10")
     if SCALE == 1 and os.path.exists(os.path.join(sf10_dir, "lineitem.parquet")):
         try:
-            # same warm-cache discipline as the SF1 runs
-            t_w = time.perf_counter()
-            with open(os.path.join(sf10_dir, "lineitem.parquet"), "rb") as fh:
-                while fh.read(1 << 24):
-                    pass
-            print(f"[worker] sf10 warmup: {time.perf_counter()-t_w:.1f}s",
-                  file=sys.stderr)
+            _warm_cache([os.path.join(sf10_dir, "lineitem.parquet")], "sf10")
             ctx10 = BallistaContext.standalone(
                 BallistaConfig(dict(base_config)), concurrent_tasks=4)
             try:

@@ -500,7 +500,7 @@ def test_adaptive_exchange_coalescing():
     # the final-aggregate stage consumed a 4-row-ish shuffle: must have
     # run as ONE task despite the 16-way hash partitioning
     coalesced = [s for s in graph.stages.values()
-                 if getattr(s, "_orig_partitions", None)]
+                 if s.planned_partitions != s.partitions]
     assert coalesced, "no stage was coalesced"
     assert all(s.partitions == 1 and len(s.task_infos) == 1
                for s in coalesced)
