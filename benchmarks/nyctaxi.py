@@ -1,0 +1,118 @@
+"""NYC taxi benchmark (parity: reference benchmarks/src/bin/nyctaxi.rs).
+
+The reference registers the yellow-taxi tripdata CSV/parquet and times
+``fare_amt_by_passenger``: min/max/sum of fare_amount grouped by
+passenger_count (nyctaxi.rs:100-117).  Real tripdata isn't downloadable in
+this environment (zero egress), so ``generate`` synthesizes data with the
+reference's exact schema (nyctaxi.rs:137-157) and plausible value
+distributions; the benchmark itself is dataset-shape-faithful.
+
+    python -m benchmarks.nyctaxi generate --rows 5000000 --output .bench_data/nyctaxi
+    python -m benchmarks.nyctaxi benchmark --path .bench_data/nyctaxi \
+        [--engine local|standalone|remote] [--iterations 3]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+QUERIES = {
+    "fare_amt_by_passenger": (
+        "SELECT passenger_count, MIN(fare_amount), MAX(fare_amount), "
+        "SUM(fare_amount) FROM tripdata GROUP BY passenger_count"
+    ),
+}
+
+
+def cmd_generate(args) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(args.seed)
+    n = args.rows
+    fares = np.round(rng.gamma(2.2, 6.0, n), 2)  # $ long-tail around ~$13
+    table = pa.table({
+        "VendorID": pa.array(rng.choice(["1", "2"], n)),
+        "tpep_pickup_datetime": pa.array(
+            [f"2023-01-{1 + i % 28:02d} 12:{i % 60:02d}:00" for i in range(n)]),
+        "tpep_dropoff_datetime": pa.array(
+            [f"2023-01-{1 + i % 28:02d} 12:{(i + 11) % 60:02d}:00" for i in range(n)]),
+        "passenger_count": pa.array(
+            rng.choice([1, 1, 1, 2, 2, 3, 4, 5, 6], n).astype(np.int32)),
+        "trip_distance": pa.array(
+            np.char.mod("%.2f", rng.gamma(1.5, 2.0, n))),
+        "RatecodeID": pa.array(rng.choice(["1", "2", "5"], n)),
+        "store_and_fwd_flag": pa.array(rng.choice(["N", "Y"], n, p=[0.98, 0.02])),
+        "PULocationID": pa.array(rng.integers(1, 266, n).astype(str)),
+        "DOLocationID": pa.array(rng.integers(1, 266, n).astype(str)),
+        "payment_type": pa.array(rng.choice(["1", "2", "3", "4"], n)),
+        "fare_amount": pa.array(fares),
+        "extra": pa.array(rng.choice([0.0, 0.5, 1.0], n)),
+        "mta_tax": pa.array(np.full(n, 0.5)),
+        "tip_amount": pa.array(np.round(fares * rng.uniform(0, 0.3, n), 2)),
+        "tolls_amount": pa.array(rng.choice([0.0, 0.0, 0.0, 6.55], n)),
+        "improvement_surcharge": pa.array(np.full(n, 0.3)),
+        "total_amount": pa.array(np.round(fares * 1.35, 2)),
+    })
+    os.makedirs(args.output, exist_ok=True)
+    path = os.path.join(args.output, "tripdata.parquet")
+    pq.write_table(table, path, compression="zstd",
+                   row_group_size=args.row_group_size)
+    print(f"wrote {path} ({n} rows)", file=sys.stderr)
+
+
+def cmd_benchmark(args) -> None:
+    ctx = _make_ctx(args)
+    results = {}
+    for name, sql in QUERIES.items():
+        per = []
+        for i in range(args.iterations):
+            t0 = time.perf_counter()
+            out = ctx.sql(sql).collect()
+            dt = time.perf_counter() - t0
+            rows = sum(b.num_rows for b in out)
+            per.append(dt)
+            print(f"query {name!r} iteration {i} took {dt*1000:.0f} ms "
+                  f"({rows} rows)", file=sys.stderr)
+        results[name] = {"min_ms": round(min(per) * 1000, 1),
+                         "iterations": [round(p * 1000, 1) for p in per]}
+    print(json.dumps({"command": "nyctaxi", "results": results}))
+    if hasattr(ctx, "shutdown"):
+        ctx.shutdown()
+
+
+def _make_ctx(args):
+    from benchmarks.tpch import make_engine_context
+
+    ctx = make_engine_context(args.engine, args.scheduler, {
+        "ballista.shuffle.partitions": str(args.shuffle_partitions or "auto"),
+    })
+    ctx.register_parquet("tripdata", os.path.join(args.path, "tripdata.parquet"))
+    return ctx
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="NYC taxi benchmark")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    g = sub.add_parser("generate")
+    g.add_argument("--rows", type=int, default=1_000_000)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--output", required=True)
+    g.add_argument("--row-group-size", type=int, default=1 << 19)
+    b = sub.add_parser("benchmark")
+    b.add_argument("--path", required=True)
+    b.add_argument("--engine", choices=["local", "standalone", "remote"],
+                   default="standalone")
+    b.add_argument("--scheduler", default="127.0.0.1:50050")
+    b.add_argument("--iterations", type=int, default=3)
+    b.add_argument("--shuffle-partitions", type=int, default=0)
+    args = ap.parse_args(argv)
+    {"generate": cmd_generate, "benchmark": cmd_benchmark}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

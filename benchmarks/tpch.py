@@ -43,22 +43,28 @@ def cmd_convert(args) -> None:
                       "seconds": round(time.time() - t0, 2)}))
 
 
-def make_context(args):
+def make_engine_context(engine: str, scheduler: str, settings: dict,
+                        concurrent_tasks: int = 4):
+    """One engine-dispatch for every benchmark harness (tpch, nyctaxi,
+    loadtest): local / standalone / remote from the same knobs."""
     from arrow_ballista_tpu.client.context import BallistaContext
     from arrow_ballista_tpu.utils.config import BallistaConfig
 
-    config = BallistaConfig({
+    config = BallistaConfig(settings)
+    if engine == "standalone":
+        return BallistaContext.standalone(config,
+                                          concurrent_tasks=concurrent_tasks)
+    if engine == "remote":
+        host, port = scheduler.split(":")
+        return BallistaContext.remote(host, int(port), config)
+    return BallistaContext.local(config)
+
+
+def make_context(args):
+    ctx = make_engine_context(args.engine, args.scheduler, {
         "ballista.shuffle.partitions": str(args.shuffle_partitions),
         "ballista.batch.size": str(args.batch_size),
-    })
-    if args.engine == "standalone":
-        ctx = BallistaContext.standalone(config,
-                                         concurrent_tasks=args.concurrent_tasks)
-    elif args.engine == "remote":
-        host, port = args.scheduler.split(":")
-        ctx = BallistaContext.remote(host, int(port), config)
-    else:
-        ctx = BallistaContext.local(config)
+    }, concurrent_tasks=args.concurrent_tasks)
     register_tables(ctx, args.path)
     return ctx
 

@@ -13,6 +13,9 @@ import pytest
 
 from arrow_ballista_tpu.client.context import BallistaContext
 from arrow_ballista_tpu.utils.avro import avro_to_arrow, read_avro, write_avro
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 AVRO_SCHEMA = {
     "type": "record",
@@ -136,3 +139,26 @@ def test_avro_through_remote_context(tmp_path):
     finally:
         ex.stop(notify=False)
         sched.stop()
+
+
+def test_nyctaxi_benchmark_harness(tmp_path):
+    """The nyctaxi harness (reference benchmarks/src/bin/nyctaxi.rs) runs
+    end to end: synthesize tripdata, run fare_amt_by_passenger."""
+    import json
+    import subprocess
+    import sys
+
+    env = {**__import__("os").environ,
+           "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+    gen = subprocess.run(
+        [sys.executable, "-m", "benchmarks.nyctaxi", "generate",
+         "--rows", "20000", "--output", str(tmp_path)],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT, env=env)
+    assert gen.returncode == 0, gen.stderr[-1500:]
+    run = subprocess.run(
+        [sys.executable, "-m", "benchmarks.nyctaxi", "benchmark",
+         "--path", str(tmp_path), "--iterations", "1"],
+        capture_output=True, text=True, timeout=600, cwd=REPO_ROOT, env=env)
+    assert run.returncode == 0, run.stderr[-1500:]
+    out = json.loads(run.stdout.strip().splitlines()[-1])
+    assert out["results"]["fare_amt_by_passenger"]["min_ms"] > 0

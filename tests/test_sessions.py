@@ -19,6 +19,9 @@ import pytest
 from arrow_ballista_tpu.client.context import BallistaContext
 from arrow_ballista_tpu.net import wire
 from arrow_ballista_tpu.utils.config import BallistaConfig
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(scope="module")
@@ -127,6 +130,6 @@ def test_external_client_script(cluster, tmp_path):
         [sys.executable, script, "127.0.0.1", str(cluster.port),
          f"create external table nums stored as parquet location '{data}'",
          "select count(*) as n, sum(v) as s from nums"],
-        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "4950" in out.stdout and "100" in out.stdout
