@@ -400,11 +400,15 @@ class PhysicalPlanner:
                 # a semi join keeps the left rows matching the (typically
                 # selective) subquery — assume a strong cut so downstream
                 # joins can pick broadcast (q18: 57 of 15M orders survive;
-                # estimating 'left' kept the next join partitioned).  The
-                # output is bounded by the LEFT side only (many left rows
-                # can match one right key), so the right estimate is not a
-                # valid cap.
-                return max(1, self._estimate_rows(node.left) // 10)
+                # estimating 'left' kept the next join partitioned and
+                # shuffled 60M lineitem rows at SF10).  The output is
+                # bounded by the LEFT side only (many left rows can match
+                # one right key), so the right estimate is not a valid
+                # cap; 5% match selectivity is the working guess for
+                # IN/EXISTS over filtered subqueries.  Worst case of an
+                # under-estimate is a large broadcast build — materialized
+                # once (build cache) and streamed against, not fatal.
+                return max(1, self._estimate_rows(node.left) // 20)
             if node.join_type == "anti":
                 return self._estimate_rows(node.left)
             if node.join_type == "full":
