@@ -212,15 +212,85 @@ class BallistaFlightServer:
         return self._sql_of_command(raw)
 
     # --- planning / execution -------------------------------------------
+    _DDL_TYPES = ("CreateExternalTable", "SetVariable", "ShowTables",
+                  "ShowSettings", "ShowColumns", "Explain")
+
+    def _parse(self, sql: str):
+        """Parse once; returns (stmt, is_ddl) where is_ddl marks the
+        utility statements (CREATE EXTERNAL TABLE / SET / SHOW / DESCRIBE
+        / EXPLAIN) the Flight door executes directly — JDBC clients issue
+        them like any statement (same set the CLI/client dispatch covers,
+        context.py:255-283)."""
+        from ..sql.parser import parse_sql
+
+        stmt = parse_sql(sql)
+        return stmt, type(stmt).__name__ in self._DDL_TYPES
+
+    def _run_ddl(self, stmt):
+        """Execute a DDL/utility statement; returns the result pa.Table."""
+        import pyarrow as pa
+
+        from ..sql import ast as sqlast
+
+        if isinstance(stmt, sqlast.CreateExternalTable):
+            from ..models.schema import Field as EField, Schema as ESchema
+            from ..sql.planner import parse_type_name
+
+            from .. import serde
+
+            payload = {"name": stmt.name, "format": stmt.file_format,
+                       "path": stmt.location, "has_header": stmt.has_header,
+                       "delimiter": stmt.delimiter}
+            if stmt.columns:  # declared column types win over inference
+                payload["schema"] = serde.schema_to_obj(ESchema(
+                    EField(n, parse_type_name(t)) for n, t in stmt.columns))
+            self.svc._register_external_table(payload, b"")
+            return pa.table({"result": pa.array([], type=pa.string())})
+        if isinstance(stmt, sqlast.SetVariable):
+            # sessionless Flight SET mutates the shared default config
+            self.svc.config.set(stmt.key, stmt.value)
+            return pa.table({"result": pa.array([], type=pa.string())})
+        if isinstance(stmt, sqlast.ShowSettings):
+            settings = self.svc.config.to_dict()
+            if stmt.key:
+                self.svc.config.get(stmt.key)  # unknown key -> error
+                settings = {stmt.key: settings[stmt.key]}
+            rows = sorted(settings.items())
+            return pa.table({
+                "name": pa.array([k for k, _ in rows], type=pa.string()),
+                "value": pa.array([str(v) for _, v in rows], type=pa.string())})
+        if isinstance(stmt, sqlast.ShowColumns):
+            schema = self.svc.catalog.provider(stmt.table).schema
+            return pa.table({
+                "column_name": pa.array([f.name for f in schema],
+                                        type=pa.string()),
+                "data_type": pa.array([str(f.dtype) for f in schema],
+                                      type=pa.string())})
+        if isinstance(stmt, sqlast.Explain):
+            from .physical_planner import explain_rows
+
+            rows = explain_rows(self.svc.catalog, self.svc.config,
+                                stmt.statement, stmt.verbose)
+            return pa.table({
+                "plan_type": pa.array([r["plan_type"] for r in rows],
+                                      type=pa.string()),
+                "plan": pa.array([r["plan"] for r in rows],
+                                 type=pa.string())})
+        # ShowTables
+        names = sorted(self.svc.catalog.table_names())
+        return pa.table({"table_name": pa.array(names, type=pa.string())})
+
     def _plan_schema(self, sql: str):
+        stmt, is_ddl = self._parse(sql)
+        if is_ddl:
+            return self._run_ddl(stmt).schema
         # plan directly (the _prepare RPC would store a statement in the
         # sessionless prepared holder — leaking one entry per Flight
         # schema probe and evicting real RPC-prepared statements)
         from ..sql.optimizer import optimize
-        from ..sql.parser import parse_sql
         from ..sql.planner import SqlToRel
 
-        logical = optimize(SqlToRel(self.svc.catalog).plan(parse_sql(sql)))
+        logical = optimize(SqlToRel(self.svc.catalog).plan(stmt))
         return logical_arrow_schema(logical.schema)
 
     def _get_flight_info(self, descriptor):
@@ -243,6 +313,10 @@ class BallistaFlightServer:
 
     def _execute_to_table(self, sql: str):
         import pyarrow as pa
+
+        stmt, is_ddl = self._parse(sql)
+        if is_ddl:
+            return self._run_ddl(stmt)
 
         from .. import serde
         from ..models.batch import ColumnBatch

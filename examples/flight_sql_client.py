@@ -1,0 +1,38 @@
+#!/usr/bin/env python3
+"""Query the cluster with a STOCK pyarrow.flight client — no
+arrow_ballista_tpu import at all.
+
+The scheduler's Arrow Flight door (scheduler/flight_service.py; parity:
+reference flight_sql.rs:83-911, the endpoint behind the Flight SQL JDBC
+driver) plans on get_flight_info and streams results on do_get.  Raw SQL
+bytes work as the descriptor command; Flight SQL's protobuf command
+envelope works too (see docs/user-guide/flight-sql.md).
+
+Usage:
+    python -m arrow_ballista_tpu.scheduler_daemon --bind-port 50050 \
+        --flight-port 50052 &
+    python -m arrow_ballista_tpu.executor_daemon --scheduler-port 50050 &
+    python examples/flight_sql_client.py localhost 50052 \
+        "create external table t stored as parquet location '/data/t.parquet'" \
+        "select count(*) as n from t"
+"""
+import sys
+
+import pyarrow.flight as fl
+
+
+def main() -> None:
+    if len(sys.argv) < 4:
+        raise SystemExit(__doc__)
+    host, port, *statements = sys.argv[1:]
+    client = fl.connect(f"grpc://{host}:{port}")
+    for sql in statements:
+        info = client.get_flight_info(
+            fl.FlightDescriptor.for_command(sql.encode()))
+        table = client.do_get(info.endpoints[0].ticket).read_all()
+        print(f"-- {sql}")
+        print(table.to_pandas().to_string(index=False))
+
+
+if __name__ == "__main__":
+    main()

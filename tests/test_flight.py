@@ -110,3 +110,58 @@ def test_get_schema_and_errors(client):
     with pytest.raises(fl.FlightError):
         info = client.get_flight_info(
             fl.FlightDescriptor.for_command(b"select nope from missing"))
+
+
+def test_ddl_and_example_script(cluster, tmp_path):
+    """DDL through the Flight door (JDBC clients issue CREATE/SET/SHOW
+    like any statement) + the stock-client example script end-to-end."""
+    import os
+    import subprocess
+    import sys
+
+    import pyarrow.parquet as pq
+
+    data = tmp_path / "nums.parquet"
+    pq.write_table(pa.table({"v": pa.array(range(50), type=pa.int64())}),
+                   str(data))
+    client = fl.connect(f"grpc://127.0.0.1:{cluster.flight.port}")
+    info = client.get_flight_info(fl.FlightDescriptor.for_command(
+        f"create external table nums stored as parquet location '{data}'"
+        .encode()))
+    client.do_get(info.endpoints[0].ticket).read_all()
+    info = client.get_flight_info(fl.FlightDescriptor.for_command(b"show tables"))
+    shown = client.do_get(info.endpoints[0].ticket).read_all()
+    assert "nums" in shown.column("table_name").to_pylist()
+    info = client.get_flight_info(fl.FlightDescriptor.for_command(
+        b"select sum(v) as s from nums"))
+    assert client.do_get(info.endpoints[0].ticket).read_all() \
+        .column("s").to_pylist() == [sum(range(50))]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "examples/flight_sql_client.py",
+         "127.0.0.1", str(cluster.flight.port),
+         "select count(*) as n from nums"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+        env={**os.environ, "PALLAS_AXON_POOL_IPS": "",
+             "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "50" in out.stdout
+
+
+def test_flight_utility_statements(cluster):
+    """SHOW ALL / DESCRIBE / EXPLAIN through the Flight door."""
+    client = fl.connect(f"grpc://127.0.0.1:{cluster.flight.port}")
+
+    def run(sql):
+        info = client.get_flight_info(fl.FlightDescriptor.for_command(sql))
+        return client.do_get(info.endpoints[0].ticket).read_all()
+
+    settings = run(b"show all")
+    assert "ballista.shuffle.partitions" in settings.column("name").to_pylist()
+    cols = run(b"show columns from t")
+    assert "g" in cols.column("column_name").to_pylist()
+    plan = run(b"explain select g, sum(v) s from t group by g")
+    assert plan.column("plan_type").to_pylist() == [
+        "logical_plan", "physical_plan"]
+    assert "HashAggregateExec" in plan.column("plan").to_pylist()[1]
