@@ -543,10 +543,11 @@ class HashAggregateExec(ExecutionPlan):
             else:
                 key_ranges.append(None)
         key_ranges = tuple(key_ranges)
-        # adaptive capacity: AGG_CAPACITY is the *initial* guess; on overflow
-        # retry at the next power-of-two (bounded by the input capacity —
-        # groups can never exceed live rows).  Mirrors the join's bucketed
-        # recompilation; static shapes stay static per bucket.
+        # adaptive capacity: AGG_CAPACITY is the *initial* guess; on
+        # overflow retry at 4x (two pow2 buckets per step, bounded by the
+        # input capacity — groups can never exceed live rows).  Mirrors
+        # the join's bucketed recompilation; static shapes stay static per
+        # bucket.
         out_cap = min(cfg_cap, big.capacity)
         # same-stage tasks see similar cardinality and share this operator
         # instance: once one task discovers the real group count, the rest
@@ -572,7 +573,13 @@ class HashAggregateExec(ExecutionPlan):
                         f"aggregation overflowed {out_cap} groups with "
                         f"{big.capacity}-row input; this should be impossible"
                     )
-                out_cap = min(out_cap * 2, big.capacity)
+                # 4x jumps: every retry is a full kernel re-run and the
+                # overflow flag says nothing about the shortfall, so take
+                # half as many retries at the price of a final buffer up to
+                # 2x larger than the 2x ladder's (e.g. 230k groups from a
+                # 64k start: one 4x retry to 256k vs two 2x retries; 460k
+                # groups: two retries to 1M vs three to 512k)
+                out_cap = min(out_cap * 4, big.capacity)
                 self.metrics().add("capacity_recompiles", 1)
         if out_cap > getattr(self, "_cap_hint", 0):
             self._cap_hint = out_cap
