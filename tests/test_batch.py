@@ -66,3 +66,30 @@ def test_config_validation():
         BallistaConfig({"ballista.bogus": 1})
     with pytest.raises(ConfigurationError):
         BallistaConfig({"ballista.shuffle.partitions": "abc"})
+
+
+def test_wire_narrowing_mixed_width_files(tmp_path):
+    """Two shuffle files for one partition — one int32-narrowed, one kept
+    int64 (values out of range) — read back as one int64 batch."""
+    import numpy as np
+
+    from arrow_ballista_tpu.models.ipc import read_ipc_files, write_ipc_rows
+    from arrow_ballista_tpu.models.schema import Field, INT64, Schema
+
+    sch = Schema([Field("v", INT64)])
+    small = {"v": np.arange(100, dtype=np.int64)}
+    big = {"v": np.arange(100, dtype=np.int64) + 2**40}
+    p1, p2 = str(tmp_path / "a.arrow"), str(tmp_path / "b.arrow")
+    write_ipc_rows(sch, small, {}, p1)
+    write_ipc_rows(sch, big, {}, p2)
+
+    import pyarrow as pa
+    import pyarrow.ipc as ipc
+
+    assert ipc.open_file(pa.memory_map(p1)).schema.field("v").type == pa.int32()
+    assert ipc.open_file(pa.memory_map(p2)).schema.field("v").type == pa.int64()
+
+    batches = read_ipc_files([p1, p2], sch)
+    vals = np.concatenate([b.compacted_numpy()["v"] for b in batches])
+    assert vals.dtype == np.int64
+    assert sorted(vals) == sorted(list(small["v"]) + list(big["v"]))
