@@ -37,7 +37,9 @@ class DefaultQueryStageExecutor(QueryStageExecutor):
         out: Dict[str, Dict[str, float]] = {}
 
         def walk(p, path="0"):
-            out[f"{path}:{type(p).__name__}"] = dict(p.metrics().values)
+            # to_dict (not .values) so deferred metrics — counts that were
+            # device-resident at record time — resolve into the snapshot
+            out[f"{path}:{type(p).__name__}"] = p.metrics().to_dict()
             for i, c in enumerate(p.children()):
                 walk(c, f"{path}.{i}")
 

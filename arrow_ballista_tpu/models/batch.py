@@ -36,6 +36,15 @@ import jax.numpy as jnp
 from .schema import Schema
 
 
+@functools.lru_cache(maxsize=1)
+def remote_device() -> bool:
+    """True when the default jax device makes device->host syncs expensive
+    (fixed ~75 ms latency per transfer over the axon tunnel).  Platform is
+    the practical proxy: cpu arrays share host memory; accelerator backends
+    pay the transfer."""
+    return jax.devices()[0].platform != "cpu"
+
+
 def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
     n = arr.shape[0]
     if n > capacity:
@@ -154,7 +163,14 @@ class ColumnBatch:
         """Compact live rows to the front and drop to the smallest
         power-of-two capacity.  A host decision (syncs on num_rows), used at
         blocking boundaries (agg/join/sort/shuffle inputs) so downstream
-        programs compile for small static shapes after selective filters."""
+        programs compile for small static shapes after selective filters.
+
+        On a remote-attached device an unknown num_rows costs a ~75 ms
+        fixed-latency fetch, and skipping the shrink merely keeps the
+        producer's (already shape-bucketed) capacity — fewer distinct
+        compile shapes, cheap extra FLOPs — so the sync is not paid there."""
+        if self._num_rows is None and remote_device():
+            return self
         n = self.num_rows
         target = round_capacity(n)
         if target >= self.capacity:
@@ -163,13 +179,61 @@ class ColumnBatch:
         return ColumnBatch(self.schema, cols, mask, self.dicts, num_rows=n)
 
     # --- host materialization ------------------------------------------
-    def compacted_numpy(self) -> Dict[str, np.ndarray]:
+    def _pack_layout(self, extra32: Sequence[str] = ()):
+        """Static pack layout for this schema: int64 / float64 / 32-bit
+        column groups (see kernels.pack_for_host).  ``extra32`` appends
+        synthetic int32 columns (e.g. shuffle bucket ids)."""
+        i64, f64, f32 = [], [], []
+        for f in self.schema:
+            dt = f.dtype.np_dtype
+            if dt.itemsize == 8:
+                (f64 if dt.kind == "f" else i64).append((f.name, dt))
+            else:
+                f32.append((f.name, dt))
+        for name in extra32:
+            f32.append((name, np.dtype(np.int32)))
+        return tuple(i64), tuple(f64), tuple(f32)
+
+    def packed_numpy(self, hint: Optional[int] = None,
+                     extra32: Optional[Dict[str, jnp.ndarray]] = None
+                     ) -> tuple:
+        """Host numpy columns of live rows only, via ONE device->host
+        transfer that also carries the live-row count (no separate num_rows
+        sync).  Returns (cols, n).  ``hint`` guesses the packed capacity —
+        when the real count exceeds it, one more exact-size fetch happens
+        (the count arrived in the first buffer).  ``extra32`` packs extra
+        int32 device arrays (same length as mask) alongside the columns."""
+        from ..ops.kernels import pack_for_host, unpack_from_host
+
+        extra32 = extra32 or {}
+        i64, f64, f32 = self._pack_layout(tuple(extra32))
+        namesi64 = tuple(n for n, _ in i64)
+        namesf64 = tuple(n for n, _ in f64)
+        names32 = tuple(n for n, _ in f32)
+        cap = self.capacity
+        if self._num_rows is not None:
+            target = min(round_capacity(self._num_rows), cap)
+        else:
+            target = min(hint if hint else max(1024, cap >> 2), cap)
+        cols = dict(self.columns)
+        cols.update(extra32)
+        while True:
+            buf, fbuf = jax.device_get(pack_for_host(
+                cols, self.mask, target, namesi64, namesf64, names32))
+            out, n = unpack_from_host(buf, fbuf, target, i64, f64, f32)
+            if out is not None:
+                break
+            target = min(round_capacity(n), cap)
+        self._num_rows = n
+        return out, n
+
+    def compacted_numpy(self, hint: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Return host numpy columns containing only live rows, in order.
-        One device->host transfer call for the whole batch (per-column
-        np.asarray would pay a dispatch round-trip per column)."""
-        cols, mask = jax.device_get(
-            ({f.name: self.columns[f.name] for f in self.schema}, self.mask))
-        return {k: v[mask] for k, v in cols.items()}
+        One packed device->host transfer for the whole batch (per-column
+        np.asarray would pay a fixed transfer latency per column — ~75 ms
+        each over the axon tunnel)."""
+        out, _ = self.packed_numpy(hint=hint)
+        return out
 
     def to_arrow(self):
         """Decode to a pyarrow Table with logical types restored: strings from
@@ -322,7 +386,12 @@ def concat_batches(schema: Schema, batches: Sequence[ColumnBatch], capacity: Opt
     dicts = {}
     for b in batches:
         dicts.update(b.dicts)
-    return ColumnBatch(schema, cols, mask, dicts)
+    # propagate host-known row counts: a num_rows sync is a fixed-latency
+    # device fetch on remote-attached accelerators, so never discard counts
+    # the host already has
+    known = [b._num_rows for b in batches]
+    total = sum(known) if all(k is not None for k in known) else None
+    return ColumnBatch(schema, cols, mask, dicts, num_rows=total)
 
 
 def _concat_impl(cols_list, mask_list, pad: int):

@@ -29,7 +29,7 @@ from ..utils.config import AGG_CAPACITY, JOIN_OUTPUT_FACTOR, MESH_BROADCAST_ROWS
 from ..utils.errors import CapacityError
 from .expressions import ExprCompiler
 from .operators import AggSpec, HashAggregateExec, null_check_of, valid_of
-from .physical import ExecutionPlan, Partitioning, TaskContext
+from .physical import ExecutionPlan, Partitioning, TaskContext, deferred_rows
 
 
 def _pow2(n: int) -> int:
@@ -322,7 +322,10 @@ class MeshAggregateExec(ExecutionPlan):
 
         result = _finish_states(self._schema, key_c, val_c, fk, fv, fmask,
                                 big.dicts, hidden_specs=hidden)
-        self.metrics().add("output_rows", result.num_rows)
+        # deferred: the count becomes host-known for free when the shuffle
+        # writer's packed fetch materializes this batch (an eager .num_rows
+        # costs a ~75 ms scalar sync per task on remote-attached devices)
+        deferred_rows(self.metrics(), "output_rows", result)
         self.metrics().add("mesh_devices", n_dev)
         return [result]
 
@@ -426,7 +429,10 @@ class MeshPartialAggregateExec(ExecutionPlan):
         # null_check then skips them when merging across hosts
         result = _finish_states(self._schema, key_c, val_c, pk, pv, pmask,
                                 big.dicts, hidden_specs=hidden)
-        self.metrics().add("output_rows", result.num_rows)
+        # deferred: the count becomes host-known for free when the shuffle
+        # writer's packed fetch materializes this batch (an eager .num_rows
+        # costs a ~75 ms scalar sync per task on remote-attached devices)
+        deferred_rows(self.metrics(), "output_rows", result)
         self.metrics().add("mesh_devices", n_dev)
         return [result]
 
@@ -654,7 +660,10 @@ class MeshJoinExec(ExecutionPlan):
         result = ColumnBatch(self._schema,
                              {k: _unshard(v) for k, v in out_cols.items()},
                              _unshard(out_mask), dicts)
-        self.metrics().add("output_rows", result.num_rows)
+        # deferred: the count becomes host-known for free when the shuffle
+        # writer's packed fetch materializes this batch (an eager .num_rows
+        # costs a ~75 ms scalar sync per task on remote-attached devices)
+        deferred_rows(self.metrics(), "output_rows", result)
         self.metrics().add("mesh_devices", n_dev)
         return [result]
 

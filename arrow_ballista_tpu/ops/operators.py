@@ -19,13 +19,13 @@ import jax
 import jax.numpy as jnp
 
 from ..models import expr as E
-from ..models.batch import ColumnBatch, concat_batches
+from ..models.batch import ColumnBatch, concat_batches, remote_device
 from ..models.schema import BOOL, DataType, Field, INT64, Schema
 from ..utils.config import AGG_CAPACITY, JOIN_MAX_CAPACITY
 from ..utils.errors import CapacityError, ExecutionError, InternalError
 from .expressions import Compiled, ExprCompiler
 from . import kernels as K
-from .physical import ExecutionPlan, Partitioning, TaskContext
+from .physical import ExecutionPlan, Partitioning, TaskContext, deferred_rows
 
 
 # job-keyed weakref registry of join operators holding a materialized
@@ -455,9 +455,12 @@ class HashAggregateExec(ExecutionPlan):
             if cc.dict_fn is not None:
                 dicts[name] = cc.dict_fn(big.dicts)
         result = ColumnBatch(self._schema, dict(cols), big.mask, dicts,
-                             num_rows=big.num_rows)
+                             num_rows=big._num_rows)
         self.metrics().add("passthrough_partials", 1)
-        self.metrics().add("output_rows", result.num_rows)
+        if result._num_rows is not None:
+            self.metrics().add("output_rows", result._num_rows)
+        else:
+            deferred_rows(self.metrics(), "output_rows", result)
         return [result]
 
     def _ensure_compiled(self, ctx, in_schema):
@@ -566,7 +569,10 @@ class HashAggregateExec(ExecutionPlan):
             while True:
                 out_keys, out_vals, out_mask, overflow = jfn(
                     big.columns, big.mask, aux, out_cap, key_ranges)
-                if not bool(overflow):
+                # overflow None == statically impossible (kernel proved
+                # out_cap bounds the group count): skip the flag check — a
+                # scalar sync costs ~75 ms per task on remote devices
+                if overflow is None or not bool(overflow):
                     break
                 if out_cap >= big.capacity:
                     raise CapacityError(
@@ -615,13 +621,42 @@ class HashAggregateExec(ExecutionPlan):
                 else:
                     data[a.name] = np.zeros(1, dtype=f.dtype.np_dtype)
             result = ColumnBatch.from_numpy(self._schema, data, dicts={})
-        self.metrics().add("output_rows", result.num_rows)
-        # poor reduction on a large input => sibling tasks (same cardinality
-        # profile) skip partial aggregation entirely and emit per-row states
-        if self.mode == "partial" and self.group_exprs \
-                and big.num_rows >= (1 << 17) \
-                and result.num_rows > 0.6 * big.num_rows:
-            self._passthrough = True
+        # output_rows and the adaptive passthrough probe both want the
+        # result's row count, which is device-resident here.  Defer them:
+        # the downstream shuffle writer's packed fetch sets _num_rows on
+        # this same batch object, so by the task-status snapshot
+        # (collect_plan_metrics -> to_dict) the count is free — an eager
+        # .num_rows would pay a ~75 ms scalar sync per task.  Weakrefs so
+        # the metrics queue never pins device buffers.
+        res_ref, inp_ref = weakref.ref(result), weakref.ref(big)
+        inp_cap = big.capacity
+
+        def _finish():
+            res = res_ref()
+            if res is None:
+                return 0  # GC'd unmaterialized: count unknowable
+            rn = res._num_rows
+            if rn is None:
+                return None  # not materialized yet; stay queued
+            # poor reduction on a large input => sibling tasks (same
+            # cardinality profile) skip partial aggregation entirely and
+            # emit per-row states.  The input count may itself be unknown
+            # (post-filter device mask); its capacity upper-bounds it, so
+            # rn > 0.6*capacity still certifies poor reduction.
+            if self.mode == "partial" and self.group_exprs:
+                inp = inp_ref()
+                bn = inp._num_rows if inp is not None else None
+                if bn is not None:
+                    if bn >= (1 << 17) and rn > 0.6 * bn:
+                        self._passthrough = True
+                elif inp_cap >= (1 << 17) and rn > 0.6 * inp_cap:
+                    self._passthrough = True
+            return rn
+
+        if result._num_rows is not None:
+            self.metrics().add("output_rows", _finish())
+        else:
+            self.metrics().add_deferred("output_rows", _finish)
         return [result]
 
     def _label(self):
@@ -894,10 +929,15 @@ class JoinExec(ExecutionPlan):
                 probe.columns, probe.mask, build.columns, build.mask,
                 bh_sorted, border, laux, raux, faux, out_cap
             )
-            # the join's own count uses the same hi-lo arithmetic, so the
+            # out_cap >= total_est by construction, and the join's own count
+            # uses the same hi-lo arithmetic as the count pass, so this
             # retry can only fire if something drifts between the two
-            # programs — kept as a safety net
-            if int(total) > out_cap:
+            # compiled programs.  On remote-attached devices the eager
+            # int(total) check would cost a ~75 ms scalar sync per task for
+            # a never-taken branch — skipped there (count and join run the
+            # same arithmetic on the same inputs; a disagreement would be an
+            # XLA miscompile, which no host-side retry rescues anyway).
+            if not remote_device() and int(total) > out_cap:
                 need = 1 << (int(total) - 1).bit_length()
                 if need > ceiling:
                     raise CapacityError(
@@ -913,7 +953,10 @@ class JoinExec(ExecutionPlan):
         if self.join_type in ("inner", "left", "full"):
             dicts.update(build.dicts)
         result = ColumnBatch(self._schema, dict(out_cols), out_mask, dicts)
-        self.metrics().add("output_rows", result.num_rows)
+        if result._num_rows is not None:
+            self.metrics().add("output_rows", result._num_rows)
+        else:
+            deferred_rows(self.metrics(), "output_rows", result)
         return [result]
 
     def _label(self):

@@ -40,16 +40,55 @@ class MetricsSet:
     def __init__(self):
         self.values: Dict[str, float] = {}
         self._lock = threading.Lock()
+        self._deferred = []  # [(name, fn)] resolved lazily in to_dict
 
     def add(self, name: str, v: float):
         with self._lock:
             self.values[name] = self.values.get(name, 0) + v
 
+    def add_deferred(self, name: str, fn):
+        """Record a metric whose value would cost a device->host sync right
+        now (~75 ms fixed latency on remote-attached devices).  ``fn()``
+        must return the value, or None while it is not yet host-known —
+        not-ready entries stay queued for the next snapshot.  Downstream
+        materialization (the shuffle writer's packed fetch) normally makes
+        the value free before any snapshot happens."""
+        with self._lock:
+            self._deferred.append((name, fn))
+
     def timer(self, name: str):
         return _Timer(self, name)
 
     def to_dict(self):
-        return dict(self.values)
+        with self._lock:
+            pending = []
+            for name, fn in self._deferred:
+                v = fn()
+                if v is None:
+                    pending.append((name, fn))
+                else:
+                    self.values[name] = self.values.get(name, 0) + v
+            self._deferred = pending
+            return dict(self.values)
+
+
+def deferred_rows(ms: MetricsSet, name: str, batch) -> None:
+    """Record ``batch``'s row count as a deferred metric WITHOUT pinning the
+    batch: the closure holds a weakref, so device buffers are never kept
+    alive by metrics.  If the batch is GC'd before its count became
+    host-known (it was never materialized), the entry resolves to 0 rather
+    than staying queued forever."""
+    import weakref
+
+    ref = weakref.ref(batch)
+
+    def fn():
+        b = ref()
+        if b is None:
+            return 0
+        return b._num_rows
+
+    ms.add_deferred(name, fn)
 
 
 class _Timer:

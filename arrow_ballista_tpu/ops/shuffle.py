@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from ..models import expr as E
 from ..models.batch import ColumnBatch, concat_batches
+from ..models.batch import round_capacity as _round_capacity
 from ..models.ipc import read_ipc_files, write_ipc_file, write_ipc_rows
 from ..models.schema import Schema
 from ..utils.errors import FetchFailedError, InternalError
@@ -136,14 +137,17 @@ class ShuffleWriterExec(ExecutionPlan):
             comp, bfn = self._compiled
             with self.metrics().timer("repart_time"):
                 aux = comp.aux_arrays(big.dicts)
-                # ONE device->host transfer for buckets+mask+columns (a
-                # per-array np.asarray pays one dispatch round-trip each —
-                # ruinous over a remote-accelerator tunnel)
-                buckets, mask_np, host_cols = jax.device_get(
-                    (bfn(big.columns, big.mask, aux), big.mask, big.columns))
-                tagged = np.where(mask_np, buckets, num_out)
-                order = np.argsort(tagged, kind="stable")
-                counts = np.bincount(tagged, minlength=num_out + 1)[:num_out]
+                # ONE packed device->host transfer for columns + bucket ids
+                # + live-row count (compacted on device): a per-array fetch
+                # pays a fixed transfer latency each — ~75 ms over the axon
+                # tunnel — and padded-capacity arrays multiply the bytes
+                host_cols, n = big.packed_numpy(
+                    hint=getattr(self, "_pack_hint", None),
+                    extra32={"__bucket__": bfn(big.columns, big.mask, aux)})
+                self._pack_hint = _round_capacity(n)
+                buckets = host_cols.pop("__bucket__")
+                order = np.argsort(buckets, kind="stable")
+                counts = np.bincount(buckets, minlength=num_out)[:num_out]
                 host_cols = {k: v[order] for k, v in host_cols.items()}
             offsets = np.concatenate([[0], np.cumsum(counts)])
             out: List[ShuffleWritePartition] = []
@@ -154,8 +158,7 @@ class ShuffleWriterExec(ExecutionPlan):
                     path = os.path.join(base, f"data-{q}.arrow")
                     rows, nbytes = write_ipc_rows(big.schema, data, big.dicts, path)
                     out.append(ShuffleWritePartition(q, path, rows, nbytes))
-            # mask is already on host — never force a device sync for a metric
-            self.metrics().add("input_rows", int(mask_np.sum()))
+            self.metrics().add("input_rows", n)
             self.metrics().add("output_rows", sum(p.num_rows for p in out))
             return out
 

@@ -85,7 +85,8 @@ def distributed_filter_aggregate(
         fk, fv, fmask, ovf3 = K.grouped_aggregate(rk, rv, rmask,
                                                   final_capacity,
                                                   key_ranges=key_ranges)
-        overflow = lax.psum((ovf1 | ovf2[0] | ovf3).astype(jnp.int32), axis) > 0
+        flags = K.overflow_flag(ovf1) | ovf2[0] | K.overflow_flag(ovf3)
+        overflow = lax.psum(flags.astype(jnp.int32), axis) > 0
         return fk, fv, fmask, overflow
 
     row = P(axis)
@@ -182,7 +183,7 @@ def distributed_partial_aggregate(
         vals = [(cols[v], how) for v, how in agg_specs]
         pk, pv, pmask, ovf = K.grouped_aggregate(keys, vals, mask, capacity,
                                                  key_ranges=key_ranges)
-        overflow = lax.psum(ovf.astype(jnp.int32), axis) > 0
+        overflow = lax.psum(K.overflow_flag(ovf).astype(jnp.int32), axis) > 0
         return pk, pv, pmask, overflow
 
     row = P(axis)

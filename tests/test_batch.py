@@ -93,3 +93,87 @@ def test_wire_narrowing_mixed_width_files(tmp_path):
     vals = np.concatenate([b.compacted_numpy()["v"] for b in batches])
     assert vals.dtype == np.int64
     assert sorted(vals) == sorted(list(small["v"]) + list(big["v"]))
+
+
+def test_packed_numpy_round_trip_all_dtypes():
+    """packed_numpy: ONE device fetch carrying compacted columns + count
+    (kernels.pack_for_host layout: int64 buffer + f64 side stack), exact
+    across every physical dtype, with the too-small-hint refetch ladder."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from arrow_ballista_tpu.models.batch import ColumnBatch
+    from arrow_ballista_tpu.models.schema import DataType, Field, Schema
+
+    sch = Schema([
+        Field("a", DataType("int64")), Field("b", DataType("float64")),
+        Field("c", DataType("int32")), Field("d", DataType("date32")),
+        Field("e", DataType("decimal", 2)), Field("f", DataType("bool")),
+        Field("g", DataType("float32")), Field("s", DataType("string")),
+    ])
+    n = 777
+    rng = np.random.default_rng(3)
+    data = {
+        "a": np.arange(n) * 3, "b": rng.random(n),
+        "c": np.arange(n, dtype=np.int32) - 5,
+        "d": np.arange(n, dtype=np.int32), "e": np.arange(n) * 100 + 7,
+        "f": np.arange(n) % 3 == 0, "g": rng.random(n).astype(np.float32),
+        "s": (np.arange(n) % 4).astype(np.int32),
+    }
+    dicts = {"s": np.array(["w", "x", "y", "z"], dtype=object)}
+    b0 = ColumnBatch.from_numpy(sch, data, dicts=dicts)
+    mask = np.asarray(b0.mask).copy()
+    mask[::7] = False  # knock out rows; count becomes device-only
+    live = np.nonzero(mask)[0]
+
+    b = ColumnBatch(sch, b0.columns, jax.device_put(mask), b0.dicts)
+    out, cnt = b.packed_numpy()
+    assert cnt == len(live) and b._num_rows == cnt  # count rode the buffer
+    for k in data:
+        exp = np.asarray(data[k])[live[live < n]]
+        assert out[k].dtype == sch.field(k).dtype.np_dtype, k
+        assert np.array_equal(out[k], exp), k
+
+    # synthetic extra int32 column (shuffle bucket ids) packs alongside
+    out2, _ = ColumnBatch(sch, b0.columns, jax.device_put(mask), b0.dicts) \
+        .packed_numpy(extra32={"__bucket__": jnp.arange(b.capacity,
+                                                        dtype=jnp.int32) % 5})
+    assert np.array_equal(out2["__bucket__"], live.astype(np.int32) % 5)
+
+    # a hint below the real count triggers exactly one exact-size refetch
+    out3, cnt3 = ColumnBatch(sch, b0.columns, jax.device_put(mask),
+                             b0.dicts).packed_numpy(hint=64)
+    assert cnt3 == cnt
+    assert all(np.array_equal(out3[k], out[k]) for k in data)
+
+
+def test_deferred_metrics_resolve_in_snapshot():
+    """Device-resident counts recorded via add_deferred resolve by the time
+    collect_plan_metrics snapshots (the shuffle writer's packed fetch makes
+    them host-known), and never pin batches (weakref)."""
+    import numpy as np
+
+    from arrow_ballista_tpu.ops.physical import MetricsSet, deferred_rows
+    from arrow_ballista_tpu.models.batch import ColumnBatch
+    from arrow_ballista_tpu.models.schema import Field, INT64, Schema
+
+    sch = Schema([Field("v", INT64)])
+    b = ColumnBatch.from_numpy(sch, {"v": np.arange(10)})
+    b._num_rows = None  # simulate a device-only count
+    ms = MetricsSet()
+    deferred_rows(ms, "output_rows", b)
+    assert "output_rows" not in ms.to_dict()  # not host-known yet: queued
+    b._num_rows = 10  # the packed fetch would set this
+    assert ms.to_dict()["output_rows"] == 10
+    assert ms.to_dict()["output_rows"] == 10  # resolves once, then sticks
+
+    ms2 = MetricsSet()
+    b2 = ColumnBatch.from_numpy(sch, {"v": np.arange(4)})
+    b2._num_rows = None
+    deferred_rows(ms2, "output_rows", b2)
+    del b2  # GC'd unmaterialized: entry must resolve (to 0), not linger
+    import gc
+
+    gc.collect()
+    assert ms2.to_dict().get("output_rows") == 0
