@@ -19,7 +19,7 @@ Key techniques:
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -256,28 +256,55 @@ def grouped_aggregate(
     num_groups = jnp.sum(boundary)
     # dead or out-of-capacity rows -> dump segment
     seg_ok = mask_s & (seg >= 0) & (seg < out_capacity)
-    seg_ids = jnp.where(seg_ok, seg, out_capacity)
+    seg_ids = jnp.where(seg_ok, seg, out_capacity).astype(jnp.int32)
 
-    out_vals = []
+    # int64 sums/counts batch through the limb path (grouped_sums_i64 —
+    # segment order does not matter there): on TPU an int64 segment_sum is
+    # a 64-bit scatter measured 1-18M rows/s, and the first alternative
+    # tried (sorted-run cumsum differences) turned out to COMPILE for 44 s
+    # per shape on this backend, which per-job recompiles turned into a
+    # regression.  The limb programs compile in ~1-2 s and run at memory
+    # speed.
+    i64_positions: List[int] = []
+    i64_vals: List[jnp.ndarray] = []
+    out_vals: List[Optional[jnp.ndarray]] = []
     for arr, how in val_cols:
         a = arr[order]
-        if how == AGG_COUNT:
-            v = jax.ops.segment_sum(jnp.where(seg_ok, 1, 0).astype(jnp.int64), seg_ids,
-                                    num_segments=out_capacity + 1)[:out_capacity]
+        if how == AGG_COUNT or (how == AGG_SUM and a.dtype == jnp.int64):
+            if how == AGG_COUNT:
+                pre = jnp.where(seg_ok, 1, 0).astype(jnp.int64)
+            else:
+                pre = jnp.where(seg_ok, a, jnp.zeros((), a.dtype))
+            i64_positions.append(len(out_vals))
+            i64_vals.append(pre)
+            out_vals.append(None)
+            continue
         elif how == AGG_SUM:
             v = jax.ops.segment_sum(jnp.where(seg_ok, a, jnp.zeros((), a.dtype)), seg_ids,
                                     num_segments=out_capacity + 1)[:out_capacity]
         elif how == AGG_MIN:
-            ident = _max_ident(a.dtype)
-            v = jax.ops.segment_min(jnp.where(seg_ok, a, ident), seg_ids,
-                                    num_segments=out_capacity + 1)[:out_capacity]
+            if a.dtype == jnp.int64:
+                v = grouped_minmax_i64(a, seg_ok, seg_ids, out_capacity + 1,
+                                       is_min=True)[:out_capacity]
+            else:
+                ident = _max_ident(a.dtype)
+                v = jax.ops.segment_min(jnp.where(seg_ok, a, ident), seg_ids,
+                                        num_segments=out_capacity + 1)[:out_capacity]
         elif how == AGG_MAX:
-            ident = _min_ident(a.dtype)
-            v = jax.ops.segment_max(jnp.where(seg_ok, a, ident), seg_ids,
-                                    num_segments=out_capacity + 1)[:out_capacity]
+            if a.dtype == jnp.int64:
+                v = grouped_minmax_i64(a, seg_ok, seg_ids, out_capacity + 1,
+                                       is_min=False)[:out_capacity]
+            else:
+                ident = _min_ident(a.dtype)
+                v = jax.ops.segment_max(jnp.where(seg_ok, a, ident), seg_ids,
+                                        num_segments=out_capacity + 1)[:out_capacity]
         else:
             raise ValueError(f"unknown agg {how}")
         out_vals.append(v)
+    if i64_vals:
+        sums = grouped_sums_i64(i64_vals, seg_ids, out_capacity + 1)
+        for pos, s in zip(i64_positions, sums):
+            out_vals[pos] = s[:out_capacity]
 
     out_keys = []
     for k in keys_s:
@@ -294,6 +321,139 @@ def grouped_aggregate(
     # fixed ~75 ms over the axon tunnel, once per task
     overflow = (num_groups > out_capacity) if out_capacity < n else None
     return out_keys, out_vals, out_mask, overflow
+
+
+# --------------------------------------------------------------------------
+# int64 grouped reductions without 64-bit scatters
+# --------------------------------------------------------------------------
+#
+# XLA's TPU scatter-add is the segment_sum lowering, and with x64 emulation
+# an int64 segment_sum measured 18M rows/s — and the realistic multi-
+# aggregate shape (8 int64 sums over one segment id vector, TPC-H q1's
+# stage) collapsed to 1M rows/s, which made the aggregate the engine's
+# dominant device cost.  int32 segment ops run ~200M rows/s and int32
+# one-hot matmuls ride the MXU at effectively memory speed, so int64
+# reductions decompose into exact 16-bit limbs:
+#
+# - sums: limb rows x one-hot(segment) matmul per row-chunk (chunk bound
+#   keeps per-chunk limb sums inside int32), recombined in int64 — measured
+#   ~1000x the segment_sum x8 shape; falls back to chunk-offset int32
+#   segment_sums when the segment count makes one-hot tiles too large.
+# - min/max: lexicographic two-pass over (hi32, lo32-with-flipped-sign)
+#   int32 segment_min/max; identity values recombine to exactly the int64
+#   idents, so empty slots stay mergeable (mesh pmin/pmax).
+#
+# The CPU backend keeps plain segment ops (its scatters are fast and the
+# matmul would cost O(n*segments) scalar FLOPs on a host core).
+
+
+@lru_cache(maxsize=1)
+def _tpu_backend() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+_MATMUL_SEG_LIMIT = 1024  # one-hot matmul while chunk x segments tiles fit
+_SEG_CHUNK = 1 << 15      # max rows/chunk: 2^15 rows x 16-bit limbs < 2^31
+
+
+def _i64_limbs(v: jnp.ndarray) -> List[jnp.ndarray]:
+    """Four 16-bit limbs (int32, non-negative) of an int64 array's two's
+    complement; limb-wise sums recombine exactly mod 2^64."""
+    u = v.astype(jnp.uint64)
+    return [((u >> (16 * i)) & jnp.uint64(0xFFFF)).astype(jnp.int32)
+            for i in range(4)]
+
+
+def _recombine_limbs(parts: jnp.ndarray) -> jnp.ndarray:
+    """parts: int64[4, S] limb sums -> int64[S]."""
+    return sum(parts[i] << (16 * i) for i in range(4))
+
+
+def grouped_sums_i64(vals: List[jnp.ndarray], seg: jnp.ndarray,
+                     num_segments: int) -> List[jnp.ndarray]:
+    """Exact int64 grouped sums of pre-masked values (dead rows must
+    already be 0).  ``seg`` is int32 in [0, num_segments); rows may also
+    carry seg == num_segments-1 as a dump slot — this computes all slots
+    and the caller slices."""
+    if not _tpu_backend():
+        return [jax.ops.segment_sum(v, seg, num_segments=num_segments)
+                for v in vals]
+    n = seg.shape[0]
+    S = num_segments
+    if S <= _MATMUL_SEG_LIMIT:
+        chunk = min(_SEG_CHUNK, n)
+        pad = (-n) % chunk
+        if pad:
+            # padded rows: seg == S matches no one-hot column -> contribute 0
+            seg = jnp.concatenate([seg, jnp.full(pad, S, seg.dtype)])
+        segc = seg.reshape(-1, chunk)
+        rows = []
+        for v in vals:
+            if pad:
+                v = jnp.concatenate([v, jnp.zeros(pad, v.dtype)])
+            rows.extend(_i64_limbs(v))
+        lhs = jnp.stack(rows).reshape(len(rows), -1, chunk).transpose(1, 0, 2)
+        iota_s = jnp.arange(S, dtype=jnp.int32)
+
+        def body(acc, xs):
+            l, sc = xs
+            oh = (sc[:, None] == iota_s[None, :]).astype(jnp.int32)
+            part = jax.lax.dot_general(l, oh, (((1,), (0,)), ((), ())))
+            return acc + part.astype(jnp.int64), None
+
+        acc, _ = jax.lax.scan(body, jnp.zeros((len(rows), S), jnp.int64),
+                              (lhs, segc))
+        return [_recombine_limbs(acc[4 * i:4 * i + 4])
+                for i in range(len(vals))]
+    # large segment count: chunk-offset int32 segment_sums per limb (per
+    # chunk x segment a limb sum stays < 2^31), recombined in int64
+    chunk = min(_SEG_CHUNK, n)
+    pad = (-n) % chunk
+    S1 = S + 1  # one scratch slot for padded rows
+    if pad:
+        seg = jnp.concatenate([seg, jnp.full(pad, S, seg.dtype)])
+    C = seg.shape[0] // chunk
+    ids = (seg.reshape(C, chunk)
+           + (jnp.arange(C, dtype=jnp.int32) * S1)[:, None]).reshape(-1)
+    out = []
+    for v in vals:
+        if pad:
+            v = jnp.concatenate([v, jnp.zeros(pad, v.dtype)])
+        parts = []
+        for limb in _i64_limbs(v):
+            p = jax.ops.segment_sum(limb, ids, num_segments=C * S1)
+            parts.append(jnp.sum(p.reshape(C, S1).astype(jnp.int64),
+                                 axis=0)[:S])
+        out.append(_recombine_limbs(jnp.stack(parts)))
+    return out
+
+
+_I32_MAX = jnp.int32(2**31 - 1)
+_I32_MIN = jnp.int32(-2**31)
+
+
+def grouped_minmax_i64(v: jnp.ndarray, ok: jnp.ndarray, seg: jnp.ndarray,
+                       num_segments: int, is_min: bool) -> jnp.ndarray:
+    """Exact int64 grouped min/max via two int32 passes: first the high
+    word, then the (unsigned-ordered) low word among rows matching the
+    winning high word.  Empty slots recombine to exactly INT64_MAX /
+    INT64_MIN — the same merge identities the int64 segment ops produce."""
+    if not _tpu_backend():
+        ident = _max_ident(v.dtype) if is_min else _min_ident(v.dtype)
+        masked = jnp.where(ok, v, ident)
+        op = jax.ops.segment_min if is_min else jax.ops.segment_max
+        return op(masked, seg, num_segments=num_segments)
+    hi = (v >> 32).astype(jnp.int32)
+    # low word compared as unsigned: subtract 2^31 so int32 order matches
+    lo = ((v & jnp.int64(0xFFFFFFFF)) - jnp.int64(1 << 31)).astype(jnp.int32)
+    op = jax.ops.segment_min if is_min else jax.ops.segment_max
+    ident = _I32_MAX if is_min else _I32_MIN
+    hi_best = op(jnp.where(ok, hi, ident), seg, num_segments=num_segments)
+    sel = ok & (hi == hi_best[seg])
+    lo_best = op(jnp.where(sel, lo, ident), seg, num_segments=num_segments)
+    lo_u = (lo_best.astype(jnp.int64) + jnp.int64(1 << 31)) \
+        & jnp.int64(0xFFFFFFFF)
+    return (hi_best.astype(jnp.int64) << 32) | lo_u
 
 
 def _dense_strides(key_ranges):
@@ -340,27 +500,44 @@ def dense_group_states(
         jnp.where(in_range, 1, 0).astype(jnp.int32), seg,
         num_segments=domain + 1)[:domain]
 
-    dense_vals = []
+    # int64 sums/counts batch through the limb path (one fused program for
+    # every aggregate — the TPU-fast formulation, see grouped_sums_i64)
+    i64_sums: List[Tuple[int, jnp.ndarray]] = []
+    dense_vals: List[Optional[jnp.ndarray]] = []
     for arr, how in val_cols:
         if how == AGG_COUNT:
-            v = jax.ops.segment_sum(
-                jnp.where(in_range, 1, 0).astype(jnp.int64), seg,
-                num_segments=domain + 1)[:domain]
+            i64_sums.append((len(dense_vals),
+                             jnp.where(in_range, 1, 0).astype(jnp.int64)))
+            dense_vals.append(None)
+        elif how == AGG_SUM and arr.dtype == jnp.int64:
+            i64_sums.append((len(dense_vals),
+                             jnp.where(in_range, arr,
+                                       jnp.zeros((), arr.dtype))))
+            dense_vals.append(None)
         elif how == AGG_SUM:
             v = jax.ops.segment_sum(
                 jnp.where(in_range, arr, jnp.zeros((), arr.dtype)), seg,
                 num_segments=domain + 1)[:domain]
-        elif how == AGG_MIN:
-            v = jax.ops.segment_min(
-                jnp.where(in_range, arr, _max_ident(arr.dtype)), seg,
-                num_segments=domain + 1)[:domain]
-        elif how == AGG_MAX:
-            v = jax.ops.segment_max(
-                jnp.where(in_range, arr, _min_ident(arr.dtype)), seg,
-                num_segments=domain + 1)[:domain]
+            dense_vals.append(v)
+        elif how in (AGG_MIN, AGG_MAX):
+            if arr.dtype == jnp.int64:
+                v = grouped_minmax_i64(arr, in_range, seg, domain + 1,
+                                       is_min=(how == AGG_MIN))[:domain]
+            elif how == AGG_MIN:
+                v = jax.ops.segment_min(
+                    jnp.where(in_range, arr, _max_ident(arr.dtype)), seg,
+                    num_segments=domain + 1)[:domain]
+            else:
+                v = jax.ops.segment_max(
+                    jnp.where(in_range, arr, _min_ident(arr.dtype)), seg,
+                    num_segments=domain + 1)[:domain]
+            dense_vals.append(v)
         else:
             raise ValueError(f"unknown agg {how}")
-        dense_vals.append(v)
+    if i64_sums:
+        sums = grouped_sums_i64([v for _, v in i64_sums], seg, domain + 1)
+        for (pos, _), s in zip(i64_sums, sums):
+            dense_vals[pos] = s[:domain]
     return dense_vals, exists_cnt, bad_rows
 
 

@@ -554,16 +554,22 @@ class ParquetScanExec(ScanExec):
         for f, rg, _ in units:
             by_file.setdefault(f, []).append(rg)
         cols = self._schema.names()
+        # string columns come back dictionary-decoded straight from the
+        # parquet pages: the engine dictionary-codes them on device anyway,
+        # so this skips a full re-encode pass in table_to_physical
+        rd = [f.name for f in self._schema if f.dtype.is_string] or None
         if len(by_file) == 1:
             f, rgs = next(iter(by_file.items()))
-            return obs.read_parquet_row_groups(f, sorted(rgs), cols)
+            return obs.read_parquet_row_groups(f, sorted(rgs), cols,
+                                               read_dictionary=rd)
         # overlap reads across files (each pyarrow read releases the GIL;
         # object-store fetches overlap their network latency)
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=min(8, len(by_file))) as pool:
             tables = list(pool.map(
-                lambda kv: obs.read_parquet_row_groups(kv[0], sorted(kv[1]), cols),
+                lambda kv: obs.read_parquet_row_groups(
+                    kv[0], sorted(kv[1]), cols, read_dictionary=rd),
                 by_file.items()))
         return pa.concat_tables(tables)
 

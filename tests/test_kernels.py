@@ -110,3 +110,90 @@ def test_bucket_of_deterministic():
     b1 = np.asarray(K.bucket_of([k], 8))
     b2 = np.asarray(K.bucket_of([k], 8))
     assert (b1 == b2).all() and b1.min() >= 0 and b1.max() < 8
+
+
+def test_i64_limb_reductions_match_plain_paths(monkeypatch):
+    """The TPU-fast int64 reductions (limb matmul / chunk-offset limb
+    segment_sums / two-pass min-max) must be bit-identical to the plain
+    segment-op paths on every input class: negatives, full-range
+    magnitudes, empty groups, dump slots."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from arrow_ballista_tpu.ops import kernels as K
+
+    rng = np.random.default_rng(5)
+    n, S = 4096, 37
+    seg_np = rng.integers(0, S, n).astype(np.int32)
+    vals_np = [
+        rng.integers(-2**40, 2**40, n).astype(np.int64),
+        rng.integers(-5, 5, n).astype(np.int64) * (2**52),
+        np.ones(n, dtype=np.int64),
+    ]
+    seg = jnp.asarray(seg_np)
+    vals = [jnp.asarray(v) for v in vals_np]
+
+    def with_fast(flag, fn):
+        K._tpu_backend.cache_clear()
+        monkeypatch.setattr(K, "_tpu_backend", lambda: flag)
+        try:
+            return fn()
+        finally:
+            monkeypatch.undo()
+            K._tpu_backend.cache_clear()
+
+    # small-S: one-hot limb matmul branch
+    fast = with_fast(True, lambda: [np.asarray(x) for x in
+                                    K.grouped_sums_i64(vals, seg, S)])
+    slow = with_fast(False, lambda: [np.asarray(x) for x in
+                                     K.grouped_sums_i64(vals, seg, S)])
+    for f, s in zip(fast, slow):
+        assert np.array_equal(f, s)
+        assert f.dtype == np.int64
+    # large-S: chunk-offset int32 segment_sum branch
+    Sbig = K._MATMUL_SEG_LIMIT + 3
+    segb = jnp.asarray(rng.integers(0, Sbig, n).astype(np.int32))
+    fast_b = with_fast(True, lambda: [np.asarray(x) for x in
+                                      K.grouped_sums_i64(vals, segb, Sbig)])
+    slow_b = with_fast(False, lambda: [np.asarray(x) for x in
+                                       K.grouped_sums_i64(vals, segb, Sbig)])
+    for f, s in zip(fast_b, slow_b):
+        assert np.array_equal(f, s)
+
+    # min/max: two-pass int32 vs int64 segment ops, incl. empty-slot idents
+    ok = jnp.asarray(rng.random(n) < 0.8)
+    Sgap = S + 4  # slots S..S+3 stay empty -> ident values must match
+    for is_min in (True, False):
+        f = with_fast(True, lambda: np.asarray(
+            K.grouped_minmax_i64(vals[0], ok, seg, Sgap, is_min)))
+        s = with_fast(False, lambda: np.asarray(
+            K.grouped_minmax_i64(vals[0], ok, seg, Sgap, is_min)))
+        assert np.array_equal(f, s)
+
+    # full sort-path grouped_aggregate equivalence (cumsum differences)
+    keys = [jnp.asarray(rng.integers(0, 50, n).astype(np.int64))]
+    mask = jnp.asarray(rng.random(n) < 0.9)
+    vcols = [(vals[0], K.AGG_SUM), (vals[1], K.AGG_SUM),
+             (jnp.zeros(n, jnp.int64), K.AGG_COUNT),
+             (vals[0], K.AGG_MIN), (vals[0], K.AGG_MAX)]
+    out_f = with_fast(True, lambda: K.grouped_aggregate(keys, vcols, mask, 64))
+    out_s = with_fast(False, lambda: K.grouped_aggregate(keys, vcols, mask, 64))
+    for f, s in zip(out_f[0] + out_f[1], out_s[0] + out_s[1]):
+        assert np.array_equal(np.asarray(f), np.asarray(s))
+    assert np.array_equal(np.asarray(out_f[2]), np.asarray(out_s[2]))
+
+    # DENSE path (key_ranges -> dense_group_states i64 routing): fast vs
+    # plain must agree through the public API too, including min/max and a
+    # mixed agg list that exercises the position bookkeeping
+    dkeys = [jnp.asarray(rng.integers(0, 3, n).astype(np.int32)),
+             jnp.asarray(rng.integers(0, 2, n).astype(np.int32))]
+    dranges = ((0, 2), (0, 1))
+    dv = [(vals[0], K.AGG_SUM), (jnp.zeros(n, jnp.int64), K.AGG_COUNT),
+          (vals[0], K.AGG_MIN), (vals[1], K.AGG_SUM), (vals[0], K.AGG_MAX)]
+    dout_f = with_fast(True, lambda: K.grouped_aggregate(
+        dkeys, dv, mask, 8, key_ranges=dranges))
+    dout_s = with_fast(False, lambda: K.grouped_aggregate(
+        dkeys, dv, mask, 8, key_ranges=dranges))
+    for f, s in zip(dout_f[0] + dout_f[1], dout_s[0] + dout_s[1]):
+        assert np.array_equal(np.asarray(f), np.asarray(s))
+    assert np.array_equal(np.asarray(dout_f[2]), np.asarray(dout_s[2]))
