@@ -256,12 +256,15 @@ class ExprCompiler:
                            for k, v in raw.items()}
                 else:
                     hit = raw
-                if len(self._aux_cache) > 64:
-                    self._aux_cache.clear()
-                # pin the keyed dictionary arrays: the key uses id(), and a
-                # collected dictionary would let an unrelated array reuse
-                # the address and hit a STALE LUT (observed as a flaky
-                # wrong-result under full-suite memory churn)
+                # LRU-bounded: entries pin the keyed dictionary arrays (the
+                # key uses id(), and a collected dictionary would let an
+                # unrelated array reuse the address and hit a STALE LUT —
+                # observed as a flaky wrong-result under memory churn), and
+                # compilers now live process-long in the cross-job program
+                # cache (ops/physical.py shared_program), so a generous
+                # bound would retain dictionaries from many finished jobs.
+                while len(self._aux_cache) >= 16:
+                    self._aux_cache.pop(next(iter(self._aux_cache)))
                 entry = (tuple(dicts.values()), hit)
                 self._aux_cache[key] = entry
         return entry[1]

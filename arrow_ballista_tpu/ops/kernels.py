@@ -395,14 +395,16 @@ def grouped_sums_i64(vals: List[jnp.ndarray], seg: jnp.ndarray,
         lhs = jnp.stack(rows).reshape(len(rows), -1, chunk).transpose(1, 0, 2)
         iota_s = jnp.arange(S, dtype=jnp.int32)
 
-        def body(acc, xs):
+        # carry-free scan (stacked per-chunk partials, summed after): a
+        # zeros-initialized carry has no varying manual axes and trips
+        # shard_map's vma check when this runs inside a mesh program
+        def body(_, xs):
             l, sc = xs
             oh = (sc[:, None] == iota_s[None, :]).astype(jnp.int32)
-            part = jax.lax.dot_general(l, oh, (((1,), (0,)), ((), ())))
-            return acc + part.astype(jnp.int64), None
+            return None, jax.lax.dot_general(l, oh, (((1,), (0,)), ((), ())))
 
-        acc, _ = jax.lax.scan(body, jnp.zeros((len(rows), S), jnp.int64),
-                              (lhs, segc))
+        _, parts = jax.lax.scan(body, None, (lhs, segc))
+        acc = jnp.sum(parts.astype(jnp.int64), axis=0)
         return [_recombine_limbs(acc[4 * i:4 * i + 4])
                 for i in range(len(vals))]
     # large segment count: chunk-offset int32 segment_sums per limb (per

@@ -25,7 +25,9 @@ from ..utils.config import AGG_CAPACITY, JOIN_MAX_CAPACITY
 from ..utils.errors import CapacityError, ExecutionError, InternalError
 from .expressions import Compiled, ExprCompiler
 from . import kernels as K
-from .physical import ExecutionPlan, Partitioning, TaskContext, deferred_rows
+from .physical import (ExecutionPlan, Partitioning, TaskContext,
+                       deferred_rows, exprs_sig, has_scalar_subquery,
+                       schema_sig, shared_program)
 
 
 # job-keyed weakref registry of join operators holding a materialized
@@ -181,7 +183,15 @@ class ProjectionExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         with self.xla_lock():
             if self._compiled is None:
-                self._compiled = self._compile(ctx.scalars)
+                if has_scalar_subquery(*[e for e, _ in self.exprs]):
+                    self._compiled = self._compile(ctx.scalars)
+                else:
+                    self._compiled = shared_program(
+                        ("proj", self.host_mode,
+                         schema_sig(self.input.schema),
+                         tuple(n for _, n in self.exprs),
+                         exprs_sig([e for e, _ in self.exprs])),
+                        lambda: self._compile(ctx.scalars))
         comp, compiled, jfn = self._compiled
         out = []
         for b in self.input.execute(partition, ctx):
@@ -274,16 +284,25 @@ class FilterExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         with self.xla_lock():
             if self._compiled is None:
-                comp = ExprCompiler(self.input.schema,
-                                    "host" if self.host_mode else "device")
-                pred = comp.compile_pred(_substitute_scalars(self.predicate, ctx.scalars))
-                if pred.dtype != BOOL:
-                    raise InternalError("filter predicate must be boolean")
-                if self.host_mode:
-                    jfn = None
+                def build():
+                    comp = ExprCompiler(self.input.schema,
+                                        "host" if self.host_mode else "device")
+                    pred = comp.compile_pred(_substitute_scalars(self.predicate, ctx.scalars))
+                    if pred.dtype != BOOL:
+                        raise InternalError("filter predicate must be boolean")
+                    if self.host_mode:
+                        jfn = None
+                    else:
+                        jfn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
+                    return comp, pred, jfn
+
+                if has_scalar_subquery(self.predicate):
+                    self._compiled = build()
                 else:
-                    jfn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
-                self._compiled = (comp, pred, jfn)
+                    self._compiled = shared_program(
+                        ("filter", self.host_mode,
+                         schema_sig(self.input.schema),
+                         exprs_sig([self.predicate])), build)
         comp, pred, jfn = self._compiled
         out = []
         for b in self.input.execute(partition, ctx):
@@ -465,62 +484,79 @@ class HashAggregateExec(ExecutionPlan):
 
     def _ensure_compiled(self, ctx, in_schema):
         if self._compiled is None:
-            comp = ExprCompiler(in_schema, "device")
-            group_c = [(comp.compile(_substitute_scalars(e, ctx.scalars)), n)
-                       for e, n in self.group_exprs]
-            agg_c = []
-            for a in self.aggs:
-                if self.mode == "final":
-                    operand = E.Column(a.name)
-                    how = self.MERGE[a.func]
-                else:
-                    operand = a.operand if a.operand is not None else None
-                    how = a.func
-                cc = comp.compile(_substitute_scalars(operand, ctx.scalars)) if operand is not None else None
-                # SQL NULL semantics: aggregates skip NULL inputs
-                null_check = null_check_of(cc, operand, in_schema)
-                agg_c.append((cc, how, a.name, null_check))
-            # nullable sum/min/max also aggregate a hidden per-group valid
-            # count, so an all-NULL group can be restored to NULL afterwards
-            tracked = [i for i, (cc, how, _, nc) in enumerate(agg_c)
-                       if nc is not None and how in ("sum", "min", "max")]
+            all_exprs = [e for e, _ in self.group_exprs] + \
+                [a.operand for a in self.aggs]
+            if not has_scalar_subquery(*all_exprs):
+                # job-independent program: share across jobs (re-running a
+                # query re-traces every program otherwise, ~0.2 s each on
+                # the remote TPU backend)
+                key = ("agg", self.mode, schema_sig(in_schema),
+                       exprs_sig([e for e, _ in self.group_exprs]),
+                       tuple(n for _, n in self.group_exprs),
+                       tuple((a.func, a.name) for a in self.aggs),
+                       exprs_sig([a.operand for a in self.aggs]))
+                self._compiled = shared_program(
+                    key, lambda: self._build_compiled(ctx, in_schema))
+            else:
+                self._compiled = self._build_compiled(ctx, in_schema)
 
-            def agg_fn(cols, mask, aux, out_cap, key_ranges):
-                # literal keys/operands compile to scalars; kernels index
-                # per row (GROUP BY 1 with a literal select item is legal)
-                keys = [jnp.broadcast_to(k, mask.shape) if k.ndim == 0 else k
-                        for k in (c.fn(cols, aux) for c, _ in group_c)]
-                vals = []
-                valids = {}
-                for i, (cc, how, _, null_check) in enumerate(agg_c):
-                    if cc is None:  # count(*)
-                        vals.append((jnp.zeros(mask.shape, jnp.int64), K.AGG_COUNT))
+    def _build_compiled(self, ctx, in_schema):
+        comp = ExprCompiler(in_schema, "device")
+        group_c = [(comp.compile(_substitute_scalars(e, ctx.scalars)), n)
+                   for e, n in self.group_exprs]
+        agg_c = []
+        for a in self.aggs:
+            if self.mode == "final":
+                operand = E.Column(a.name)
+                how = self.MERGE[a.func]
+            else:
+                operand = a.operand if a.operand is not None else None
+                how = a.func
+            cc = comp.compile(_substitute_scalars(operand, ctx.scalars)) if operand is not None else None
+            # SQL NULL semantics: aggregates skip NULL inputs
+            null_check = null_check_of(cc, operand, in_schema)
+            agg_c.append((cc, how, a.name, null_check))
+        # nullable sum/min/max also aggregate a hidden per-group valid
+        # count, so an all-NULL group can be restored to NULL afterwards
+        tracked = [i for i, (cc, how, _, nc) in enumerate(agg_c)
+                   if nc is not None and how in ("sum", "min", "max")]
+
+        def agg_fn(cols, mask, aux, out_cap, key_ranges):
+            # literal keys/operands compile to scalars; kernels index
+            # per row (GROUP BY 1 with a literal select item is legal)
+            keys = [jnp.broadcast_to(k, mask.shape) if k.ndim == 0 else k
+                    for k in (c.fn(cols, aux) for c, _ in group_c)]
+            vals = []
+            valids = {}
+            for i, (cc, how, _, null_check) in enumerate(agg_c):
+                if cc is None:  # count(*)
+                    vals.append((jnp.zeros(mask.shape, jnp.int64), K.AGG_COUNT))
+                    continue
+                v = cc.fn(cols, aux)
+                if v.ndim == 0:
+                    # literal operands (count(1), sum(2)) compile to
+                    # scalars; aggregation kernels index per row
+                    v = jnp.broadcast_to(v, mask.shape)
+                if null_check is not None:
+                    valid = valid_of(v, null_check)
+                    valids[i] = valid
+                    if how == "count":
+                        vals.append((valid.astype(jnp.int64), K.AGG_SUM))
                         continue
-                    v = cc.fn(cols, aux)
-                    if v.ndim == 0:
-                        # literal operands (count(1), sum(2)) compile to
-                        # scalars; aggregation kernels index per row
-                        v = jnp.broadcast_to(v, mask.shape)
-                    if null_check is not None:
-                        valid = valid_of(v, null_check)
-                        valids[i] = valid
-                        if how == "count":
-                            vals.append((valid.astype(jnp.int64), K.AGG_SUM))
-                            continue
-                        if how == "sum":
-                            v = jnp.where(valid, v, jnp.zeros((), v.dtype))
-                        elif how == "min":
-                            v = jnp.where(valid, v, K._max_ident(v.dtype))
-                        elif how == "max":
-                            v = jnp.where(valid, v, K._min_ident(v.dtype))
-                    vals.append((v, how))
-                for i in tracked:
-                    vals.append((valids[i].astype(jnp.int64), K.AGG_SUM))
-                return K.grouped_aggregate(keys, vals, mask, out_cap,
-                                           key_ranges=key_ranges)
+                    if how == "sum":
+                        v = jnp.where(valid, v, jnp.zeros((), v.dtype))
+                    elif how == "min":
+                        v = jnp.where(valid, v, K._max_ident(v.dtype))
+                    elif how == "max":
+                        v = jnp.where(valid, v, K._min_ident(v.dtype))
+                vals.append((v, how))
+            for i in tracked:
+                vals.append((valids[i].astype(jnp.int64), K.AGG_SUM))
+            return K.grouped_aggregate(keys, vals, mask, out_cap,
+                                       key_ranges=key_ranges)
 
-            self._compiled = (comp, group_c, agg_c, tracked,
-                              jax.jit(agg_fn, static_argnums=(3, 4)))
+        return (comp, group_c, agg_c, tracked,
+                jax.jit(agg_fn, static_argnums=(3, 4)))
 
     def _execute_device(self, ctx, cfg_cap, big):
         comp, group_c, agg_c, tracked, jfn = self._compiled
@@ -763,118 +799,129 @@ class JoinExec(ExecutionPlan):
 
     def _ensure_compiled(self, ctx, lsch, rsch):
         if self._compiled is None:
-            lcomp = ExprCompiler(lsch, "device")
-            rcomp = ExprCompiler(rsch, "device")
-            lkeys = [lcomp.compile_key(le) for le, _ in self.on]
-            rkeys = [rcomp.compile_key(re_) for _, re_ in self.on]
-            # NULL join keys never match (string keys handle this via the
-            # NULL_KEY_SENTINEL below; numeric nullable keys via validity)
-            lkey_valid = [lcomp.validity_fn(lcomp.nullable_refs(le)) for le, _ in self.on]
-            rkey_valid = [rcomp.validity_fn(rcomp.nullable_refs(re_)) for _, re_ in self.on]
-            fcomp = fpred = None
-            if self.filter is not None:
-                merged = lsch.merge(rsch)
-                fcomp = ExprCompiler(merged, "device")
-                fpred = fcomp.compile_pred(_substitute_scalars(self.filter, ctx.scalars))
+            join_exprs = [e for pair in self.on for e in pair] + [self.filter]
+            if not has_scalar_subquery(*join_exprs):
+                key = ("join", self.join_type, self.dist,
+                       schema_sig(lsch), schema_sig(rsch),
+                       schema_sig(self._schema), exprs_sig(join_exprs))
+                self._compiled = shared_program(
+                    key, lambda: self._build_join(ctx, lsch, rsch))
+            else:
+                self._compiled = self._build_join(ctx, lsch, rsch)
 
-            jt = self.join_type
-            lnames = [f.name for f in lsch]
-            rnames = [f.name for f in rsch]
-            rfill = {f.name: f.dtype.null_sentinel for f in rsch}
-            lfill = {f.name: f.dtype.null_sentinel for f in lsch}
+    def _build_join(self, ctx, lsch, rsch):
+        lcomp = ExprCompiler(lsch, "device")
+        rcomp = ExprCompiler(rsch, "device")
+        lkeys = [lcomp.compile_key(le) for le, _ in self.on]
+        rkeys = [rcomp.compile_key(re_) for _, re_ in self.on]
+        # NULL join keys never match (string keys handle this via the
+        # NULL_KEY_SENTINEL below; numeric nullable keys via validity)
+        lkey_valid = [lcomp.validity_fn(lcomp.nullable_refs(le)) for le, _ in self.on]
+        rkey_valid = [rcomp.validity_fn(rcomp.nullable_refs(re_)) for _, re_ in self.on]
+        fcomp = fpred = None
+        if self.filter is not None:
+            merged = lsch.merge(rsch)
+            fcomp = ExprCompiler(merged, "device")
+            fpred = fcomp.compile_pred(_substitute_scalars(self.filter, ctx.scalars))
 
-            def prep_fn(bcols, bmask, raux):
-                # build-side hash + sort, hoisted out of the per-task probe:
-                # a broadcast build is shared by every probe partition, and
-                # re-sorting a 1.5M-row build inside all 12 task dispatches
-                # was measured at 61 task-seconds on q21's l1/orders join
-                bk = [c.fn(bcols, raux) for c in rkeys]
-                bh_sorted, border, _ = K.build_side_sort(bk, bmask)
-                return bh_sorted, border
+        jt = self.join_type
+        lnames = [f.name for f in lsch]
+        rnames = [f.name for f in rsch]
+        rfill = {f.name: f.dtype.null_sentinel for f in rsch}
+        lfill = {f.name: f.dtype.null_sentinel for f in lsch}
 
-            def join_fn(pcols, pmask, bcols, bmask, bh_sorted, border,
-                        laux, raux, faux, out_cap):
-                pk = [c.fn(pcols, laux) for c in lkeys]
-                bk = [c.fn(bcols, raux) for c in rkeys]
-                ph = K.hash64(pk)
-                pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
-                bidx = border[bp]
-                # verify real key equality (hash collisions) + build liveness;
-                # string keys are value-hashes: exclude the NULL sentinel so
-                # NULL never equals NULL (SQL semantics)
-                ok = pair_valid & bmask[bidx]
-                for i, ((a, b), ck) in enumerate(zip(zip(pk, bk), lkeys)):
-                    ok = ok & (a[pi] == b[bidx])
-                    if ck.dtype.is_string:
-                        sent = ExprCompiler.NULL_KEY_SENTINEL
-                        ok = ok & (a[pi] != sent)
-                    if lkey_valid[i] is not None:
-                        ok = ok & lkey_valid[i](pcols, laux)[pi]
-                    if rkey_valid[i] is not None:
-                        ok = ok & rkey_valid[i](bcols, raux)[bidx]
-                if fpred is not None:
-                    pair_cols = {n: pcols[n][pi] for n in lnames}
-                    pair_cols.update({n: bcols[n][bidx] for n in rnames})
-                    ok = ok & fpred.fn(pair_cols, faux)
+        def prep_fn(bcols, bmask, raux):
+            # build-side hash + sort, hoisted out of the per-task probe:
+            # a broadcast build is shared by every probe partition, and
+            # re-sorting a 1.5M-row build inside all 12 task dispatches
+            # was measured at 61 task-seconds on q21's l1/orders join
+            bk = [c.fn(bcols, raux) for c in rkeys]
+            bh_sorted, border, _ = K.build_side_sort(bk, bmask)
+            return bh_sorted, border
 
-                if jt in ("semi", "anti"):
-                    hit = K.segment_any(ok, pi, pmask.shape[0])
-                    new_mask = pmask & (hit if jt == "semi" else ~hit)
-                    return pcols, new_mask, total
+        def join_fn(pcols, pmask, bcols, bmask, bh_sorted, border,
+                    laux, raux, faux, out_cap):
+            pk = [c.fn(pcols, laux) for c in lkeys]
+            bk = [c.fn(bcols, raux) for c in rkeys]
+            ph = K.hash64(pk)
+            pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
+            bidx = border[bp]
+            # verify real key equality (hash collisions) + build liveness;
+            # string keys are value-hashes: exclude the NULL sentinel so
+            # NULL never equals NULL (SQL semantics)
+            ok = pair_valid & bmask[bidx]
+            for i, ((a, b), ck) in enumerate(zip(zip(pk, bk), lkeys)):
+                ok = ok & (a[pi] == b[bidx])
+                if ck.dtype.is_string:
+                    sent = ExprCompiler.NULL_KEY_SENTINEL
+                    ok = ok & (a[pi] != sent)
+                if lkey_valid[i] is not None:
+                    ok = ok & lkey_valid[i](pcols, laux)[pi]
+                if rkey_valid[i] is not None:
+                    ok = ok & rkey_valid[i](bcols, raux)[bidx]
+            if fpred is not None:
+                pair_cols = {n: pcols[n][pi] for n in lnames}
+                pair_cols.update({n: bcols[n][bidx] for n in rnames})
+                ok = ok & fpred.fn(pair_cols, faux)
 
-                out_cols = {n: pcols[n][pi] for n in lnames}
-                out_cols.update({n: bcols[n][bidx] for n in rnames})
-                out_mask = ok
-                if jt in ("left", "full"):
-                    hit = K.segment_any(ok, pi, pmask.shape[0])
-                    miss = pmask & ~hit
-                    # append unmatched probe rows; build side filled with the
-                    # per-dtype NULL sentinel (schema marks those nullable)
-                    out_cols = {
-                        n: jnp.concatenate([
-                            out_cols[n],
-                            pcols[n] if n in lnames else jnp.full(
-                                pmask.shape[0],
-                                rfill[n],
-                                out_cols[n].dtype,
-                            ),
-                        ])
-                        for n in out_cols
-                    }
-                    out_mask = jnp.concatenate([out_mask, miss])
-                if jt == "full":
-                    # unmatched BUILD rows too, probe side NULL-filled
-                    hit_b = K.segment_any(ok, bidx, bmask.shape[0])
-                    miss_b = bmask & ~hit_b
-                    out_cols = {
-                        n: jnp.concatenate([
-                            out_cols[n],
-                            bcols[n] if n in rnames else jnp.full(
-                                bmask.shape[0],
-                                lfill[n],
-                                out_cols[n].dtype,
-                            ),
-                        ])
-                        for n in out_cols
-                    }
-                    out_mask = jnp.concatenate([out_mask, miss_b])
-                return out_cols, out_mask, total
+            if jt in ("semi", "anti"):
+                hit = K.segment_any(ok, pi, pmask.shape[0])
+                new_mask = pmask & (hit if jt == "semi" else ~hit)
+                return pcols, new_mask, total
 
-            def count_fn(pcols, pmask, bh_sorted, laux):
-                # candidate-pair count only: the same hi-lo arithmetic the
-                # join performs, none of the gathers — sizes the output
-                # buffers to reality instead of out_factor x probe capacity
-                # (a 1M-row probe batch with 30k matches would otherwise
-                # gather every output column into 2M-row buffers)
-                pk = [c.fn(pcols, laux) for c in lkeys]
-                ph = K.hash64(pk)
-                lo = jnp.searchsorted(bh_sorted, ph, side="left")
-                hi = jnp.searchsorted(bh_sorted, ph, side="right")
-                return jnp.sum(jnp.where(pmask, hi - lo, 0))
+            out_cols = {n: pcols[n][pi] for n in lnames}
+            out_cols.update({n: bcols[n][bidx] for n in rnames})
+            out_mask = ok
+            if jt in ("left", "full"):
+                hit = K.segment_any(ok, pi, pmask.shape[0])
+                miss = pmask & ~hit
+                # append unmatched probe rows; build side filled with the
+                # per-dtype NULL sentinel (schema marks those nullable)
+                out_cols = {
+                    n: jnp.concatenate([
+                        out_cols[n],
+                        pcols[n] if n in lnames else jnp.full(
+                            pmask.shape[0],
+                            rfill[n],
+                            out_cols[n].dtype,
+                        ),
+                    ])
+                    for n in out_cols
+                }
+                out_mask = jnp.concatenate([out_mask, miss])
+            if jt == "full":
+                # unmatched BUILD rows too, probe side NULL-filled
+                hit_b = K.segment_any(ok, bidx, bmask.shape[0])
+                miss_b = bmask & ~hit_b
+                out_cols = {
+                    n: jnp.concatenate([
+                        out_cols[n],
+                        bcols[n] if n in rnames else jnp.full(
+                            bmask.shape[0],
+                            lfill[n],
+                            out_cols[n].dtype,
+                        ),
+                    ])
+                    for n in out_cols
+                }
+                out_mask = jnp.concatenate([out_mask, miss_b])
+            return out_cols, out_mask, total
 
-            self._compiled = (lcomp, rcomp, fcomp,
-                              jax.jit(join_fn, static_argnums=(9,)),
-                              jax.jit(count_fn), jax.jit(prep_fn))
+        def count_fn(pcols, pmask, bh_sorted, laux):
+            # candidate-pair count only: the same hi-lo arithmetic the
+            # join performs, none of the gathers — sizes the output
+            # buffers to reality instead of out_factor x probe capacity
+            # (a 1M-row probe batch with 30k matches would otherwise
+            # gather every output column into 2M-row buffers)
+            pk = [c.fn(pcols, laux) for c in lkeys]
+            ph = K.hash64(pk)
+            lo = jnp.searchsorted(bh_sorted, ph, side="left")
+            hi = jnp.searchsorted(bh_sorted, ph, side="right")
+            return jnp.sum(jnp.where(pmask, hi - lo, 0))
+
+        return (lcomp, rcomp, fcomp,
+                jax.jit(join_fn, static_argnums=(9,)),
+                jax.jit(count_fn), jax.jit(prep_fn))
 
     def _join_device(self, ctx, probe, build, lsch, rsch):
         lcomp, rcomp, fcomp, jfn, cfn, pfn = self._compiled
@@ -1002,15 +1049,24 @@ class SortExec(ExecutionPlan):
 
         with self.xla_lock():
             if self._compiled is None:
-                comp = ExprCompiler(self.input.schema, "device")
-                keys_c = [(comp.compile(_substitute_scalars(e, ctx.scalars)), asc) for e, asc in self.keys]
+                def build():
+                    comp = ExprCompiler(self.input.schema, "device")
+                    keys_c = [(comp.compile(_substitute_scalars(e, ctx.scalars)), asc) for e, asc in self.keys]
 
-                def sort_fn(cols, mask, aux):
-                    key_arrays = [(c.fn(cols, aux), asc) for c, asc in keys_c]
-                    order = K.sort_order(key_arrays, mask)
-                    return {k: v[order] for k, v in cols.items()}, mask[order]
+                    def sort_fn(cols, mask, aux):
+                        key_arrays = [(c.fn(cols, aux), asc) for c, asc in keys_c]
+                        order = K.sort_order(key_arrays, mask)
+                        return {k: v[order] for k, v in cols.items()}, mask[order]
 
-                self._compiled = (comp, jax.jit(sort_fn))
+                    return comp, jax.jit(sort_fn)
+
+                if has_scalar_subquery(*[e for e, _ in self.keys]):
+                    self._compiled = build()
+                else:
+                    self._compiled = shared_program(
+                        ("sort", schema_sig(self.input.schema),
+                         tuple(asc for _, asc in self.keys),
+                         exprs_sig([e for e, _ in self.keys])), build)
             comp, jfn = self._compiled
             with self.metrics().timer("sort_time"):
                 aux = comp.aux_arrays(big.dicts)

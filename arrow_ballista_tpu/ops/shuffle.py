@@ -38,7 +38,8 @@ from ..models.schema import Schema
 from ..utils.errors import FetchFailedError, InternalError
 from .expressions import ExprCompiler
 from . import kernels as K
-from .physical import ExecutionPlan, Partitioning, TaskContext
+from .physical import (ExecutionPlan, Partitioning, TaskContext,
+                       exprs_sig, schema_sig, shared_program)
 
 
 @dataclasses.dataclass
@@ -126,14 +127,20 @@ class ShuffleWriterExec(ExecutionPlan):
             # (kernels.py grouped_aggregate notes).
             with self.xla_lock():
                 if self._compiled is None:
-                    comp = ExprCompiler(self.input.schema, "device")
-                    keys_c = [comp.compile_key(e) for e in self.partitioning.exprs]
+                    def build():
+                        comp = ExprCompiler(self.input.schema, "device")
+                        keys_c = [comp.compile_key(e)
+                                  for e in self.partitioning.exprs]
 
-                    def bucket_fn(cols, mask, aux):
-                        keys = [c.fn(cols, aux) for c in keys_c]
-                        return K.bucket_of(keys, num_out)
+                        def bucket_fn(cols, mask, aux):
+                            keys = [c.fn(cols, aux) for c in keys_c]
+                            return K.bucket_of(keys, num_out)
 
-                    self._compiled = (comp, jax.jit(bucket_fn))
+                        return comp, jax.jit(bucket_fn)
+
+                    self._compiled = shared_program(
+                        ("bucket", num_out, schema_sig(self.input.schema),
+                         exprs_sig(self.partitioning.exprs)), build)
             comp, bfn = self._compiled
             with self.metrics().timer("repart_time"):
                 aux = comp.aux_arrays(big.dicts)

@@ -52,12 +52,17 @@ class ScalarUdf:
 class UdfRegistry:
     def __init__(self):
         self._udfs: Dict[str, ScalarUdf] = {}
+        # bumped on every (de)registration: compiled closures bake udf.fn,
+        # so the cross-job program cache keys on this generation — a
+        # replaced UDF must never be served from a stale cached program
+        self.generation = 0
 
     def register(self, udf: ScalarUdf) -> None:
         key = udf.name.lower()
         if key in self._udfs:
             log.info("replacing UDF %s", key)
         self._udfs[key] = udf
+        self.generation += 1
 
     def get(self, name: str) -> Optional[ScalarUdf]:
         return self._udfs.get(name.lower())
@@ -67,6 +72,7 @@ class UdfRegistry:
 
     def deregister(self, name: str) -> None:
         self._udfs.pop(name.lower(), None)
+        self.generation += 1
 
 
 # process-global registry (reference GlobalPluginManager singleton)

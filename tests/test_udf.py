@@ -168,3 +168,27 @@ def test_udf_inside_mesh_fused_aggregate(udfs, table):
     finally:
         mesh_ctx.shutdown()
         file_ctx.shutdown()
+
+
+def test_udf_replacement_invalidates_shared_programs(tmp_path):
+    """Re-registering a UDF must not serve a stale compiled closure from
+    the cross-job program cache (exprs_sig carries the registry
+    generation)."""
+    import numpy as np
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.models.schema import INT64
+    from arrow_ballista_tpu.udf import register_udf
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    import pyarrow as pa
+
+    ctx = BallistaContext.local(BallistaConfig({}))
+    table = pa.table({"x": pa.array([1, 2, 3], type=pa.int64())})
+    ctx.register_table("t", table)
+    register_udf("bump2", lambda x: x + 1, INT64, arg_count=1)
+    r1 = ctx.sql("SELECT bump2(x) AS y FROM t").to_pandas()
+    assert list(r1["y"]) == [2, 3, 4]
+    register_udf("bump2", lambda x: x * 10, INT64, arg_count=1)
+    r2 = ctx.sql("SELECT bump2(x) AS y FROM t").to_pandas()
+    assert list(r2["y"]) == [10, 20, 30]
