@@ -165,45 +165,6 @@ def _worker(platform: str) -> None:
           file=sys.stderr)
     del cols, mask, out
 
-    # --- kernel: join shape (sorted-build + searchsorted probe) ---------
-    # evidences the device join path: the build argsort is the one program
-    # family measured to compile slowly on this backend, so compile time is
-    # reported separately from steady-state
-    rngj = np.random.default_rng(11)
-    n_probe, n_build = KERNEL_ROWS // 2, KERNEL_ROWS // 8
-    pk = jax.device_put(jnp.asarray(
-        rngj.integers(0, n_build * 2, n_probe).astype(np.int64)))
-    bk = jax.device_put(jnp.asarray(np.arange(n_build, dtype=np.int64)))
-    pmask_j = jax.device_put(jnp.ones(n_probe, bool))
-    bmask_j = jax.device_put(jnp.ones(n_build, bool))
-    out_cap = n_probe
-
-    @jax.jit
-    def join_step(pk, bk, pmask, bmask):
-        bh_sorted, border, _ = K.build_side_sort([bk], bmask)
-        ph = K.hash64([pk])
-        pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
-        bidx = border[bp]
-        ok = pair_valid & bmask[bidx] & (pk[pi] == bk[bidx])
-        return jnp.sum(ok), total
-
-    t_c = time.perf_counter()
-    jax.block_until_ready(join_step(pk, bk, pmask_j, bmask_j))
-    detail["kernel_join_compile_s"] = round(time.perf_counter() - t_c, 1)
-
-    def _timed_join():
-        out = join_step(pk, bk, pmask_j, bmask_j)
-        jax.block_until_ready(out)
-        np.asarray(out[0])  # scalar D2H: forces true remote completion
-
-    medj = _med(_timed_join)
-    detail["kernel_join_rows_per_sec"] = round(n_probe / medj, 1)
-    detail["kernel_join_ms"] = round(medj * 1000, 3)
-    print(f"[worker] kernel join: {n_probe/medj/1e6:.1f}M probe rows/s "
-          f"({medj*1000:.2f} ms, compile {detail['kernel_join_compile_s']}s)",
-          file=sys.stderr)
-    del pk, bk, pmask_j, bmask_j
-
     # --- engine bench: TPC-H through BallistaContext --------------------
     from arrow_ballista_tpu.client.context import BallistaContext
     from arrow_ballista_tpu.utils.config import BallistaConfig
@@ -336,8 +297,53 @@ def _worker(platform: str) -> None:
         result["error"] = ("q1 not measured: " +
                            engine.get("q1_error", "not in BENCH_QUERIES"))
     # provisional print FIRST: the parent takes the LAST parseable JSON
-    # line, so if the SF10 rider below outlives the attempt budget and the
-    # worker is killed, the SF1 headline already on stdout still wins
+    # line, so if anything below (join microbench compile, SF10 rider)
+    # outlives the attempt budget and the worker is killed, the SF1
+    # headline already on stdout still wins.  The join kernel moved AFTER
+    # this print for exactly that reason: its fresh-shape build argsort
+    # compile once wedged the remote compile helper for 25+ minutes and
+    # starved the whole attempt of engine numbers.
+    print(json.dumps(result), flush=True)
+
+    # --- kernel: join shape (sorted-build + searchsorted probe) ---------
+    # evidences the device join path: the build argsort is the one program
+    # family measured to compile slowly on this backend, so compile time is
+    # reported separately from steady-state
+    rngj = np.random.default_rng(11)
+    n_probe, n_build = KERNEL_ROWS // 2, KERNEL_ROWS // 8
+    pk = jax.device_put(jnp.asarray(
+        rngj.integers(0, n_build * 2, n_probe).astype(np.int64)))
+    bk = jax.device_put(jnp.asarray(np.arange(n_build, dtype=np.int64)))
+    pmask_j = jax.device_put(jnp.ones(n_probe, bool))
+    bmask_j = jax.device_put(jnp.ones(n_build, bool))
+    out_cap = n_probe
+
+    @jax.jit
+    def join_step(pk, bk, pmask, bmask):
+        bh_sorted, border, _ = K.build_side_sort([bk], bmask)
+        ph = K.hash64([pk])
+        pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
+        bidx = border[bp]
+        ok = pair_valid & bmask[bidx] & (pk[pi] == bk[bidx])
+        return jnp.sum(ok), total
+
+    t_c = time.perf_counter()
+    jax.block_until_ready(join_step(pk, bk, pmask_j, bmask_j))
+    detail["kernel_join_compile_s"] = round(time.perf_counter() - t_c, 1)
+
+    def _timed_join():
+        out = join_step(pk, bk, pmask_j, bmask_j)
+        jax.block_until_ready(out)
+        np.asarray(out[0])  # scalar D2H: forces true remote completion
+
+    medj = _med(_timed_join)
+    result["kernel_join_rows_per_sec"] = round(n_probe / medj, 1)
+    result["kernel_join_ms"] = round(medj * 1000, 3)
+    result["kernel_join_compile_s"] = detail["kernel_join_compile_s"]
+    print(f"[worker] kernel join: {n_probe/medj/1e6:.1f}M probe rows/s "
+          f"({medj*1000:.2f} ms, compile {detail['kernel_join_compile_s']}s)",
+          file=sys.stderr)
+    del pk, bk, pmask_j, bmask_j
     print(json.dumps(result), flush=True)
 
     # --- SF10 rider: q1 when the data exists ----------------------------
