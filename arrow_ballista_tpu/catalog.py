@@ -12,6 +12,7 @@ import dataclasses
 from typing import Dict, List, Optional, Sequence
 
 from .models import expr as E
+from .models.ipc import int64_decimal_storage_scale
 from .models.schema import DataType, Field, Schema, decimal
 from .sql.planner import Catalog
 from .utils.errors import PlanningError
@@ -27,6 +28,7 @@ def arrow_schema_to_engine(pa_schema, nullable_by_col=None) -> Schema:
     fields = []
     for f in pa_schema:
         t = f.type
+        meta = f.metadata or {}
         if pa.types.is_dictionary(t):
             t = t.value_type
         if pa.types.is_string(t) or pa.types.is_large_string(t):
@@ -35,6 +37,13 @@ def arrow_schema_to_engine(pa_schema, nullable_by_col=None) -> Schema:
             dt = DataType("date32")
         elif pa.types.is_decimal(t):
             dt = decimal(t.scale)
+        elif (pa.types.is_int64(t)
+              and int64_decimal_storage_scale(f) is not None):
+            # int64-stored decimals (unscaled values + metadata scale): the
+            # physical-storage convention shared with the engine's shuffle
+            # IPC files and the benchmark converter (benchmarks/tpch.py
+            # decimal_to_int64_storage)
+            dt = decimal(int64_decimal_storage_scale(f))
         elif pa.types.is_int64(t) or pa.types.is_uint64(t):
             dt = DataType("int64")
         elif pa.types.is_integer(t):

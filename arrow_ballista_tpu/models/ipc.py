@@ -40,6 +40,21 @@ def _physical_arrow_schema(schema: Schema):
     return pa.schema(fields)
 
 
+def int64_decimal_storage_scale(field) -> "Optional[int]":
+    """Storage scale of an int64-stored decimal arrow field (the
+    ``{kind: decimal, scale}`` field-metadata convention this module writes
+    and benchmarks/tpch.py decimal_to_int64_storage shares); None when the
+    field is not an int-backed decimal.  The single parser for the
+    convention — catalog inference, scan conversion, stats pruning, and the
+    test oracle all route through here."""
+    import pyarrow as pa
+
+    meta = field.metadata or {}
+    if meta.get(b"kind") == b"decimal" and pa.types.is_integer(field.type):
+        return int(meta.get(b"scale", b"0"))
+    return None
+
+
 def physical_table_from_numpy(schema: Schema, data: Dict[str, np.ndarray],
                               dicts: Dict[str, np.ndarray]):
     """Compact host numpy columns -> physical arrow table (no decoding).

@@ -361,6 +361,42 @@ def table_to_physical(table, schema: Schema):
                 a = pc.fill_null(a, int(f.dtype.null_sentinel))
             cols[f.name] = a.to_numpy(zero_copy_only=False).astype(np.int32)
         elif f.dtype.is_decimal:
+            ftype = table.schema.field(f.name)
+            if pa.types.is_integer(ftype.type):
+                # int64-stored decimal (unscaled values; metadata carries
+                # the storage scale — benchmarks/tpch.py
+                # decimal_to_int64_storage / models/ipc.py convention):
+                # already the engine's physical representation, up to a
+                # power-of-ten rescale when schemas disagree
+                from ..models.ipc import int64_decimal_storage_scale
+
+                sscale = int64_decimal_storage_scale(ftype) or 0
+                nulls = None
+                a = arr
+                if a.null_count:
+                    if isinstance(a, pa.ChunkedArray):
+                        a = a.combine_chunks()
+                    nulls = pc.is_null(a).to_numpy(zero_copy_only=False)
+                    a = pc.fill_null(a, 0)
+                vals = a.cast(pa.int64()).to_numpy(zero_copy_only=False)
+                if sscale != f.dtype.scale:
+                    if f.dtype.scale > sscale:
+                        factor = np.int64(10 ** (f.dtype.scale - sscale))
+                        # int64 multiplication wraps silently: keep the
+                        # overflow guard the float path had
+                        if len(vals) and np.abs(vals).max() > (2**63 - 1) // int(factor):
+                            raise ExecutionError(
+                                f"decimal column {f.name} exceeds int64 "
+                                "range after rescale")
+                        vals = vals * factor
+                    else:
+                        vals = vals // np.int64(10 ** (sscale - f.dtype.scale))
+                vals = vals.astype(np.int64, copy=False)
+                if nulls is not None:
+                    vals = vals.copy()
+                    vals[nulls] = np.int64(f.dtype.null_sentinel)
+                cols[f.name] = vals
+                continue
             # NULLs can't ride the float64 conversion (the int64-min
             # sentinel exceeds the 2^52 exact range): remember them, fill
             # with 0 for conversion, then stamp the sentinel back in
@@ -530,10 +566,15 @@ def _simple_predicates(filters: Sequence[E.Expr], schema: Schema):
     return out
 
 
-def _stats_refute(stats, op: str, value, dt: DataType) -> bool:
+def _stats_refute(stats, op: str, value, dt: DataType,
+                  stats_scale: Optional[int] = None) -> bool:
     """True iff row-group stats prove no row can satisfy ``col op value``.
     ``value`` is in the column's physical domain (see _simple_predicates);
-    stats min/max are converted into that same domain before comparing."""
+    stats min/max are converted into that same domain before comparing.
+    ``stats_scale``: for int64-stored decimal columns, the storage scale —
+    integer stats are then already scaled by 10^stats_scale and must NOT
+    be scaled again (double-scaling would wrongly refute matching row
+    groups)."""
     if stats is None or not stats.has_min_max:
         return False
     lo, hi = stats.min, stats.max
@@ -555,6 +596,13 @@ def _stats_refute(stats, op: str, value, dt: DataType) -> bool:
                 if isinstance(x, datetime.date):
                     return (x - datetime.date(1970, 1, 1)).days
                 if dt.is_decimal:
+                    if stats_scale is not None and isinstance(x, int):
+                        # python ints: exact; floor division matches the
+                        # row conversion's // so pruning can never disagree
+                        # with execution
+                        if dt.scale >= stats_scale:
+                            return x * (10 ** (dt.scale - stats_scale))
+                        return x // (10 ** (stats_scale - dt.scale))
                     if isinstance(x, pydec.Decimal):
                         return int(x.scaleb(dt.scale))  # exact
                     return float(x) * (10 ** dt.scale)
@@ -603,13 +651,26 @@ class ParquetScanExec(ScanExec):
             raise ExecutionError(f"no parquet files found in {paths}")
         self.files = files
 
+        import pyarrow as pa
+
         preds = _simple_predicates(self.filters, self.table_schema)
         units: List[Tuple[str, int, int]] = []  # (file, row_group, rows)
         self.pruned_row_groups = 0
         for f in files:
-            meta = obs.parquet_file(f).metadata
+            pf = obs.parquet_file(f)
+            meta = pf.metadata
             name_to_idx = {meta.schema.column(i).name: i
                            for i in range(meta.num_columns)}
+            # int64-stored decimal columns: their integer stats are in the
+            # storage-scaled domain (metadata convention, see
+            # table_to_physical)
+            from ..models.ipc import int64_decimal_storage_scale
+
+            stats_scales = {}
+            for af in pf.schema_arrow:
+                s = int64_decimal_storage_scale(af)
+                if s is not None:
+                    stats_scales[af.name] = s
             for rg in range(meta.num_row_groups):
                 g = meta.row_group(rg)
                 refuted = False
@@ -617,7 +678,8 @@ class ParquetScanExec(ScanExec):
                     ci = name_to_idx.get(col)
                     if ci is None:
                         continue
-                    if _stats_refute(g.column(ci).statistics, op, v, dt):
+                    if _stats_refute(g.column(ci).statistics, op, v, dt,
+                                     stats_scale=stats_scales.get(col)):
                         refuted = True
                         break
                 if refuted:

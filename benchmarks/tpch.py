@@ -18,6 +18,53 @@ import time
 from typing import Dict, List
 
 
+def decimal_to_int64_storage(table):
+    """Rewrite decimal columns as int64 UNSCALED values with field metadata
+    ``{kind: decimal, scale}`` — the same physical convention as the
+    engine's shuffle IPC files (models/ipc.py).  Parquet decodes int64
+    pages ~3x faster than decimal128's fixed-len-byte-array (measured
+    0.08 s vs 0.25 s per 6M-value column), and the engine's device
+    representation IS scaled int64, so the scan's decimal conversion
+    disappears entirely.  Readers without the metadata convention still
+    see exact integers (units of 10^-scale)."""
+    import pyarrow as pa
+
+    fields, arrays = [], []
+    for f in table.schema:
+        col = table.column(f.name)
+        if pa.types.is_decimal(f.type):
+            import numpy as np
+
+            scale = f.type.scale
+            # decimal128 -> unscaled int64, exactly: the storage IS a
+            # 16-byte little-endian two's-complement integer; take the low
+            # word and require the high word to be its sign extension
+            # (TPC-H values fit int64 by orders of magnitude)
+            combined = col.combine_chunks() if isinstance(
+                col, pa.ChunkedArray) else col
+            raw = np.frombuffer(combined.buffers()[1], dtype="<i8")
+            raw = raw[combined.offset * 2:(combined.offset + len(combined)) * 2]
+            pairs = raw.reshape(-1, 2)
+            lo, hi = pairs[:, 0], pairs[:, 1]
+            nulls = combined.is_null().to_numpy(zero_copy_only=False) \
+                if combined.null_count else None
+            # null slots' data bytes are unspecified — only valid slots
+            # must fit int64
+            valid = slice(None) if nulls is None else ~nulls
+            if not np.array_equal(hi[valid], lo[valid] >> 63):
+                raise ValueError(
+                    f"decimal column {f.name} exceeds int64 unscaled range")
+            ints = pa.array(lo, type=pa.int64(), mask=nulls)
+            arrays.append(ints)
+            fields.append(pa.field(
+                f.name, pa.int64(), nullable=f.nullable,
+                metadata={b"kind": b"decimal", b"scale": str(scale).encode()}))
+        else:
+            arrays.append(col)
+            fields.append(f)
+    return pa.table(arrays, schema=pa.schema(fields))
+
+
 def cmd_convert(args) -> None:
     import pyarrow.parquet as pq
 
@@ -26,8 +73,15 @@ def cmd_convert(args) -> None:
     os.makedirs(args.output, exist_ok=True)
     t0 = time.time()
     tables = generate_tables(args.scale, seed=args.seed)
+    # a stale oracle built from previous files must not survive ANY
+    # regeneration (new seed/scale/encoding alike)
+    oracle = os.path.join(args.output, "oracle.sqlite")
+    if os.path.exists(oracle):
+        os.remove(oracle)
     for name, table in tables.items():
         if args.format == "parquet":
+            if args.decimal_storage == "int64":
+                table = decimal_to_int64_storage(table)
             path = os.path.join(args.output, f"{name}.parquet")
             # bounded row groups give the row-group-granular ParquetScanExec
             # its scan parallelism even for single-file tables
@@ -173,6 +227,8 @@ def main(argv=None) -> None:
     c.add_argument("--format", choices=["parquet", "csv"], default="parquet")
     c.add_argument("--compression", default="zstd")
     c.add_argument("--row-group-size", type=int, default=1 << 19)
+    c.add_argument("--decimal-storage", choices=["int64", "decimal128"],
+                   default="int64")
 
     def common(p):
         p.add_argument("--path", required=True)

@@ -66,8 +66,15 @@ def _arrow_to_oracle_df(table) -> pd.DataFrame:
     cols = {}
     for name, col in zip(table.column_names, table.columns):
         t = col.type
+        meta = table.schema.field(name).metadata or {}
         if pa.types.is_decimal(t):
             cols[name] = np.asarray(col.cast(pa.float64()))
+        elif pa.types.is_integer(t) and meta.get(b"kind") == b"decimal":
+            # int64-stored decimal (unscaled + metadata scale; the
+            # benchmark converter's physical layout) -> float value domain
+            scale = int(meta.get(b"scale", b"0"))
+            cols[name] = np.asarray(col.cast(pa.int64())).astype(
+                np.float64) / (10 ** scale)
         elif pa.types.is_date32(t):
             cols[name] = np.asarray(col.cast(pa.int32()))
         else:
