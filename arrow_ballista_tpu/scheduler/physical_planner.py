@@ -413,7 +413,16 @@ class PhysicalPlanner:
                 return self._estimate_rows(node.left)
             if node.join_type == "full":
                 return self._estimate_rows(node.left) + self._estimate_rows(node.right)
-            return max(self._estimate_rows(node.left), self._estimate_rows(node.right))
+            # inner/left equi-joins in analytic schemas are key-FK: the
+            # output is bounded by the fact side and the DIMENSION side is
+            # what downstream broadcast decisions care about, so min() is
+            # the closer estimate.  max() made q18's (orders-semi x
+            # customer) build look like 1.5M rows (> broadcast threshold)
+            # and forced a 60M-row lineitem shuffle at SF10; its true size
+            # is ~500 rows.  A genuine fan-out join under-estimates here —
+            # the cost is an oversized broadcast build (materialized once,
+            # build-cached), not wrong results.
+            return min(self._estimate_rows(node.left), self._estimate_rows(node.right))
         if isinstance(node, L.CrossJoin):
             return self._estimate_rows(node.left) * self._estimate_rows(node.right)
         return 10_000_000
