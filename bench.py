@@ -365,6 +365,12 @@ def _worker(platform: str) -> None:
                     sf10["q1_rows_per_sec"] = round(rows10 / q1_10, 1)
                     sf10["vs_baseline_sf10"] = round(
                         rows10 / q1_10 / BASELINE_ROWS_PER_S, 4)
+                    # the reference baseline IS SF10 (README.md:52-60):
+                    # when the like-for-like datapoint exists it becomes
+                    # the headline; the SF1 numbers stay in `engine`
+                    result["metric"] = "tpch_q1_sf10_engine_rows_per_sec"
+                    result["value"] = sf10["q1_rows_per_sec"]
+                    result["vs_baseline"] = sf10["vs_baseline_sf10"]
                 result["engine_sf10"] = sf10
             finally:
                 ctx10.shutdown()
