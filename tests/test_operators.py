@@ -230,3 +230,48 @@ def test_count_literal_operand():
     # literal group keys broadcast too
     out2 = ctx.sql("select 7 as k, count(*) as n from t group by k").to_pandas()
     assert out2.k.tolist() == [7] and out2.n.tolist() == [30]
+
+
+def test_partial_agg_passthrough_activates_for_siblings():
+    """The adaptive partial-agg skip: once a task observes near-zero
+    reduction on a large input, sibling tasks emit per-row states.  The
+    probe is deferred until the result's count is host-known (the packed
+    fetch normally sets it); resolution happens at the metrics snapshot."""
+    import numpy as np
+
+    from arrow_ballista_tpu.models.schema import Field, INT64, Schema
+    from arrow_ballista_tpu.ops.operators import HashAggregateExec
+    from arrow_ballista_tpu.ops.physical import MemoryScanExec, TaskContext
+    from arrow_ballista_tpu.models import expr as E
+    import pyarrow as pa
+
+    n = 1 << 18  # 2 partitions x 2^17 (the large-input threshold each)
+    tbl = pa.table({"k": pa.array(np.arange(n), type=pa.int64()),
+                    "v": pa.array(np.ones(n, dtype=np.int64))})
+    scan = MemoryScanExec(Schema([Field("k", INT64), Field("v", INT64)]),
+                          tbl, partitions=2)
+    agg = HashAggregateExec.partial(scan, [(E.Column("k"), "k")],
+                                    [("sum", E.Column("v"), "s")]) \
+        if hasattr(HashAggregateExec, "partial") else None
+    if agg is None:
+        from arrow_ballista_tpu.ops.operators import AggSpec
+
+        agg = HashAggregateExec(scan, [(E.Column("k"), "k")],
+                                [AggSpec("sum", E.Column("v"), "s")],
+                                mode="partial")
+    ctx = TaskContext()
+    out0 = agg.execute(0, ctx)
+    # resolve the deferred probe: materialize the count, then snapshot
+    for b in out0:
+        b.compacted_numpy()
+    agg.metrics().to_dict()
+    assert getattr(agg, "_passthrough", False), \
+        "all-distinct keys on a 2^17-row input must trigger passthrough"
+    out1 = agg.execute(1, ctx)
+    snap = agg.metrics().to_dict()
+    assert snap.get("passthrough_partials", 0) >= 1
+    # passthrough partials still merge correctly at the final
+    from arrow_ballista_tpu.models.batch import concat_batches
+
+    rows = sum(b.num_rows for b in out0 + out1)
+    assert rows == n
