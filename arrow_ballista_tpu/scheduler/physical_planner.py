@@ -404,25 +404,41 @@ class PhysicalPlanner:
                 # shuffled 60M lineitem rows at SF10).  The output is
                 # bounded by the LEFT side only (many left rows can match
                 # one right key), so the right estimate is not a valid
-                # cap; 5% match selectivity is the working guess for
-                # IN/EXISTS over filtered subqueries.  Worst case of an
-                # under-estimate is a large broadcast build — materialized
-                # once (build cache) and streamed against, not fatal.
-                return max(1, self._estimate_rows(node.left) // 20)
+                # cap; 1% match selectivity is the working guess for
+                # IN/EXISTS over filtered/aggregated subqueries (q18's
+                # HAVING subquery keeps 673 of 15M orders — 1/22000; the
+                # earlier 5% guess left the estimate above the broadcast
+                # threshold and forced a 60M-row shuffle).  Worst case of
+                # an under-estimate is a large broadcast build —
+                # materialized once (build cache) and streamed against,
+                # not fatal.
+                return max(1, self._estimate_rows(node.left) // 100)
             if node.join_type == "anti":
                 return self._estimate_rows(node.left)
             if node.join_type == "full":
                 return self._estimate_rows(node.left) + self._estimate_rows(node.right)
             # inner/left equi-joins in analytic schemas are key-FK: the
-            # output is bounded by the fact side and the DIMENSION side is
-            # what downstream broadcast decisions care about, so min() is
-            # the closer estimate.  max() made q18's (orders-semi x
-            # customer) build look like 1.5M rows (> broadcast threshold)
-            # and forced a 60M-row lineitem shuffle at SF10; its true size
-            # is ~500 rows.  A genuine fan-out join under-estimates here —
-            # the cost is an oversized broadcast build (materialized once,
-            # build-cached), not wrong results.
-            return min(self._estimate_rows(node.left), self._estimate_rows(node.right))
+            # output is bounded by the fact side.  Which side that is can't
+            # be known statically, so trust the SMALL side's estimate only
+            # when it is decisively small (a quarter of the broadcast
+            # threshold — semi/aggregate-derived inputs land here) and fall
+            # back to max() otherwise.  Plain min() made q3's
+            # (customer x orders) subtree look like 375k rows when the join
+            # truly produces 1.46M at SF10, flipping a rightly-partitioned
+            # join to a 1.5M-row broadcast build (+22% wall); max() alone
+            # made q18's (orders-semi x customer) look like 1.5M rows when
+            # the truth is ~500, forcing a 60M-row lineitem shuffle.
+            left_e = self._estimate_rows(node.left)
+            right_e = self._estimate_rows(node.right)
+            decisive = self.config.get(BROADCAST_THRESHOLD) // 4
+            est = max(left_e, right_e)
+            if min(left_e, right_e) <= decisive:
+                est = min(left_e, right_e)
+            if node.join_type == "left":
+                # every left row is emitted at least once: the decisive-
+                # small shortcut is only valid for inner joins
+                est = max(est, left_e)
+            return est
         if isinstance(node, L.CrossJoin):
             return self._estimate_rows(node.left) * self._estimate_rows(node.right)
         return 10_000_000
