@@ -468,21 +468,44 @@ class ScanExec(ExecutionPlan):
     def _read_partition(self, partition: int):  # -> pyarrow table
         raise NotImplementedError
 
+    def _cache_key(self, partition: int, capacity: int):
+        """Key for the device-resident scan cache, or None when this scan
+        can't be cached (volatile source).  Must embed source versioning
+        (file mtime/size) so stale data can never be served."""
+        return None
+
     def output_partition_count(self) -> int:
         raise NotImplementedError
+
+    def _produce_batches(self, partition: int, ctx: TaskContext,
+                         capacity: int) -> List[ColumnBatch]:
+        """Read + convert one partition to device batches (pre-filter)."""
+        with self.metrics().timer("scan_read_time"):
+            table = self._read_partition(partition)
+        ctx.check_cancelled()
+        with self.metrics().timer("scan_convert_time"):
+            return table_to_batches(table, self._schema, capacity)
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         import jax
         import jax.numpy as jnp
 
-        ctx.check_cancelled()
-        with self.metrics().timer("scan_read_time"):
-            table = self._read_partition(partition)
+        from ..utils import table_cache
+        from ..utils.config import SCAN_CACHE_BYTES
+
         ctx.check_cancelled()
         capacity = ctx.config.batch_size
-        with self.metrics().timer("scan_convert_time"):
-            batches = table_to_batches(table, self._schema, capacity)
-        self.metrics().add("output_rows", table.num_rows)
+        budget = table_cache.resolve_budget(ctx.config.get(SCAN_CACHE_BYTES))
+        key = self._cache_key(partition, capacity) if budget else None
+        batches = table_cache.CACHE.get(key) if key is not None else None
+        if batches is None:
+            batches = self._produce_batches(partition, ctx, capacity)
+            if key is not None:
+                table_cache.CACHE.set_budget(budget)
+                table_cache.CACHE.put(key, batches)
+        else:
+            self.metrics().add("scan_cache_hits", 1)
+        self.metrics().add("output_rows", sum(b.num_rows for b in batches))
         if not self.filters:
             return batches
         # compile the conjunction once per (schema, filters) — shared
@@ -706,12 +729,29 @@ class ParquetScanExec(ScanExec):
     def output_partition_count(self) -> int:
         return len(self.groups)
 
-    def _read_partition(self, partition: int):
+    def _cache_key(self, partition: int, capacity: int):
+        """(file, row-group, mtime, size) units + projection + capacity.
+        Local files embed stat() versioning; object-store URLs (no local
+        stat) skip caching rather than risk staleness."""
+        units = self.groups[partition]
+        if not units:
+            return None
+        import os as _os
+
+        versioned = []
+        for f, rg, _rows in units:
+            try:
+                st = _os.stat(f)
+            except OSError:
+                return None
+            versioned.append((f, rg, st.st_mtime_ns, st.st_size))
+        return ("parquet", tuple(versioned), tuple(self._schema.names()), capacity)
+
+    def _read_units(self, units):
         import pyarrow as pa
 
         from ..utils import object_store as obs
 
-        units = self.groups[partition]
         if not units:
             return self._schema.to_arrow_empty()
         by_file: Dict[str, List[int]] = {}
@@ -736,6 +776,55 @@ class ParquetScanExec(ScanExec):
                     kv[0], sorted(kv[1]), cols, read_dictionary=rd),
                 by_file.items()))
         return pa.concat_tables(tables)
+
+    def _read_partition(self, partition: int):
+        return self._read_units(self.groups[partition])
+
+    def _produce_batches(self, partition: int, ctx: TaskContext,
+                         capacity: int) -> List[ColumnBatch]:
+        """Double-buffered cold path: read chunk i+1 on a background thread
+        while chunk i converts and transfers to the device, so a cold scan
+        costs ~max(read, convert+H2D) instead of their sum (the streaming
+        shape of the reference's shuffle-writer pull loop,
+        reference shuffle_writer.rs:214-252, applied to the scan).
+
+        Chunks group row-group units to >= ``capacity`` rows, so the device
+        batch shapes match the unpipelined path and the jit cache stays
+        small.  Per-chunk string dictionaries can differ across chunks;
+        downstream consumers unify on demand (models/batch.py
+        _unify_string_dicts) — same contract as mixed scan partitions."""
+        units = self.groups[partition]
+        chunks: List[List[Tuple[str, int, int]]] = []
+        cur, cur_rows = [], 0
+        for u in sorted(units):
+            cur.append(u)
+            cur_rows += u[2]
+            if cur_rows >= capacity:
+                chunks.append(cur)
+                cur, cur_rows = [], 0
+        if cur:
+            chunks.append(cur)
+        if len(chunks) <= 1:
+            return super()._produce_batches(partition, ctx, capacity)
+        from concurrent.futures import ThreadPoolExecutor
+
+        batches: List[ColumnBatch] = []
+        pool = ThreadPoolExecutor(max_workers=1)
+        try:
+            fut = pool.submit(self._read_units, chunks[0])
+            for i in range(len(chunks)):
+                ctx.check_cancelled()
+                # scan_read_time records time BLOCKED on IO; overlapped
+                # read time hides behind the previous chunk's convert+H2D
+                with self.metrics().timer("scan_read_time"):
+                    table = fut.result()
+                if i + 1 < len(chunks):
+                    fut = pool.submit(self._read_units, chunks[i + 1])
+                with self.metrics().timer("scan_convert_time"):
+                    batches.extend(table_to_batches(table, self._schema, capacity))
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+        return batches
 
     def row_count_estimate(self) -> int:
         return self._total_rows

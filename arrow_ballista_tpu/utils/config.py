@@ -31,6 +31,7 @@ MESH_BROADCAST_ROWS = "ballista.shuffle.mesh.broadcast_rows"  # build side <= th
 TASK_SLOTS = "ballista.executor.task_slots"
 BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides smaller skip the shuffle
 JOB_TIMEOUT_S = "ballista.job.timeout.seconds"  # client-side wait_for_job deadline
+SCAN_CACHE_BYTES = "ballista.scan.cache.bytes"  # HBM-resident scan cache budget ('auto' | bytes | 0=off)
 
 
 @dataclasses.dataclass
@@ -91,6 +92,9 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "broadcast join build sides with fewer estimated rows"),
         ConfigEntry(JOB_TIMEOUT_S, 3600, int,
                     "seconds a client waits for a submitted job before giving up"),
+        ConfigEntry(SCAN_CACHE_BYTES, "auto", str,
+                    "device-resident scan cache budget: 'auto' (6 GiB), "
+                    "a byte count, or 0 to disable; see utils/table_cache.py"),
     ]
 }
 

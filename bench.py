@@ -2,20 +2,38 @@
 
 Two layers, both reported:
 
-- **engine**: TPC-H q1 + q6 at SF1 run END-TO-END through
+- **engine**: TPC-H q1/q6/q3/q5/q18 at SF1 run END-TO-END through
   ``BallistaContext.standalone`` — parquet scan -> device pipeline ->
   shuffle -> final aggregate -> collect.  The headline metric is engine
   rows/s on q1 (lineitem rows / wall-clock), matching how the reference's
   README chart is computed (reference README.md:52-60: q1 SF10 in ~3.1 s on
-  a 24-core executor => ~19.35M rows/s, see BASELINE.md).
+  a 24-core executor => ~19.35M rows/s, see BASELINE.md).  When SF10 data
+  exists the like-for-like SF10 numbers become the headline.
 - **kernel**: the fused q1 pipeline (filter -> derived columns -> grouped
   aggregate) over HBM-resident arrays, isolating device throughput from IO.
 
-Robustness (round-1 failure mode: the experimental "axon" TPU plugin can
-fail or hang at backend init): the parent process never imports jax.  It
-launches a worker subprocess per attempt — TPU with retries, then a
-CPU-forced fallback — with a hard timeout, and re-prints the worker's final
-JSON line.  Exactly ONE JSON line lands on stdout:
+Reliability design (rounds 1-4 failure mode: the experimental "axon" TPU
+plugin's tunnel can hang backend init for 900s+, and serial tpu-then-cpu
+attempts burned the whole budget before any number landed):
+
+- The parent never imports jax.  It runs TWO workers CONCURRENTLY:
+  a CPU-forced worker (axon plugin disabled at the env level — it can
+  never hang) and a TPU worker under an init watchdog.
+- TPU backend init is supervised: if the "backend up" marker doesn't
+  appear within BENCH_INIT_TIMEOUT the attempt is killed and retried
+  with backoff while the TPU budget lasts.  A worker that initialized
+  once holds its lease for the whole run (warm lease reuse).
+- Workers print a RESULT JSON line after every milestone (backend up,
+  platform constants, each query, each transport); the parent re-prints
+  the best merged JSON line every time one improves.  Even a truncated
+  run leaves TPU evidence on stdout and in .bench_logs/latest.json.
+- The TPU worker waits at a gate before its host-heavy engine phase
+  until the CPU worker finishes (this box has ONE core; running both
+  engine benches concurrently would corrupt the CPU numbers).  Device-
+  bound phases (platform constants, kernel microbench) run before the
+  gate, so TPU evidence lands early.
+
+The FINAL stdout line is the merged result:
   {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N, ...}
 """
 from __future__ import annotations
@@ -30,11 +48,14 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_ROWS_PER_S = 59_986_052 / 3.1  # reference q1 SF10 wall-clock
 SCALE = float(os.environ.get("BENCH_SCALE", "1"))
-QUERIES = os.environ.get("BENCH_QUERIES", "1,6")
+QUERIES = os.environ.get("BENCH_QUERIES", "1,6,3,5,18")
+MESH_QUERIES = os.environ.get("BENCH_MESH_QUERIES", "1,6,3")
+SF10_QUERIES = os.environ.get("BENCH_SF10_QUERIES", "1,3,5,18")
 DATA_DIR = os.environ.get(
     "BENCH_DATA", os.path.join(REPO, ".bench_data", f"tpch-sf{SCALE:g}")
 )
 KERNEL_ROWS = int(os.environ.get("BENCH_KERNEL_ROWS", str(8_000_000)))
+LOG_DIR = os.path.join(REPO, ".bench_logs")
 
 
 def _cpu_env(n_devices: int = 1) -> dict:
@@ -65,7 +86,7 @@ def ensure_data() -> None:
 # --------------------------------------------------------------------------
 
 
-def _worker(platform: str) -> None:
+def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
     import numpy as np
     import jax
 
@@ -78,7 +99,21 @@ def _worker(platform: str) -> None:
     dev = jax.devices()[0]
     print(f"[worker] backend up: {dev.platform} ({dev.device_kind})", file=sys.stderr)
 
-    detail: dict = {"platform": dev.platform, "device": str(dev.device_kind)}
+    result: dict = {
+        "metric": f"tpch_q1_sf{SCALE:g}_engine_rows_per_sec",
+        "value": 0.0, "unit": "rows/s", "vs_baseline": 0.0,
+        "partial": "backend-up",
+        "platform": dev.platform, "device": str(dev.device_kind),
+    }
+
+    def emit(stage: str) -> None:
+        """Milestone emission: every print is a complete, parseable result —
+        the parent takes the newest line, so a killed worker still leaves
+        everything measured so far."""
+        result["partial"] = stage
+        print(json.dumps(result), flush=True)
+
+    emit("backend-up")
 
     # --- platform characterization: the constants needed to interpret the
     # engine numbers (the device may sit across a network tunnel where
@@ -106,13 +141,14 @@ def _worker(platform: str) -> None:
     jax.block_until_ready(d_bigs)
     it = iter(d_bigs)
     d2h = _med(lambda: np.asarray(next(it)), 3)
-    detail["platform_rtt_ms"] = round(rtt * 1000, 2)
-    detail["platform_h2d_gbps"] = round(big.nbytes / h2d / 1e9, 2)
-    detail["platform_d2h_gbps"] = round(big.nbytes / d2h / 1e9, 2)
+    result["platform_rtt_ms"] = round(rtt * 1000, 2)
+    result["platform_h2d_gbps"] = round(big.nbytes / h2d / 1e9, 2)
+    result["platform_d2h_gbps"] = round(big.nbytes / d2h / 1e9, 2)
     print(f"[worker] platform: rtt {rtt*1000:.2f} ms, "
           f"h2d {big.nbytes/h2d/1e9:.2f} GB/s, d2h {big.nbytes/d2h/1e9:.2f} GB/s",
           file=sys.stderr)
     del d_bigs, big
+    emit("platform-constants")
 
     # --- kernel microbench ---------------------------------------------
     sys.path.insert(0, REPO)
@@ -138,7 +174,7 @@ def _worker(platform: str) -> None:
     t_c = time.perf_counter()
     out = step(cols, mask)  # compile + warmup
     jax.block_until_ready(out)
-    detail["kernel_q1_compile_s"] = round(time.perf_counter() - t_c, 1)
+    result["kernel_q1_compile_s"] = round(time.perf_counter() - t_c, 1)
     # block on the WHOLE output tree AND force a 1-element host read: an
     # experimental remote backend's block_until_ready may not await remote
     # completion, and a D2H read cannot lie (its cost is one rtt, reported
@@ -157,13 +193,25 @@ def _worker(platform: str) -> None:
     # columns alone — if this exceeds the chip's spec the measurement is
     # wrong, not the kernel fast
     in_bytes = sum(v.nbytes for v in cols.values()) + mask.nbytes
-    detail["kernel_q1_rows_per_sec"] = round(kernel_rows_s, 1)
-    detail["kernel_q1_ms"] = round(med * 1000, 3)
-    detail["kernel_q1_gbps"] = round(in_bytes / med / 1e9, 1)
+    result["kernel_q1_rows_per_sec"] = round(kernel_rows_s, 1)
+    result["kernel_q1_ms"] = round(med * 1000, 3)
+    result["kernel_q1_gbps"] = round(in_bytes / med / 1e9, 1)
     print(f"[worker] kernel q1: {kernel_rows_s/1e6:.1f}M rows/s "
           f"({med*1000:.2f} ms, {in_bytes/med/1e9:.0f} GB/s implied)",
           file=sys.stderr)
     del cols, mask, out
+    emit("kernel-q1")
+
+    # --- gate: wait for the CPU worker before the host-heavy engine phase
+    # (one core; concurrent engine benches would corrupt both).  The lease
+    # stays warm while waiting — that is the point.
+    if gate_file:
+        gate_wait = float(os.environ.get("BENCH_GATE_WAIT", "2400"))
+        t_g = time.time()
+        while not os.path.exists(gate_file) and time.time() - t_g < gate_wait:
+            time.sleep(5)
+        print(f"[worker] gate cleared after {time.time()-t_g:.0f}s",
+              file=sys.stderr)
 
     # --- engine bench: TPC-H through BallistaContext --------------------
     from arrow_ballista_tpu.client.context import BallistaContext
@@ -203,7 +251,7 @@ def _worker(platform: str) -> None:
                                      concurrent_tasks=4)
     register_tables(ctx, DATA_DIR)
     lineitem_rows = ctx.catalog.provider("lineitem").row_count()
-    detail["lineitem_rows"] = lineitem_rows
+    result["lineitem_rows"] = lineitem_rows
 
     def _job_metrics(ctx):
         """Aggregate per-operator metrics of the most recent job, per stage —
@@ -237,121 +285,120 @@ def _worker(platform: str) -> None:
         except Exception as e:  # noqa: BLE001 — profiling must never kill a bench
             return {"error": str(e)}
 
-    def run_queries(ctx, queries, label):
-        out = {}
+    def _headline_from_q1(engine, rows, sf_label):
+        q1_s = engine.get("q1_ms", 0.0) / 1000.0
+        if q1_s:
+            value = rows / q1_s
+            result["metric"] = f"tpch_q1_{sf_label}_engine_rows_per_sec"
+            result["value"] = round(value, 1)
+            result["vs_baseline"] = round(value / BASELINE_ROWS_PER_S, 4)
+
+    def run_queries(ctx, queries, label, dest, iters=2, rows=None, sf_label=None):
         for q in queries:
+            if time.time() > deadline - 60:
+                dest[f"q{q}_skipped"] = "deadline"
+                print(f"[worker] {label} q{q} skipped: deadline", file=sys.stderr)
+                continue
             per = []
             try:
-                for it in range(2):
+                for it in range(iters):
                     t0 = time.perf_counter()
                     res = ctx.sql(SQL[q]).collect()
                     nrows = sum(b.num_rows for b in res)
                     per.append(time.perf_counter() - t0)
                     print(f"[worker] {label} q{q} iter{it}: {per[-1]*1000:.0f} ms "
                           f"({nrows} rows)", file=sys.stderr)
-                out[f"q{q}_ms"] = round(min(per) * 1000, 1)
+                dest[f"q{q}_ms"] = round(min(per) * 1000, 1)
                 print(f"[worker] {label} q{q} metrics: "
                       f"{json.dumps(_job_metrics(ctx))}", file=sys.stderr)
             except Exception as e:  # noqa: BLE001 — record, keep benching
-                out[f"q{q}_error"] = f"{type(e).__name__}: {e}"
+                dest[f"q{q}_error"] = f"{type(e).__name__}: {e}"
                 print(f"[worker] {label} q{q} FAILED: {e}", file=sys.stderr)
-        return out
+            if rows is not None and sf_label:
+                _headline_from_q1(dest, rows, sf_label)
+            emit(f"{label}-q{q}")
+        return dest
 
-    # q3 rides along on BOTH transports so the join paths are comparable
-    # (round-2 gap: the mesh join had zero perf evidence; a mesh-only q3
-    # number answers nothing without the file-path number next to it)
-    queries = [int(x) for x in QUERIES.split(",")]
-    if 3 not in queries:
-        queries = queries + [3]
-    engine = run_queries(ctx, queries, "file")
+    queries = [int(x) for x in QUERIES.split(",") if x.strip()]
+    engine = result["engine"] = {}
+    run_queries(ctx, queries, "file", engine, rows=lineitem_rows,
+                sf_label=f"sf{SCALE:g}")
     ctx.shutdown()
-    detail["engine"] = engine
-
-    # --- mesh path: same queries + a join shape, ICI all_to_all shuffle ---
-    # guarded end to end: a mesh-path failure must never discard the file
-    # numbers already measured above
-    try:
-        mesh_config = BallistaConfig(
-            {**base_config, "ballista.shuffle.mesh": "true"})
-        mctx = BallistaContext.standalone(mesh_config, concurrent_tasks=4)
-        try:
-            register_tables(mctx, DATA_DIR)
-            detail["engine_mesh"] = run_queries(mctx, queries, "mesh")
-        finally:
-            mctx.shutdown()
-    except Exception as e:  # noqa: BLE001 — record, keep the file numbers
-        detail["engine_mesh"] = {"error": f"{type(e).__name__}: {e}"}
-        print(f"[worker] mesh bench failed: {e}", file=sys.stderr)
-
-    q1_s = engine.get("q1_ms", 0.0) / 1000.0
-    value = lineitem_rows / q1_s if q1_s else 0.0
-    result = {
-        "metric": f"tpch_q1_sf{SCALE:g}_engine_rows_per_sec",
-        "value": round(value, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(value / BASELINE_ROWS_PER_S, 4),
-        **detail,
-    }
-    if not q1_s:
+    if not engine.get("q1_ms"):
         # a 0.0 headline must be distinguishable from a measured zero
         result["error"] = ("q1 not measured: " +
                            engine.get("q1_error", "not in BENCH_QUERIES"))
-    # provisional print FIRST: the parent takes the LAST parseable JSON
-    # line, so if anything below (join microbench compile, SF10 rider)
-    # outlives the attempt budget and the worker is killed, the SF1
-    # headline already on stdout still wins.  The join kernel moved AFTER
-    # this print for exactly that reason: its fresh-shape build argsort
-    # compile once wedged the remote compile helper for 25+ minutes and
-    # starved the whole attempt of engine numbers.
-    print(json.dumps(result), flush=True)
+    else:
+        result.pop("error", None)
+
+    # --- mesh path: same queries, ICI all_to_all shuffle ----------------
+    # guarded end to end: a mesh-path failure must never discard the file
+    # numbers already measured above
+    if time.time() < deadline - 300:
+        try:
+            mesh_config = BallistaConfig(
+                {**base_config, "ballista.shuffle.mesh": "true"})
+            mctx = BallistaContext.standalone(mesh_config, concurrent_tasks=4)
+            try:
+                register_tables(mctx, DATA_DIR)
+                mesh_queries = [int(x) for x in MESH_QUERIES.split(",") if x.strip()]
+                run_queries(mctx, mesh_queries, "mesh",
+                            result.setdefault("engine_mesh", {}))
+            finally:
+                mctx.shutdown()
+        except Exception as e:  # noqa: BLE001 — record, keep the file numbers
+            result["engine_mesh"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[worker] mesh bench failed: {e}", file=sys.stderr)
+    else:
+        result["engine_mesh"] = {"skipped": "deadline"}
 
     # --- kernel: join shape (sorted-build + searchsorted probe) ---------
     # evidences the device join path: the build argsort is the one program
     # family measured to compile slowly on this backend, so compile time is
     # reported separately from steady-state
-    rngj = np.random.default_rng(11)
-    n_probe, n_build = KERNEL_ROWS // 2, KERNEL_ROWS // 8
-    pk = jax.device_put(jnp.asarray(
-        rngj.integers(0, n_build * 2, n_probe).astype(np.int64)))
-    bk = jax.device_put(jnp.asarray(np.arange(n_build, dtype=np.int64)))
-    pmask_j = jax.device_put(jnp.ones(n_probe, bool))
-    bmask_j = jax.device_put(jnp.ones(n_build, bool))
-    out_cap = n_probe
+    if time.time() < deadline - 300:
+        rngj = np.random.default_rng(11)
+        n_probe, n_build = KERNEL_ROWS // 2, KERNEL_ROWS // 8
+        pk = jax.device_put(jnp.asarray(
+            rngj.integers(0, n_build * 2, n_probe).astype(np.int64)))
+        bk = jax.device_put(jnp.asarray(np.arange(n_build, dtype=np.int64)))
+        pmask_j = jax.device_put(jnp.ones(n_probe, bool))
+        bmask_j = jax.device_put(jnp.ones(n_build, bool))
+        out_cap = n_probe
 
-    @jax.jit
-    def join_step(pk, bk, pmask, bmask):
-        bh_sorted, border, _ = K.build_side_sort([bk], bmask)
-        ph = K.hash64([pk])
-        pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
-        bidx = border[bp]
-        ok = pair_valid & bmask[bidx] & (pk[pi] == bk[bidx])
-        return jnp.sum(ok), total
+        @jax.jit
+        def join_step(pk, bk, pmask, bmask):
+            bh_sorted, border, _ = K.build_side_sort([bk], bmask)
+            ph = K.hash64([pk])
+            pi, bp, pair_valid, total = K.probe_join(ph, pmask, bh_sorted, out_cap)
+            bidx = border[bp]
+            ok = pair_valid & bmask[bidx] & (pk[pi] == bk[bidx])
+            return jnp.sum(ok), total
 
-    t_c = time.perf_counter()
-    jax.block_until_ready(join_step(pk, bk, pmask_j, bmask_j))
-    detail["kernel_join_compile_s"] = round(time.perf_counter() - t_c, 1)
+        t_c = time.perf_counter()
+        jax.block_until_ready(join_step(pk, bk, pmask_j, bmask_j))
+        result["kernel_join_compile_s"] = round(time.perf_counter() - t_c, 1)
 
-    def _timed_join():
-        out = join_step(pk, bk, pmask_j, bmask_j)
-        jax.block_until_ready(out)
-        np.asarray(out[0])  # scalar D2H: forces true remote completion
+        def _timed_join():
+            out = join_step(pk, bk, pmask_j, bmask_j)
+            jax.block_until_ready(out)
+            np.asarray(out[0])  # scalar D2H: forces true remote completion
 
-    medj = _med(_timed_join)
-    result["kernel_join_rows_per_sec"] = round(n_probe / medj, 1)
-    result["kernel_join_ms"] = round(medj * 1000, 3)
-    result["kernel_join_compile_s"] = detail["kernel_join_compile_s"]
-    print(f"[worker] kernel join: {n_probe/medj/1e6:.1f}M probe rows/s "
-          f"({medj*1000:.2f} ms, compile {detail['kernel_join_compile_s']}s)",
-          file=sys.stderr)
-    del pk, bk, pmask_j, bmask_j
-    print(json.dumps(result), flush=True)
+        medj = _med(_timed_join)
+        result["kernel_join_rows_per_sec"] = round(n_probe / medj, 1)
+        result["kernel_join_ms"] = round(medj * 1000, 3)
+        print(f"[worker] kernel join: {n_probe/medj/1e6:.1f}M probe rows/s "
+              f"({medj*1000:.2f} ms, compile {result['kernel_join_compile_s']}s)",
+              file=sys.stderr)
+        del pk, bk, pmask_j, bmask_j
+        emit("kernel-join")
 
-    # --- SF10 rider: q1 when the data exists ----------------------------
-    # the reference baseline IS SF10 (README.md:52-60); this records the
-    # like-for-like datapoint whenever a prior round generated the data,
-    # without making the headline depend on a 13-minute generation step
+    # --- SF10 rider: the reference baseline IS SF10 (README.md:52-60) ---
+    # runs whenever a prior round generated the data, without making the
+    # headline depend on a 13-minute generation step
     sf10_dir = os.path.join(REPO, ".bench_data", "tpch-sf10")
-    if SCALE == 1 and os.path.exists(os.path.join(sf10_dir, "lineitem.parquet")):
+    if (SCALE == 1 and os.path.exists(os.path.join(sf10_dir, "lineitem.parquet"))
+            and time.time() < deadline - 600):
         try:
             _warm_cache([os.path.join(sf10_dir, "lineitem.parquet")], "sf10")
             ctx10 = BallistaContext.standalone(
@@ -359,24 +406,30 @@ def _worker(platform: str) -> None:
             try:
                 register_tables(ctx10, sf10_dir)
                 rows10 = ctx10.catalog.provider("lineitem").row_count()
-                sf10 = run_queries(ctx10, [1], "sf10")
+                sf10 = result.setdefault("engine_sf10", {})
+                sf10_queries = [int(x) for x in SF10_QUERIES.split(",") if x.strip()]
+                # q1 runs 2 iters (warm number is the headline); the rest run
+                # once — they are evidence queries, not the headline
+                run_queries(ctx10, [q for q in sf10_queries if q == 1],
+                            "sf10", sf10, iters=2)
                 q1_10 = sf10.get("q1_ms", 0.0) / 1000.0
                 if q1_10:
                     sf10["q1_rows_per_sec"] = round(rows10 / q1_10, 1)
                     sf10["vs_baseline_sf10"] = round(
                         rows10 / q1_10 / BASELINE_ROWS_PER_S, 4)
-                    # the reference baseline IS SF10 (README.md:52-60):
-                    # when the like-for-like datapoint exists it becomes
-                    # the headline; the SF1 numbers stay in `engine`
+                    # the like-for-like datapoint becomes the headline; the
+                    # SF1 numbers stay in `engine`
                     result["metric"] = "tpch_q1_sf10_engine_rows_per_sec"
                     result["value"] = sf10["q1_rows_per_sec"]
                     result["vs_baseline"] = sf10["vs_baseline_sf10"]
-                result["engine_sf10"] = sf10
+                    emit("sf10-q1")
+                run_queries(ctx10, [q for q in sf10_queries if q != 1],
+                            "sf10", sf10, iters=1)
             finally:
                 ctx10.shutdown()
         except Exception as e:  # noqa: BLE001 — rider must not kill the run
             result["engine_sf10"] = {"error": f"{type(e).__name__}: {e}"}
-        print(json.dumps(result))
+    emit("done")
 
 
 # --------------------------------------------------------------------------
@@ -384,132 +437,242 @@ def _worker(platform: str) -> None:
 # --------------------------------------------------------------------------
 
 
-LOG_DIR = os.path.join(REPO, ".bench_logs")
+class WorkerProc:
+    """One supervised worker subprocess.  Non-blocking: the parent polls
+    ``poll()`` which also harvests any new RESULT JSON lines from the
+    worker's stdout file.  Full stdout/stderr is persisted win or lose
+    (round-2 failure mode: only a 1500-char tail survived, losing the TPU
+    kernel number that printed before the engine bench died)."""
 
+    def __init__(self, platform: str, timeout: float, tag: str,
+                 gate_file: str | None, deadline: float):
+        self.platform = platform
+        self.timeout = timeout
+        env = dict(os.environ) if platform == "tpu" else _cpu_env()
+        os.makedirs(LOG_DIR, exist_ok=True)
+        stamp = int(time.time())
+        self.log_path = os.path.join(LOG_DIR, f"attempt-{stamp}-{platform}{tag}.log")
+        self.out_path = self.log_path + ".stdout"
+        self.err_path = self.log_path + ".stderr"
+        self.init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "600"))
+        self.t0 = time.time()
+        self.timed_out: str | None = None
+        self.result: dict | None = None
+        self._out_pos = 0
+        self._backend_up = platform != "tpu"
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               "--platform", platform, "--deadline", str(deadline)]
+        if gate_file:
+            cmd += ["--gate-file", gate_file]
+        self._out_fh = open(self.out_path, "w")
+        self._err_fh = open(self.err_path, "w")
+        self.proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                                     stdout=self._out_fh, stderr=self._err_fh,
+                                     text=True)
 
-def _attempt(platform: str, timeout: int, tag: str = ""):
-    """Run one worker subprocess.  The FULL stdout/stderr is persisted to a
-    log file win or lose (round-2 failure mode: only a 1500-char tail
-    survived, losing the TPU kernel number that printed before the engine
-    bench died).
-
-    Backend-init watchdog: the experimental TPU plugin's tunnel grant can
-    wedge for an hour+ (observed), hanging jax.devices() with zero CPU.
-    The worker prints '[worker] backend up' the moment the backend exists;
-    if that marker hasn't appeared within BENCH_INIT_TIMEOUT the attempt
-    is killed early so a wedged tunnel can't eat the whole bench budget —
-    the CPU fallback still produces a number."""
-    env = dict(os.environ) if platform == "tpu" else _cpu_env()
-    os.makedirs(LOG_DIR, exist_ok=True)
-    stamp = int(time.time())
-    log_path = os.path.join(LOG_DIR, f"attempt-{stamp}-{platform}{tag}.log")
-    out_path = log_path + ".stdout"
-    err_path = log_path + ".stderr"
-    init_timeout = int(os.environ.get("BENCH_INIT_TIMEOUT", "900"))
-    t0 = time.time()
-    timed_out = None
-    with open(out_path, "w") as out_fh, open(err_path, "w") as err_fh:
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--worker",
-             "--platform", platform],
-            cwd=REPO, env=env, stdout=out_fh, stderr=err_fh, text=True,
-        )
-        backend_up = platform != "tpu"
-        while proc.poll() is None:
-            time.sleep(5)
-            elapsed = time.time() - t0
-            if not backend_up:
-                try:
-                    with open(err_path) as fh:
-                        backend_up = "backend up" in fh.read(65536)
-                except OSError:
-                    pass
-            if not backend_up and elapsed > init_timeout:
-                timed_out = f"backend init exceeded {init_timeout}s"
-                break
-            if elapsed > timeout:
-                timed_out = f"attempt exceeded {timeout}s"
-                break
-        if timed_out is not None:
-            proc.kill()
-            proc.wait()
-    rc = -1 if timed_out else proc.returncode
-    # errors='replace': a kill can truncate mid multi-byte character, and a
-    # decode crash here would abort the bench instead of falling back
-    with open(out_path, errors="replace") as fh:
-        stdout = fh.read()
-    with open(err_path, errors="replace") as fh:
-        stderr = fh.read()
-    with open(log_path, "w") as fh:
-        fh.write(f"# platform={platform} rc={rc} wall={time.time()-t0:.0f}s "
-                 f"timed_out={timed_out}\n--- stdout ---\n{stdout}\n"
-                 f"--- stderr ---\n{stderr}\n")
-    for p in (out_path, err_path):
+    def _harvest(self) -> bool:
+        """Read new stdout, keep the newest parseable JSON line.  Returns
+        True when the result advanced."""
+        advanced = False
         try:
-            os.remove(p)
+            with open(self.out_path) as fh:
+                fh.seek(self._out_pos)
+                chunk = fh.read()
+                self._out_pos = fh.tell()
+        except OSError:
+            return False
+        for line in chunk.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    self.result = json.loads(line)
+                    advanced = True
+                except json.JSONDecodeError:
+                    continue
+        return advanced
+
+    def poll(self) -> bool:
+        """Advance supervision; True while still running."""
+        self._harvest()
+        if self.proc.poll() is not None:
+            return False
+        elapsed = time.time() - self.t0
+        if not self._backend_up:
+            try:
+                with open(self.err_path) as fh:
+                    self._backend_up = "backend up" in fh.read(65536)
+            except OSError:
+                pass
+        if not self._backend_up and elapsed > self.init_timeout:
+            self.timed_out = f"backend init exceeded {self.init_timeout:.0f}s"
+        elif elapsed > self.timeout:
+            self.timed_out = f"attempt exceeded {self.timeout:.0f}s"
+        if self.timed_out:
+            self.proc.kill()
+            self.proc.wait()
+            return False
+        return True
+
+    def finish(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+        self._harvest()
+        for fh in (self._out_fh, self._err_fh):
+            try:
+                fh.close()
+            except OSError:
+                pass
+        # errors='replace': a kill can truncate mid multi-byte character
+        try:
+            with open(self.out_path, errors="replace") as fh:
+                stdout = fh.read()
+            with open(self.err_path, errors="replace") as fh:
+                stderr = fh.read()
+            with open(self.log_path, "w") as fh:
+                fh.write(f"# platform={self.platform} rc={self.proc.returncode} "
+                         f"wall={time.time()-self.t0:.0f}s "
+                         f"timed_out={self.timed_out}\n--- stdout ---\n{stdout}\n"
+                         f"--- stderr ---\n{stderr}\n")
+            for p in (self.out_path, self.err_path):
+                os.remove(p)
+            sys.stderr.write(stderr[-3000:])
         except OSError:
             pass
-    print(f"[bench] full log: {log_path}", file=sys.stderr)
-    if timed_out:
-        print(f"[bench] {platform} attempt killed: {timed_out}", file=sys.stderr)
-        return None
-    sys.stderr.write(stderr[-4000:])
-    if rc != 0:
-        print(f"[bench] {platform} attempt failed rc={rc} "
-              f"after {time.time()-t0:.0f}s", file=sys.stderr)
-        return None
-    for line in reversed(stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    print(f"[bench] {platform} attempt produced no JSON", file=sys.stderr)
-    return None
+        print(f"[bench] {self.platform} worker done rc={self.proc.returncode} "
+              f"timed_out={self.timed_out} log={self.log_path}", file=sys.stderr)
+
+
+def _merge(cpu: dict | None, tpu: dict | None) -> dict:
+    """The headline is TPU whenever the TPU worker measured ANY engine
+    query; otherwise CPU with whatever TPU evidence exists attached."""
+    tpu_has_engine = bool(tpu and (tpu.get("engine") or {}).get("q1_ms"))
+    if tpu_has_engine:
+        out = dict(tpu)
+        if cpu:
+            out["cpu"] = {k: v for k, v in cpu.items()
+                          if k not in ("metric", "unit", "partial")}
+        return out
+    if cpu:
+        out = dict(cpu)
+        if tpu:
+            out["tpu_partial"] = {k: v for k, v in tpu.items()
+                                  if k not in ("metric", "unit")}
+        return out
+    if tpu:
+        return dict(tpu)
+    return {"metric": "tpch_q1_engine_rows_per_sec", "value": 0.0,
+            "unit": "rows/s", "vs_baseline": 0.0, "error": "all attempts failed"}
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--platform", default="auto")
+    ap.add_argument("--gate-file", default=None)
+    ap.add_argument("--deadline", type=float, default=0.0)
     args = ap.parse_args()
 
     if args.worker:
-        _worker(args.platform)
+        deadline = args.deadline or (time.time() + 3600)
+        _worker(args.platform, args.gate_file, deadline)
         return
 
     ensure_data()
 
-    # subprocess timeout must exceed the engine's own job deadline (the
-    # worker sets ballista.job.timeout.seconds below it) so a slow-but-alive
-    # TPU run is never SIGKILLed from outside
-    tpu_budget = int(os.environ.get("BENCH_TPU_TIMEOUT", "3600"))
-    plan = []
-    if args.platform in ("auto", "tpu"):
-        plan += [("tpu", tpu_budget)]
-    if args.platform in ("auto", "cpu"):
-        plan += [("cpu", 2400)]
+    total_budget = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "5400"))
+    tpu_budget = float(os.environ.get("BENCH_TPU_TIMEOUT", str(total_budget - 120)))
+    cpu_budget = float(os.environ.get("BENCH_CPU_TIMEOUT", "2700"))
+    t_start = time.time()
+    hard_deadline = t_start + total_budget
+    os.makedirs(LOG_DIR, exist_ok=True)
+    gate_file = os.path.join(LOG_DIR, f"gate-{int(t_start)}")
 
-    result = None
-    for i, (platform, timeout) in enumerate(plan):
-        if i > 0:
-            time.sleep(20)
-        t0 = time.time()
-        result = _attempt(platform, timeout, tag=f"-{i}")
-        if result is None and platform == "tpu" and time.time() - t0 < 300:
-            # fast failure = transient backend-init Unavailable (device-grant
-            # tunnel recovering), not a slow run: one fresh retry is cheap
-            # and often succeeds.  Slow failures are NOT retried — a second
-            # identical attempt can only fail the same way (round-2 lesson).
-            time.sleep(20)
-            result = _attempt(platform, timeout, tag=f"-{i}-retry")
-        if result is not None:
+    want_tpu = args.platform in ("auto", "tpu")
+    want_cpu = args.platform in ("auto", "cpu")
+
+    cpu_w = WorkerProc("cpu", cpu_budget, "-0", None, hard_deadline - 30) \
+        if want_cpu else None
+    if not want_cpu:
+        # no CPU worker: open the gate immediately
+        open(gate_file, "w").close()
+    tpu_w = WorkerProc("tpu", tpu_budget, "-0", gate_file,
+                       hard_deadline - 30) if want_tpu else None
+
+    cpu_result: dict | None = None
+    tpu_result: dict | None = None
+    last_emitted = None
+    tpu_attempt = 0
+    tpu_give_up = False
+
+    def emit_best() -> None:
+        nonlocal last_emitted
+        merged = _merge(cpu_result, tpu_result)
+        line = json.dumps(merged)
+        if line != last_emitted:
+            last_emitted = line
+            print(line, flush=True)
+            try:
+                with open(os.path.join(LOG_DIR, "latest.json"), "w") as fh:
+                    fh.write(line + "\n")
+            except OSError:
+                pass
+
+    while time.time() < hard_deadline:
+        busy = False
+        if cpu_w is not None:
+            if cpu_w.poll():
+                busy = True
+            else:
+                cpu_w.finish()
+                cpu_result = cpu_w.result or cpu_result
+                cpu_w = None
+                open(gate_file, "w").close()  # release the TPU engine phase
+                emit_best()
+        if tpu_w is not None:
+            if tpu_w.poll():
+                busy = True
+                if tpu_w.result is not None and tpu_w.result != tpu_result:
+                    tpu_result = tpu_w.result
+                    emit_best()
+            else:
+                tpu_w.finish()
+                tpu_result = tpu_w.result or tpu_result
+                finished_ok = (tpu_w.timed_out is None
+                               and tpu_w.proc.returncode == 0)
+                made_progress = tpu_w.result is not None
+                tpu_w = None
+                emit_best()
+                # retry while budget remains, UNLESS the worker finished its
+                # run (rc=0) or got far enough that a rerun can't do better
+                remaining = t_start + tpu_budget - time.time()
+                if (want_tpu and not finished_ok and not made_progress
+                        and not tpu_give_up and remaining > 300):
+                    tpu_attempt += 1
+                    backoff = min(120.0, 30.0 * tpu_attempt)
+                    print(f"[bench] tpu retry #{tpu_attempt} in {backoff:.0f}s "
+                          f"({remaining:.0f}s budget left)", file=sys.stderr)
+                    time.sleep(backoff)
+                    tpu_w = WorkerProc("tpu", t_start + tpu_budget - time.time(),
+                                       f"-{tpu_attempt}", gate_file,
+                                       hard_deadline - 30)
+                else:
+                    tpu_give_up = True
+        if cpu_w is None and tpu_w is None:
             break
-    if result is None:
-        result = {"metric": "tpch_q1_engine_rows_per_sec", "value": 0.0,
-                  "unit": "rows/s", "vs_baseline": 0.0, "error": "all attempts failed"}
-    print(json.dumps(result))
+        if busy:
+            time.sleep(5)
+
+    for w in (cpu_w, tpu_w):
+        if w is not None:
+            w.finish()
+            if w.platform == "cpu":
+                cpu_result = w.result or cpu_result
+            else:
+                tpu_result = w.result or tpu_result
+    # final merged line is ALWAYS the last stdout line
+    last_emitted = None
+    emit_best()
 
 
 if __name__ == "__main__":
