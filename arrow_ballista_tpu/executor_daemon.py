@@ -30,11 +30,17 @@ def main(argv=None) -> None:
     ap.add_argument("--scheduling-policy", choices=["push", "pull"],
                     default="push")
     ap.add_argument("--log-level", default="INFO")
+    ap.add_argument("--log-dir", default=None,
+                    help="write rotating log files here instead of stderr")
+    ap.add_argument("--log-file-name-prefix", default="executor")
+    ap.add_argument("--log-rotation-policy", default="daily",
+                    choices=["minutely", "hourly", "daily", "never"])
     args = ap.parse_args(argv)
 
-    logging.basicConfig(
-        level=args.log_level,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    from .utils.logsetup import init_logging
+
+    init_logging(args.log_level, args.log_dir, args.log_file_name_prefix,
+                 args.log_rotation_policy)
     # native-crash forensics: a SIGSEGV in a daemon otherwise dies silently
     import faulthandler
 

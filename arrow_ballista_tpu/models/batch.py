@@ -37,12 +37,25 @@ from .schema import Schema
 
 
 @functools.lru_cache(maxsize=1)
+def _platform_remote() -> bool:
+    return jax.devices()[0].platform != "cpu"
+
+
 def remote_device() -> bool:
     """True when the default jax device makes device->host syncs expensive
-    (fixed ~75 ms latency per transfer over the axon tunnel).  Platform is
-    the practical proxy: cpu arrays share host memory; accelerator backends
-    pay the transfer."""
-    return jax.devices()[0].platform != "cpu"
+    (fixed ~75 ms latency per transfer over the axon tunnel) — gates the
+    sync-avoidance behaviors (skip shrink(), deferred metrics, join-retry
+    elision).  ``BALLISTA_REMOTE_DEVICE=0/1`` overrides explicitly and is
+    re-read on every call (only the backend-platform probe is cached): a
+    locally-attached accelerator with fast D2H should set 0 to keep the
+    eager safety nets (advisor r4).  Default proxy: cpu arrays share host
+    memory; accelerator backends pay the transfer."""
+    from ..utils.config import env_flag
+
+    env = env_flag("BALLISTA_REMOTE_DEVICE")
+    if env is not None:
+        return env
+    return _platform_remote()
 
 
 def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:

@@ -35,8 +35,32 @@ I64_MAX = jnp.int64(2**63 - 1)
 # --------------------------------------------------------------------------
 
 
+def force_hash_collisions() -> bool:
+    """Collision-stress mode (the reference ships this as the
+    ``force_hash_collisions`` cargo feature, reference
+    ballista/core/Cargo.toml:40-41): every hash64 becomes a constant, so
+    all rows collide into one shuffle bucket / join probe range.  Join and
+    aggregate correctness must survive because both re-verify real key
+    equality after hashing.  Process-level env flag — set
+    ``BALLISTA_FORCE_HASH_COLLISIONS=1`` before any program traces — the
+    first read is cached for the process lifetime, so already-traced and
+    newly-traced programs can never disagree about hashing (a mid-process
+    flip would silently split keys across transports)."""
+    global _FORCE_COLLISIONS
+    if _FORCE_COLLISIONS is None:
+        from ..utils.config import env_flag
+
+        _FORCE_COLLISIONS = bool(env_flag("BALLISTA_FORCE_HASH_COLLISIONS"))
+    return _FORCE_COLLISIONS
+
+
+_FORCE_COLLISIONS: Optional[bool] = None
+
+
 def hash64(arrays: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """Combine columns into a 64-bit mixed hash (splitmix64-style)."""
+    if force_hash_collisions():
+        return jnp.zeros(arrays[0].shape, dtype=jnp.uint64)
     h = jnp.zeros(arrays[0].shape, dtype=jnp.uint64)
     for a in arrays:
         x = a.astype(jnp.uint64)
@@ -228,6 +252,16 @@ def grouped_aggregate(
     the dense program compiles in seconds (measured: 163 s vs 3.8 s for the
     q1 shape on v5e) and runs ~2.5x faster.  Otherwise grouping is
     sort-based (lexsort -> boundary flags -> segment reductions).
+
+    CONTRACT: ``key_ranges`` bounds are a caller-guaranteed invariant — every
+    live row's key must lie inside its declared range.  On the dense path,
+    when the domain fits ``out_capacity`` the overflow flag is statically
+    None and out-of-range rows are **silently folded into the scratch slot**
+    (dropped); only when the domain exceeds ``out_capacity`` does the
+    returned flag also surface bad rows.  Engine callers build ranges
+    structurally (dictionary code ranges, bool {0,1}) so violation is
+    impossible there; external callers passing literal ranges own the
+    guarantee.
     """
     if key_cols:
         domain = dense_domain(key_ranges)
@@ -354,6 +388,10 @@ def _tpu_backend() -> bool:
 
 _MATMUL_SEG_LIMIT = 1024  # one-hot matmul while chunk x segments tiles fit
 _SEG_CHUNK = 1 << 15      # max rows/chunk: 2^15 rows x 16-bit limbs < 2^31
+# chunk-offset path ceiling on C*(S+1): keeps the per-limb scratch buffer
+# <= 512 MB int32 AND far from the int32 id wrap at 2^31 (advisor r4:
+# wrapped ids silently dropped rows -> wrong aggregates with no error)
+_CHUNK_OFFSET_LIMIT = 1 << 27
 
 
 def _i64_limbs(v: jnp.ndarray) -> List[jnp.ndarray]:
@@ -410,8 +448,17 @@ def grouped_sums_i64(vals: List[jnp.ndarray], seg: jnp.ndarray,
     # large segment count: chunk-offset int32 segment_sums per limb (per
     # chunk x segment a limb sum stays < 2^31), recombined in int64
     chunk = min(_SEG_CHUNK, n)
-    pad = (-n) % chunk
     S1 = S + 1  # one scratch slot for padded rows
+    n_chunks = -(-n // chunk)
+    if n_chunks * S1 > _CHUNK_OFFSET_LIMIT:
+        # ids = seg + chunk_index*S1 wraps int32 past 2^31 — XLA would then
+        # silently DROP the wrapped rows — and the C*S1 scratch buffer per
+        # limb reaches multiple GB well before the wrap point.  All inputs
+        # to this check are static shapes, so the guard costs nothing: fall
+        # back to the plain int64 segment_sum (a slow 64-bit scatter, but
+        # exact) rather than ever risking silent wrong aggregates.
+        return [jax.ops.segment_sum(v, seg, num_segments=S) for v in vals]
+    pad = (-n) % chunk
     if pad:
         seg = jnp.concatenate([seg, jnp.full(pad, S, seg.dtype)])
     C = seg.shape[0] // chunk

@@ -40,11 +40,17 @@ def main(argv=None) -> None:
                          "executor TTL janitor remains as backstop)")
     ap.add_argument("--shuffle-partitions", type=int, default=16)
     ap.add_argument("--log-level", default="INFO")
+    ap.add_argument("--log-dir", default=None,
+                    help="write rotating log files here instead of stderr")
+    ap.add_argument("--log-file-name-prefix", default="scheduler")
+    ap.add_argument("--log-rotation-policy", default="daily",
+                    choices=["minutely", "hourly", "daily", "never"])
     args = ap.parse_args(argv)
 
-    logging.basicConfig(
-        level=args.log_level,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    from .utils.logsetup import init_logging
+
+    init_logging(args.log_level, args.log_dir, args.log_file_name_prefix,
+                 args.log_rotation_policy)
     # native-crash forensics: a SIGSEGV in a daemon otherwise dies silently
     import faulthandler
 

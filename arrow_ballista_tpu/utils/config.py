@@ -28,6 +28,7 @@ COLLECT_STATISTICS = "ballista.collect_statistics"
 MESH_SHUFFLE = "ballista.shuffle.mesh"  # use ICI all-to-all when executors co-located on a mesh
 MESH_HYBRID = "ballista.shuffle.mesh.hybrid"  # mesh WITHIN a host, file shuffle ACROSS hosts
 MESH_BROADCAST_ROWS = "ballista.shuffle.mesh.broadcast_rows"  # build side <= this -> all_gather broadcast join
+MESH_MIN_ROWS = "ballista.shuffle.mesh.min_rows"  # adaptive: fuse on mesh only when exchange >= this
 TASK_SLOTS = "ballista.executor.task_slots"
 BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides smaller skip the shuffle
 JOB_TIMEOUT_S = "ballista.job.timeout.seconds"  # client-side wait_for_job deadline
@@ -40,6 +41,20 @@ class ConfigEntry:
     default: Any
     parse: Callable[[str], Any]
     doc: str = ""
+
+
+def env_flag(name: str) -> bool:
+    """Shared truthiness rule for boolean env overrides
+    (BALLISTA_REMOTE_DEVICE, BALLISTA_FORCE_HASH_COLLISIONS, ...):
+    unset/''/'0'/'false'/'no' are False, anything else True.
+    Returns None when the variable is unset/blank so callers can
+    distinguish 'explicitly 0' from 'not set'."""
+    import os
+
+    v = os.environ.get(name)
+    if v is None or v.strip() == "":
+        return None
+    return v.strip().lower() not in ("0", "false", "no")
 
 
 def _parse_bool(s: str) -> bool:
@@ -87,6 +102,12 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "mesh joins all_gather the build side instead of "
                     "all_to_all-ing both sides when its live rows fit here "
                     "(CollectLeft analog)"),
+        ConfigEntry(MESH_MIN_ROWS, 8_000_000, int,
+                    "adaptive transport: mesh-fuse an exchange only when "
+                    "its estimated input rows reach this (small exchanges "
+                    "measured faster on the materialized file path; the "
+                    "mesh's no-materialization advantage grows with size); "
+                    "0 forces mesh for every eligible exchange"),
         ConfigEntry(TASK_SLOTS, 4, int, "concurrent task slots per executor"),
         ConfigEntry(BROADCAST_THRESHOLD, 1_000_000, int,
                     "broadcast join build sides with fewer estimated rows"),

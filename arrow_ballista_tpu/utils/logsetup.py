@@ -1,0 +1,59 @@
+"""Daemon logging: stderr or rotating files.
+
+Parity with the reference's tracing-appender setup
+(reference ballista/core/src/config.rs:290-310 LogRotationPolicy
+{Minutely, Hourly, Daily, Never} + executor_process.rs:94-129 /
+scheduler bin/main.rs:94-130 file-or-stdout selection): daemons log to
+stderr by default, or to ``<log_dir>/<prefix>.log`` with time-based
+rotation when ``--log-dir`` is given.
+
+One daemon per (log_dir, prefix): TimedRotatingFileHandler's rollover
+rename is not multi-process safe, so co-located daemons must use distinct
+prefixes (e.g. ``--log-file-name-prefix executor-50052``) or distinct
+dirs — same discipline the reference's tracing-appender needs.
+"""
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+from typing import Optional
+
+ROTATION_POLICIES = ("minutely", "hourly", "daily", "never")
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def init_logging(level: str = "INFO", log_dir: Optional[str] = None,
+                 file_prefix: str = "ballista", rotation: str = "daily") -> None:
+    """Configure the root logger.  ``log_dir=None`` -> stderr only."""
+    if rotation not in ROTATION_POLICIES:
+        raise ValueError(f"unknown rotation policy {rotation!r}; "
+                         f"expected one of {ROTATION_POLICIES}")
+    root = logging.getLogger()
+    root.setLevel(level)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    fmt = logging.Formatter(_FORMAT)
+    if log_dir is None:
+        h: logging.Handler = logging.StreamHandler()
+        h.setFormatter(fmt)
+        root.addHandler(h)
+        return
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, f"{file_prefix}.log")
+    if rotation == "never":
+        h = logging.FileHandler(path)
+    else:
+        when = {"minutely": "M", "hourly": "H", "daily": "midnight"}[rotation]
+        h = logging.handlers.TimedRotatingFileHandler(
+            path, when=when, interval=1, backupCount=72)
+    h.setFormatter(fmt)
+    root.addHandler(h)
+    # operational errors still surface on the console while normal flow
+    # goes to the file (same split as the reference's print_thread_info
+    # stdout diagnostics next to file tracing)
+    console = logging.StreamHandler()
+    console.setLevel(logging.WARNING)
+    console.setFormatter(fmt)
+    root.addHandler(console)

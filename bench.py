@@ -336,8 +336,14 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
     # numbers already measured above
     if time.time() < deadline - 300:
         try:
+            # min_rows=0: the default transport is ADAPTIVE (small exchanges
+            # plan onto the file path), so the mesh leg forces mesh to keep
+            # measuring the raw transport — the adaptive default is what
+            # users get and equals the better of the two legs
             mesh_config = BallistaConfig(
-                {**base_config, "ballista.shuffle.mesh": "true"})
+                {**base_config, "ballista.shuffle.mesh": "true",
+                 "ballista.shuffle.mesh.min_rows": "0"})
+            result["mesh_forced"] = True
             mctx = BallistaContext.standalone(mesh_config, concurrent_tasks=4)
             try:
                 register_tables(mctx, DATA_DIR)

@@ -1,0 +1,98 @@
+"""Collision-stress mode: every hash64 collides (constant), results must
+not change.
+
+Parity: the reference's ``force_hash_collisions`` feature
+(reference ballista/core/Cargo.toml:40-41) exists to prove join/agg/shuffle
+correctness never depends on hash quality.  Here the engine re-verifies
+real key equality after every hash probe and shuffles by bucket id only,
+so a constant hash merely stresses skew (one bucket) and join fan-out
+(every probe matches the whole build range).
+
+The flag is process-level (jit programs bake it in at trace time, like the
+reference's compile-time feature), so each configuration runs in a fresh
+subprocess and the outputs are compared.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVER = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["BALLISTA_REPO"])
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+from arrow_ballista_tpu.ops import kernels as K
+
+out_dir = sys.argv[1]
+rng = np.random.default_rng(3)
+n_fact, n_dim = 3000, 200
+pq.write_table(pa.table({
+    "k": rng.integers(0, n_dim, n_fact).astype(np.int64),
+    "s": np.array(["g%d" % v for v in rng.integers(0, 7, n_fact)]),
+    "v": rng.integers(0, 1000, n_fact).astype(np.int64),
+}), out_dir + "/fact.parquet")
+pq.write_table(pa.table({
+    "k": np.arange(n_dim, dtype=np.int64),
+    "name": np.array(["d%03d" % i for i in range(n_dim)]),
+}), out_dir + "/dim.parquet")
+
+results = {"collisions": K.force_hash_collisions()}
+for mesh in (False, True):
+    cfg = {"ballista.shuffle.partitions": "4"}
+    if mesh:
+        cfg["ballista.shuffle.mesh"] = "true"
+        cfg["ballista.shuffle.mesh.min_rows"] = "0"
+    ctx = BallistaContext.standalone(BallistaConfig(cfg), concurrent_tasks=2)
+    ctx.register_parquet("fact", out_dir + "/fact.parquet")
+    ctx.register_parquet("dim", out_dir + "/dim.parquet")
+    tag = "mesh" if mesh else "file"
+    results["join_" + tag] = ctx.sql(
+        "select d.name, count(*) as n, sum(f.v) as sv from fact f "
+        "join dim d on f.k = d.k group by d.name order by sv desc, d.name "
+        "limit 20").to_pandas().to_csv(index=False)
+    results["agg_" + tag] = ctx.sql(
+        "select s, count(*) as n, sum(v) as sv, min(v) as mn, max(v) as mx "
+        "from fact group by s order by s").to_pandas().to_csv(index=False)
+    results["semi_" + tag] = ctx.sql(
+        "select count(*) as n from fact where k in "
+        "(select k from dim where k < 50)").to_pandas().to_csv(index=False)
+    ctx.shutdown()
+print("RESULT:" + json.dumps(results))
+"""
+
+
+def _run(tmp_path, forced: bool) -> dict:
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _scrubbed_cpu_env
+
+    env = _scrubbed_cpu_env(8)
+    env["BALLISTA_FORCE_HASH_COLLISIONS"] = "1" if forced else "0"
+    env["BALLISTA_REPO"] = REPO
+    d = tmp_path / ("forced" if forced else "plain")
+    d.mkdir()
+    driver = tmp_path / "driver.py"
+    driver.write_text(DRIVER)
+    r = subprocess.run([sys.executable, str(driver), str(d)],
+                       capture_output=True, text=True, cwd=REPO,
+                       env=env, timeout=900)
+    assert r.returncode == 0, r.stderr[-4000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("RESULT:")][-1]
+    return json.loads(line[len("RESULT:"):])
+
+
+def test_forced_collisions_change_nothing(tmp_path):
+    plain = _run(tmp_path, forced=False)
+    forced = _run(tmp_path, forced=True)
+    assert plain["collisions"] is False
+    assert forced["collisions"] is True
+    for key in plain:
+        if key == "collisions":
+            continue
+        assert plain[key] == forced[key], f"{key} diverged under collisions"

@@ -30,7 +30,8 @@ def table():
 
 def contexts(table):
     base = {"ballista.shuffle.partitions": "4"}
-    mesh_ctx = BallistaContext.local(BallistaConfig({**base, "ballista.shuffle.mesh": "true"}))
+    mesh_ctx = BallistaContext.local(BallistaConfig({**base, "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0"}))
     file_ctx = BallistaContext.local(BallistaConfig(base))
     for c in (mesh_ctx, file_ctx):
         c.register_table("t", table)
@@ -67,7 +68,8 @@ def test_mesh_matches_file_shuffle(table, q):
 
 def test_mesh_standalone_cluster(table):
     config = BallistaConfig({"ballista.shuffle.partitions": "4",
-                             "ballista.shuffle.mesh": "true"})
+                             "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0"})
     ctx = BallistaContext.standalone(config, concurrent_tasks=4)
     ctx.register_table("t", table)
     got = ctx.sql("select g, sum(v) as sv from t group by g order by g").to_pandas()
@@ -145,7 +147,8 @@ def join_contexts(join_tables, strategy="broadcast"):
     # broadcast threshold 0 forces the partitioned path on both contexts
     base = {"ballista.shuffle.partitions": "4",
             "ballista.join.broadcast_threshold": "0"}
-    mesh_extra = {"ballista.shuffle.mesh": "true"}
+    mesh_extra = {"ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0"}
     if strategy == "partitioned":
         # force both sides through the all_to_all exchange (the 2k-row dim
         # side would otherwise take the all_gather broadcast path)
@@ -239,6 +242,7 @@ def test_mesh_hybrid_plan_shape(table):
     from arrow_ballista_tpu.sql.optimizer import optimize
 
     cfg = BallistaConfig({"ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0",
                           "ballista.shuffle.mesh.hybrid": "true",
                           "ballista.shuffle.partitions": "4"})
     ctx = BallistaContext.local(cfg)
@@ -260,6 +264,7 @@ def test_mesh_hybrid_plan_shape(table):
 def test_mesh_hybrid_matches_file_shuffle(table):
     """Hybrid path results are identical to the plain file-shuffle path."""
     hybrid_cfg = BallistaConfig({"ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0",
                                  "ballista.shuffle.mesh.hybrid": "true",
                                  "ballista.shuffle.partitions": "4"})
     plain_cfg = BallistaConfig({"ballista.shuffle.partitions": "4"})
@@ -292,6 +297,7 @@ def test_mesh_hybrid_nullable_operands():
                       type=pa.int64()),
     })
     hybrid_cfg = BallistaConfig({"ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0",
                                  "ballista.shuffle.mesh.hybrid": "true",
                                  "ballista.shuffle.partitions": "4"})
     plain_cfg = BallistaConfig({"ballista.shuffle.partitions": "4"})
@@ -339,6 +345,7 @@ def test_mesh_hybrid_through_network_scheduler(tmp_path, table):
         ex.start()
     try:
         cfg = BallistaConfig({"ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0",
                               "ballista.shuffle.mesh.hybrid": "true",
                               "ballista.shuffle.partitions": "4"})
         ctx = BallistaContext.remote("127.0.0.1", sched.port, cfg)
@@ -371,6 +378,7 @@ def test_mesh_hybrid_join_matches_file_shuffle(join_tables):
             "ballista.join.broadcast_threshold": "0"}
     hctx = BallistaContext.local(BallistaConfig({
         **base, "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0",
         "ballista.shuffle.mesh.hybrid": "true"}))
     fctx = BallistaContext.local(BallistaConfig(base))
     for c in (hctx, fctx):
@@ -394,6 +402,7 @@ def test_mesh_hybrid_join_through_standalone_cluster(join_tables):
     cfg = BallistaConfig({"ballista.shuffle.partitions": "4",
                           "ballista.join.broadcast_threshold": "0",
                           "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0",
                           "ballista.shuffle.mesh.hybrid": "true"})
     ctx = BallistaContext.standalone(cfg, concurrent_tasks=4)
     try:
@@ -420,6 +429,7 @@ def test_mesh_task_join_serde_roundtrip(join_tables):
         "ballista.shuffle.partitions": "4",
         "ballista.join.broadcast_threshold": "0",
         "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0",
         "ballista.shuffle.mesh.hybrid": "true"}))
     ctx.register_table("fact", fact)
     ctx.register_table("dim", dim)
@@ -429,3 +439,40 @@ def test_mesh_task_join_serde_roundtrip(join_tables):
     back = serde.plan_from_obj(obj)
     assert collect_nodes(back, MeshTaskJoinExec)
     assert back.display() == planned.plan.display()
+
+
+def test_adaptive_transport_gate(tmp_path):
+    """VERDICT r4 #5: mesh vs file is chosen per exchange from row
+    estimates — small exchanges stay on the materialized file path even
+    with mesh enabled; min_rows=0 forces mesh (operator/test override)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "k": np.arange(4000, dtype=np.int64) % 50,
+        "v": np.arange(4000, dtype=np.int64),
+    }), path, row_group_size=1000)  # 4 row groups -> multi-partition scan
+
+    def physical_plan(cfg):
+        ctx = BallistaContext.local(BallistaConfig(cfg))
+        ctx.register_parquet("t", path)
+        df = ctx.sql("explain select k, sum(v) from t group by k").to_pandas()
+        return df[df.plan_type == "physical_plan"].plan.iloc[0]
+
+    gated = physical_plan({"ballista.shuffle.mesh": "true",
+                           "ballista.shuffle.partitions": "4",
+                           "ballista.shuffle.mesh.min_rows": "1000000"})
+    assert "MeshAggregate" not in gated  # 4000-row table: file path
+    forced = physical_plan({"ballista.shuffle.mesh": "true",
+                            "ballista.shuffle.partitions": "4",
+                            "ballista.shuffle.mesh.min_rows": "0"})
+    assert "MeshAggregate" in forced
+    small_floor = physical_plan({"ballista.shuffle.mesh": "true",
+                                 "ballista.shuffle.partitions": "4",
+                                 "ballista.shuffle.mesh.min_rows": "100"})
+    assert "MeshAggregate" in small_floor  # estimate clears the gate

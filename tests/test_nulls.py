@@ -164,6 +164,7 @@ def test_mesh_join_null_keys_never_match(ctx):
 
     mctx = BallistaContext.local(BallistaConfig({
         "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0",
         "ballista.join.broadcast_threshold": "0",
         "ballista.shuffle.partitions": "4"}))
     mctx.register_table("t", pa.table({

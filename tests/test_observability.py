@@ -103,3 +103,33 @@ def test_keda_scaler_endpoint(stack):
     sched, ex, ctx = stack
     out = _get(sched, "/api/scaler")
     assert "inflight_tasks" in out and isinstance(out["inflight_tasks"], int)
+
+
+def test_rotating_file_logging(tmp_path):
+    """Daemon log-to-file with rotation (reference config.rs:290-310
+    LogRotationPolicy + tracing-appender rolling files)."""
+    import logging
+
+    from arrow_ballista_tpu.utils.logsetup import init_logging
+
+    root = logging.getLogger()
+    saved = list(root.handlers)
+    saved_level = root.level
+    try:
+        init_logging("INFO", str(tmp_path), "sched", "minutely")
+        logging.getLogger("t").info("hello rotation")
+        for h in logging.getLogger().handlers:
+            h.flush()
+        path = tmp_path / "sched.log"
+        assert path.exists() and "hello rotation" in path.read_text()
+        import pytest
+
+        with pytest.raises(ValueError):
+            init_logging("INFO", str(tmp_path), "x", "weekly")
+    finally:
+        for h in list(root.handlers):
+            root.removeHandler(h)
+            h.close()
+        for h in saved:
+            root.addHandler(h)
+        root.setLevel(saved_level)

@@ -191,7 +191,8 @@ def test_tpch_query(ctx, oracle, q):
 @pytest.fixture(scope="module")
 def mesh_ctx(data):
     config = BallistaConfig({"ballista.shuffle.partitions": "4",
-                             "ballista.shuffle.mesh": "true"})
+                             "ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0"})
     c = BallistaContext.local(config)
     for name, table in data.items():
         c.register_table(name, table)

@@ -152,7 +152,8 @@ def test_udf_inside_mesh_fused_aggregate(udfs, table):
     from arrow_ballista_tpu.utils.config import BallistaConfig
 
     sql = "SELECT k, SUM(sq(v)) AS s FROM t GROUP BY k ORDER BY k"
-    mesh_ctx = BallistaContext.local(BallistaConfig({"ballista.shuffle.mesh": "true"}))
+    mesh_ctx = BallistaContext.local(BallistaConfig({"ballista.shuffle.mesh": "true",
+        "ballista.shuffle.mesh.min_rows": "0"}))
     file_ctx = BallistaContext.local()
     try:
         for c in (mesh_ctx, file_ctx):

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the axon tunnel every ~10 min; log transitions. Stop via rm tmp/tpu_watch.on
+touch /root/repo/tmp/tpu_watch.on
+while [ -f /root/repo/tmp/tpu_watch.on ]; do
+  ts=$(date +%H:%M:%S)
+  out=$(timeout 560 python -c "
+import jax, json
+try:
+    d = jax.devices()[0]
+    print('ALIVE', d.platform, d.device_kind)
+except Exception as e:
+    print('DOWN', type(e).__name__, str(e)[:120])
+" 2>/dev/null | tail -1)
+  echo "$ts $out" >> /root/repo/tmp/tpu_watch.log
+  case "$out" in ALIVE*) echo "$ts TUNNEL UP" >> /root/repo/tmp/tpu_watch.log;; esac
+  sleep 600
+done
