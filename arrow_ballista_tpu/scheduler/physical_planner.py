@@ -102,20 +102,46 @@ class PhysicalPlanner:
             return
         target = max(1, self.config.batch_size)
         rows = 0
+        row_bytes = 0
 
         def walk(node: L.LogicalPlan):
-            nonlocal rows
+            nonlocal rows, row_bytes
             if isinstance(node, L.TableScan):
                 try:
                     rc = self.catalog.provider(node.table).row_count()
                 except Exception:  # noqa: BLE001 — stats are best-effort
                     rc = None
-                rows = max(rows, rc or 0)
+                if (rc or 0) > rows:
+                    rows = rc or 0
+                    try:
+                        # node.schema is the PROJECTED scan schema
+                        # (projection pushdown already ran), so the width
+                        # reflects the columns a task actually holds
+                        row_bytes = sum(f.dtype.np_dtype.itemsize
+                                        for f in node.schema) + 1
+                    except Exception:  # noqa: BLE001
+                        row_bytes = 64
             for c in node.children():
                 walk(c)
 
         walk(logical)
-        self._partitions = min(64, max(1, -(-rows // target))) if rows else 8
+        if not rows:
+            self._partitions = 8
+            return
+        base = max(1, -(-rows // target))
+        # stats-driven memory control (VERDICT r4 #6): a task's input is
+        # ~(rows/partitions) * row_bytes, so the per-task budget sets a
+        # partition-count FLOOR; the cap relaxes from 64 to 256 only under
+        # budget pressure (fine partitioning costs scheduling overhead,
+        # so it is bought only when memory demands it)
+        from ..utils.config import resolve_task_budget
+
+        budget = resolve_task_budget(self.config)
+        if budget:
+            need = -(-rows * row_bytes // budget)
+            self._partitions = min(256, max(min(64, base), need, 1))
+        else:
+            self._partitions = min(64, base)
 
     # --- entry ----------------------------------------------------------
     def plan_query(self, logical: L.LogicalPlan) -> PlannedQuery:

@@ -488,10 +488,7 @@ class SqlToRel:
 
     @staticmethod
     def _flat(relations: List[Relation]) -> List[Relation]:
-        out = []
-        for r in relations:
-            out.extend(r.members if isinstance(r, _CompositeRelation) else [r])
-        return out
+        return _flatten_relations(relations)
 
     def _as_equi_pair_by_alias(self, c: E.Expr):
         if isinstance(c, E.BinOp) and c.op == "=":
@@ -888,12 +885,28 @@ class SqlToRel:
 
 
 class _CompositeRelation(Relation):
-    """A pre-joined (explicit JOIN..ON) group of relations."""
+    """A pre-joined (explicit JOIN..ON) group of relations.
+
+    ``members`` is always a FLAT list of leaf relations: a chained
+    ``a JOIN b ON .. JOIN c ON ..`` nests composites, and an unflattened
+    member would hide its aliases from scope resolution (``p.grp`` in a
+    3-table chain resolved against the composite's first-member alias
+    only — r5 regression find)."""
 
     def __init__(self, members: List[Relation], plan: L.LogicalPlan):
-        self.members = members
-        self.alias = members[0].alias
+        flat = _flatten_relations(members)
+        self.members = flat
+        self.alias = flat[0].alias
         self.plan = plan
+
+
+def _flatten_relations(relations: List[Relation]) -> List[Relation]:
+    """One source of the composite-flattening invariant (also used by
+    SqlToRel._flat for scope construction)."""
+    out: List[Relation] = []
+    for r in relations:
+        out.extend(r.members if isinstance(r, _CompositeRelation) else [r])
+    return out
 
 
 # internal predicate carriers (consumed by _apply_subquery_pred)

@@ -33,6 +33,7 @@ TASK_SLOTS = "ballista.executor.task_slots"
 BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides smaller skip the shuffle
 JOB_TIMEOUT_S = "ballista.job.timeout.seconds"  # client-side wait_for_job deadline
 SCAN_CACHE_BYTES = "ballista.scan.cache.bytes"  # HBM-resident scan cache budget ('auto' | bytes | 0=off)
+MEM_TASK_BUDGET = "ballista.memory.task.budget.bytes"  # per-task device working-set bound ('auto' | bytes | 0=unlimited)
 
 
 @dataclasses.dataclass
@@ -116,8 +117,40 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(SCAN_CACHE_BYTES, "auto", str,
                     "device-resident scan cache budget: 'auto' (6 GiB), "
                     "a byte count, or 0 to disable; see utils/table_cache.py"),
+        ConfigEntry(MEM_TASK_BUDGET, "auto", str,
+                    "memory control: per-task device working-set budget in "
+                    "bytes; joins chunk their probe side and 'auto' shuffle "
+                    "partition counts scale to keep task state under it.  "
+                    "'auto' = 4 GiB on accelerator backends, unlimited on "
+                    "CPU; 0 = unlimited"),
     ]
 }
+
+
+def resolve_task_budget(cfg: "BallistaConfig") -> int:
+    """MEM_TASK_BUDGET -> bytes (0 = unlimited).
+
+    Memory-control role of the reference's spill machinery
+    (reference ballista/core/src/utils.rs:176-212 write_stream_to_disk):
+    a static-shape engine cannot react to pressure by spilling mid-kernel,
+    so the budget is enforced *before* allocation — joins chunk their probe
+    loop and 'auto' partition counts scale so no task's working set is
+    planned above the budget.  Disk-tier state remains the shuffle's IPC
+    files, exactly as reference shuffle files serve as its data
+    checkpoints."""
+    v = cfg.get(MEM_TASK_BUDGET)
+    if isinstance(v, str):
+        if v.strip().lower() == "auto":
+            # keyed on the backend PLATFORM, not remote_device(): that
+            # helper is a D2H-latency proxy with a user override
+            # (BALLISTA_REMOTE_DEVICE=0 restores eager safety nets), and
+            # the override must not silently lift the memory budget on
+            # small-HBM accelerators
+            from ..models.batch import _platform_remote
+
+            return (4 << 30) if _platform_remote() else 0
+        v = int(v)
+    return int(v)
 
 
 class BallistaConfig:

@@ -322,3 +322,34 @@ def test_order_by_qualified_grouped_column():
     out = ctx.sql("select d.w, sum(t.v) s from t join d on t.k = d.k "
                   "group by d.w order by d.w desc").to_pandas()
     assert out.w.tolist() == [8, 6, 4, 2, 0]
+
+
+def test_three_table_explicit_join_chain(tmp_path):
+    """a JOIN b ON .. JOIN c ON .. nests composite relations; every member
+    alias must stay resolvable in the SELECT scope (r5 regression)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    d = tmp_path
+    pq.write_table(pa.table({"pk": np.arange(10, dtype=np.int64),
+                             "sk": np.arange(10, dtype=np.int64) % 3,
+                             "qty": np.ones(10, dtype=np.int64)}),
+                   str(d / "li.parquet"))
+    pq.write_table(pa.table({"pk": np.arange(10, dtype=np.int64),
+                             "grp": np.array(["g%d" % (i % 2) for i in range(10)])}),
+                   str(d / "part.parquet"))
+    pq.write_table(pa.table({"sk": np.arange(3, dtype=np.int64),
+                             "nat": np.array(["n0", "n1", "n2"])}),
+                   str(d / "supp.parquet"))
+    ctx = BallistaContext.local()
+    for t in ("li", "part", "supp"):
+        ctx.register_parquet(t, str(d / f"{t}.parquet"))
+    out = ctx.sql(
+        "select p.grp, s.nat, sum(l.qty) as q from li l "
+        "join part p on l.pk = p.pk join supp s on l.sk = s.sk "
+        "group by p.grp, s.nat order by p.grp, s.nat").to_pandas()
+    assert out.q.sum() == 10
+    assert set(out.grp) == {"g0", "g1"}
