@@ -154,6 +154,13 @@ class Schema:
     def names(self) -> list[str]:
         return [f.name for f in self.fields]
 
+    def row_byte_width(self) -> int:
+        """Physical bytes one row occupies in device form (columns + the
+        liveness mask byte).  The ONE estimator behind every memory-budget
+        decision (join chunk trigger, auto-partition floor) — keep them
+        consistent by using this, not a hand-rolled sum."""
+        return sum(f.dtype.np_dtype.itemsize for f in self.fields) + 1
+
     def field(self, name: str) -> Field:
         try:
             return self.fields[self._index[name]]

@@ -930,15 +930,31 @@ class JoinExec(ExecutionPlan):
             hi = jnp.searchsorted(bh_sorted, ph, side="right")
             return jnp.sum(jnp.where(pmask, hi - lo, 0))
 
+        def wcount_fn(pcols, pmask, bh_sorted, laux, chunk_rows, n_windows):
+            # per-window candidate counts for the budget-chunked probe
+            # loop: ONE program + ONE host transfer for every window
+            # (a per-window scalar sync would cost ~75 ms each on
+            # remote-attached devices)
+            pk = [c.fn(pcols, laux) for c in lkeys]
+            ph = K.hash64(pk)
+            lo = jnp.searchsorted(bh_sorted, ph, side="left")
+            hi = jnp.searchsorted(bh_sorted, ph, side="right")
+            per_row = jnp.where(pmask, hi - lo, 0)
+            wid = (jnp.arange(pmask.shape[0], dtype=jnp.int32)
+                   // jnp.int32(chunk_rows))
+            return jax.ops.segment_sum(per_row, wid,
+                                       num_segments=n_windows)
+
         return (lcomp, rcomp, fcomp,
                 jax.jit(join_fn, static_argnums=(9,)),
-                jax.jit(count_fn), jax.jit(prep_fn))
+                jax.jit(count_fn), jax.jit(prep_fn),
+                jax.jit(wcount_fn, static_argnums=(4, 5)))
 
     def _out_row_bytes(self) -> int:
-        return sum(f.dtype.np_dtype.itemsize for f in self._schema) + 1
+        return self._schema.row_byte_width()
 
     def _join_device(self, ctx, probe, build, lsch, rsch):
-        lcomp, rcomp, fcomp, jfn, cfn, pfn = self._compiled
+        lcomp, rcomp, fcomp, jfn, cfn, pfn, _ = self._compiled
 
         laux = lcomp.aux_arrays(probe.dicts)
         raux = rcomp.aux_arrays(build.dicts)
@@ -1055,7 +1071,7 @@ class JoinExec(ExecutionPlan):
         most of the matches still allocates its real match count — the
         overrun is bounded by that window's genuine output size (which must
         be materialized regardless), not by fan-out across the whole probe."""
-        lcomp, rcomp, fcomp, jfn, cfn, pfn = self._compiled
+        lcomp, rcomp, fcomp, jfn, cfn, pfn, wcfn = self._compiled
         cap = probe.capacity
         width = self._out_row_bytes()
         want = max(1, -(-planned_cap * width // budget))
@@ -1076,12 +1092,16 @@ class JoinExec(ExecutionPlan):
         dicts = dict(probe.dicts)
         if self.join_type == "inner":
             dicts.update(build.dicts)
+        # all window counts in ONE program + ONE host transfer (per-window
+        # scalar syncs would cost ~75 ms each on remote-attached devices)
+        window_counts = np.asarray(wcfn(probe.columns, probe.mask, bh_sorted,
+                                        laux, chunk_rows, chunks))
         grand_total = 0  # the cross-join guard must see the SUM of windows
         for i in range(chunks):
             ctx.check_cancelled()
             pmask_c = _window_mask(probe.mask, i * chunk_rows,
                                    min((i + 1) * chunk_rows, cap))
-            total_c = int(cfn(probe.columns, pmask_c, bh_sorted, laux))
+            total_c = int(window_counts[i])
             grand_total += total_c
             if grand_total > ceiling:
                 raise CapacityError(

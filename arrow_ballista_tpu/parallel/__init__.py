@@ -7,8 +7,10 @@ Replaces the reference's Arrow Flight data plane for co-located executors
 from .mesh import PART_AXIS, make_mesh, mesh_axis_size, replicated, row_sharding
 from .ici_shuffle import all_to_all_rows, dispatch_to_buckets, shuffle_rows
 from .distributed import (
+    distributed_broadcast_join,
     distributed_filter_aggregate,
     distributed_grouped_aggregate,
+    distributed_hash_join,
 )
 
 __all__ = [
@@ -20,6 +22,8 @@ __all__ = [
     "all_to_all_rows",
     "dispatch_to_buckets",
     "shuffle_rows",
+    "distributed_broadcast_join",
     "distributed_filter_aggregate",
     "distributed_grouped_aggregate",
+    "distributed_hash_join",
 ]

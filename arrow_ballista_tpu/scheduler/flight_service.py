@@ -60,10 +60,12 @@ def _write_varint(v: int) -> bytes:
             return bytes(out)
 
 
-def pb_decode(data: bytes) -> Dict[int, List[bytes]]:
-    """field number -> list of raw length-delimited payloads.  Non-LEN
-    fields are skipped (none of the messages we speak use them)."""
-    out: Dict[int, List[bytes]] = {}
+def pb_decode(data: bytes) -> Dict[int, List]:
+    """field number -> list of values: raw bytes for length-delimited
+    fields, int for varint fields (bools like include_schema arrive as
+    wire-type 0 — skipping them loses real driver flags).  64/32-bit
+    fixed fields are skipped (none of the messages we speak use them)."""
+    out: Dict[int, List] = {}
     i = 0
     while i < len(data):
         key, i = _read_varint(data, i)
@@ -72,8 +74,9 @@ def pb_decode(data: bytes) -> Dict[int, List[bytes]]:
             n, i = _read_varint(data, i)
             out.setdefault(field, []).append(data[i:i + n])
             i += n
-        elif wire == 0:  # varint — skip
-            _, i = _read_varint(data, i)
+        elif wire == 0:  # varint
+            v, i = _read_varint(data, i)
+            out.setdefault(field, []).append(v)
         elif wire == 1:  # 64-bit — skip
             i += 8
         elif wire == 5:  # 32-bit — skip
@@ -142,8 +145,10 @@ class BallistaFlightServer:
                 return outer._get_flight_info(descriptor)
 
             def get_schema(self, context, descriptor):
-                sql = outer._sql_of_command(bytes(descriptor.command))
-                return fl.SchemaResult(outer._plan_schema(sql))
+                kind, payload = outer._command_kind(bytes(descriptor.command))
+                if kind == "meta":
+                    return fl.SchemaResult(outer._meta_table(*payload).schema)
+                return fl.SchemaResult(outer._plan_schema(payload))
 
             def do_get(self, context, ticket):
                 return outer._do_get(bytes(ticket.ticket))
@@ -178,27 +183,154 @@ class BallistaFlightServer:
         except Exception:  # noqa: BLE001 — shutdown is best-effort
             log.debug("flight server shutdown", exc_info=True)
 
+    # --- metadata commands (the JDBC/ADBC connect sequence) --------------
+    # Every Flight SQL driver issues these on connect, before any query
+    # (reference flight_sql.rs get_flight_info_sql_info/_catalogs/
+    # _schemas/_tables/_table_types); the standard result schemas are
+    # fixed by the Flight SQL spec.
+    _META_COMMANDS = ("CommandGetSqlInfo", "CommandGetCatalogs",
+                      "CommandGetDbSchemas", "CommandGetTables",
+                      "CommandGetTableTypes")
+    CATALOG_NAME = "ballista"
+    DB_SCHEMA_NAME = "public"
+
+    def _meta_table(self, name: str, value: bytes):
+        import pyarrow as pa
+
+        if name == "CommandGetCatalogs":
+            return pa.table({"catalog_name": pa.array([self.CATALOG_NAME],
+                                                      type=pa.string())})
+        if name == "CommandGetDbSchemas":
+            return pa.table({
+                "catalog_name": pa.array([self.CATALOG_NAME], type=pa.string()),
+                "db_schema_name": pa.array([self.DB_SCHEMA_NAME],
+                                           type=pa.string())})
+        if name == "CommandGetTableTypes":
+            return pa.table({"table_type": pa.array(["TABLE"],
+                                                    type=pa.string())})
+        if name == "CommandGetTables":
+            # FlightSql.proto CommandGetTables: catalog=1,
+            # db_schema_filter_pattern=2, table_name_filter_pattern=3,
+            # table_types=4 (repeated string), include_schema=5 (bool)
+            f = pb_decode(value)
+
+            def _like(pattern: str):
+                import re as _re
+
+                return _re.compile(
+                    "^" + _re.escape(pattern).replace("%", ".*")
+                    .replace("_", ".") + "$", _re.IGNORECASE)
+
+            names = sorted(self.svc.catalog.table_names())
+            catalog = f[1][0].decode("utf-8") if 1 in f else None
+            if catalog not in (None, "", self.CATALOG_NAME):
+                names = []
+            if 2 in f and not _like(f[2][0].decode("utf-8")).match(
+                    self.DB_SCHEMA_NAME):
+                names = []
+            if 3 in f:
+                rx = _like(f[3][0].decode("utf-8"))
+                names = [n for n in names if rx.match(n)]
+            if 4 in f:  # repeated table-type filter
+                types = {t.decode("utf-8").upper() for t in f[4]}
+                if "TABLE" not in types:
+                    names = []
+            include_schema = bool(f[5][0]) if 5 in f else False
+            cols = {
+                "catalog_name": pa.array([self.CATALOG_NAME] * len(names),
+                                         type=pa.string()),
+                "db_schema_name": pa.array([self.DB_SCHEMA_NAME] * len(names),
+                                           type=pa.string()),
+                "table_name": pa.array(names, type=pa.string()),
+                "table_type": pa.array(["TABLE"] * len(names),
+                                       type=pa.string()),
+            }
+            if include_schema:
+                blobs = []
+                for n in names:
+                    sch = logical_arrow_schema(
+                        self.svc.catalog.provider(n).schema)
+                    blobs.append(sch.serialize().to_pybytes())
+                cols["table_schema"] = pa.array(blobs, type=pa.binary())
+            return pa.table(cols)
+        if name == "CommandGetSqlInfo":
+            # spec schema: info_name uint32, value dense_union of
+            # (string, bool, int64, int32, list<utf8>, map<int32,list<int32>>)
+            from .. import __version__ as _ver
+
+            info = {
+                0: "arrow-ballista-tpu",          # FLIGHT_SQL_SERVER_NAME
+                1: str(_ver),                     # FLIGHT_SQL_SERVER_VERSION
+                2: pa.__version__,                # FLIGHT_SQL_SERVER_ARROW_VERSION
+            }
+            f = pb_decode(value)
+            # requested info ids: packed (one LEN payload of varints) or
+            # unpacked repeated uint32 (ints straight from the decoder)
+            wanted = None
+            if 1 in f:
+                wanted = set()
+                for payload in f[1]:
+                    if isinstance(payload, int):
+                        wanted.add(payload)
+                        continue
+                    i = 0
+                    while i < len(payload):
+                        v, i = _read_varint(payload, i)
+                        wanted.add(v)
+            rows = [(k, v) for k, v in sorted(info.items())
+                    if wanted is None or k in wanted]
+            union_type = pa.dense_union([
+                pa.field("string_value", pa.string()),
+                pa.field("bool_value", pa.bool_()),
+                pa.field("bigint_value", pa.int64()),
+                pa.field("int32_bitmask", pa.int32()),
+                pa.field("string_list", pa.list_(pa.string())),
+                pa.field("int32_to_int32_list_map",
+                         pa.map_(pa.int32(), pa.list_(pa.int32()))),
+            ])
+            types = pa.array([0] * len(rows), type=pa.int8())
+            offsets = pa.array(range(len(rows)), type=pa.int32())
+            strings = pa.array([v for _, v in rows], type=pa.string())
+            empty = [pa.array([], type=t.type) for t in list(union_type)[1:]]
+            union = pa.UnionArray.from_dense(types, offsets,
+                                             [strings, *empty],
+                                             [t.name for t in union_type])
+            return pa.table({
+                "info_name": pa.array([k for k, _ in rows], type=pa.uint32()),
+                "value": union})
+        raise self._fl.FlightServerError(f"unsupported metadata command {name}")
+
     # --- command parsing -------------------------------------------------
-    def _sql_of_command(self, cmd: bytes) -> str:
-        """SQL text from a descriptor command: an Any-wrapped Flight SQL
-        message, or raw SQL bytes (the stock-pyarrow-client path)."""
+    def _command_kind(self, cmd: bytes):
+        """(kind, payload): ('meta', (name, value)) for metadata commands,
+        ('sql', text) for query commands."""
         try:
             name, value = any_unwrap(cmd)
         except Exception:  # noqa: BLE001 — not protobuf: plain SQL bytes
-            return cmd.decode("utf-8")
-        if name in ("CommandStatementQuery",):
-            f = pb_decode(value)
-            return f[1][0].decode("utf-8")
-        if name in ("CommandPreparedStatementQuery",):
+            return "sql", cmd.decode("utf-8")
+        if name in self._META_COMMANDS:
+            return "meta", (name, value)
+        if name == "CommandStatementQuery":
+            return "sql", pb_decode(value)[1][0].decode("utf-8")
+        if name == "CommandPreparedStatementQuery":
             handle = pb_decode(value)[1][0]
             with self._lock:
                 sql = self._prepared.get(handle)
             if sql is None:
                 raise self._fl.FlightServerError(
                     f"unknown prepared statement handle {handle!r}")
-            return sql
+            return "sql", sql
         raise self._fl.FlightServerError(
             f"unsupported Flight SQL command {name}")
+
+    def _sql_of_command(self, cmd: bytes) -> str:
+        """SQL text from a descriptor command: an Any-wrapped Flight SQL
+        message, or raw SQL bytes (the stock-pyarrow-client path)."""
+        kind, payload = self._command_kind(cmd)
+        if kind != "sql":
+            raise self._fl.FlightServerError(
+                f"metadata command {payload[0]} carries no SQL")
+        return payload
 
     def _sql_of_ticket(self, raw: bytes) -> str:
         try:
@@ -295,18 +427,32 @@ class BallistaFlightServer:
 
     def _get_flight_info(self, descriptor):
         fl = self._fl
-        sql = self._sql_of_command(bytes(descriptor.command))
-        schema = self._plan_schema(sql)
-        # the ticket round-trips through the client verbatim (JDBC sends it
-        # back as-is): Any(TicketStatementQuery{statement_handle=sql})
-        ticket = fl.Ticket(any_wrap(
-            "TicketStatementQuery", pb_field(1, sql.encode())))
+        cmd = bytes(descriptor.command)
+        kind, payload = self._command_kind(cmd)
+        if kind == "meta":
+            # metadata flows: the ticket is the command itself, round-tripped
+            # verbatim (exactly how the JDBC driver replays it to do_get)
+            schema = self._meta_table(*payload).schema
+            ticket = fl.Ticket(cmd)
+        else:
+            sql = payload
+            schema = self._plan_schema(sql)
+            # the ticket round-trips through the client verbatim (JDBC sends
+            # it back as-is): Any(TicketStatementQuery{statement_handle=sql})
+            ticket = fl.Ticket(any_wrap(
+                "TicketStatementQuery", pb_field(1, sql.encode())))
         endpoint = fl.FlightEndpoint(ticket, [
             fl.Location.for_grpc_tcp(self.host, self.port)])
         return fl.FlightInfo(schema, descriptor, [endpoint], -1, -1)
 
     def _do_get(self, raw_ticket: bytes):
         fl = self._fl
+        try:
+            name, value = any_unwrap(raw_ticket)
+        except Exception:  # noqa: BLE001
+            name = value = None
+        if name in self._META_COMMANDS:
+            return fl.RecordBatchStream(self._meta_table(name, value))
         sql = self._sql_of_ticket(raw_ticket)
         table = self._execute_to_table(sql)
         return fl.RecordBatchStream(table)

@@ -117,8 +117,7 @@ class PhysicalPlanner:
                         # node.schema is the PROJECTED scan schema
                         # (projection pushdown already ran), so the width
                         # reflects the columns a task actually holds
-                        row_bytes = sum(f.dtype.np_dtype.itemsize
-                                        for f in node.schema) + 1
+                        row_bytes = node.schema.row_byte_width()
                     except Exception:  # noqa: BLE001
                         row_bytes = 64
             for c in node.children():
