@@ -123,7 +123,8 @@ class ExecutorServer:
                  external_host: Optional[str] = None,
                  policy: str = "push",
                  job_data_ttl_s: float = 3600.0,
-                 janitor_interval_s: float = 300.0):
+                 janitor_interval_s: float = 300.0,
+                 flight_port: int = -1):
         import socket as socketmod
         import tempfile
         import uuid
@@ -177,6 +178,16 @@ class ExecutorServer:
         self._janitor_thread: Optional[threading.Thread] = None
         self._plan_cache = StagePlanCache()
 
+        # optional standard Arrow Flight door (reference
+        # flight_service.rs:82-120): any stock Arrow client can do_get a
+        # shuffle partition; peers keep using the native/RPC plane
+        self.flight = None
+        if flight_port >= 0:
+            from .flight_service import ExecutorFlightServer
+
+            self.flight = ExecutorFlightServer(self.work_dir, self._dp_token,
+                                               host, flight_port)
+
         self.rpc.register("launch_multi_task", self._launch_multi_task)
         self.rpc.register("cancel_tasks", self._cancel_tasks)
         self.rpc.register("fetch_partition", self._fetch_partition)
@@ -187,6 +198,8 @@ class ExecutorServer:
     # --- lifecycle -------------------------------------------------------
     def start(self, register: bool = True) -> None:
         self.rpc.start()
+        if self.flight is not None:
+            self.flight.start()
         if register:
             self.scheduler.register_executor(self.metadata)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
@@ -292,6 +305,8 @@ class ExecutorServer:
                 pass
         self.executor.shutdown()
         self.rpc.stop()
+        if self.flight is not None:
+            self.flight.stop()
         if self._native_dp is not None:
             self._native_dp.dp_stop()
             self._native_dp = None

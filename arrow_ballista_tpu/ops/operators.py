@@ -406,7 +406,8 @@ class HashAggregateExec(ExecutionPlan):
         big = concat_batches(in_schema, batches).shrink()
 
         if self.mode == "partial" and self.group_exprs \
-                and getattr(self, "_passthrough", False):
+                and getattr(self, "_passthrough", False) \
+                and getattr(self, "clustered", None) is None:
             # adaptive partial-agg skip (DataFusion does the same): when a
             # sibling task observed near-no reduction (high-cardinality
             # keys like l_orderkey), aggregating before the shuffle burns
@@ -422,7 +423,83 @@ class HashAggregateExec(ExecutionPlan):
         # concurrent first-calls of the shared jfn
         with self.xla_lock():
             self._ensure_compiled(ctx, in_schema)
-        return self._execute_device(ctx, cfg_cap, big)
+        out, disorder = self._execute_device(ctx, cfg_cap, big)
+        if self.mode == "partial" and getattr(self, "clustered", None) \
+                is not None:
+            filtered = [self._apply_clustered_filter(ctx, b, disorder)
+                        for b in out]
+            if any(f is None for f in filtered):
+                # stats promised clustering but rows inside a row group
+                # were unordered: latch off the presorted grouping,
+                # recompile the sorted path, redo (correctness first).
+                # _make_compiled returns the tuple so the shared instance
+                # is swapped atomically — concurrent tasks never see None.
+                self.metrics().add("presort_fallbacks", 1)
+                with self.xla_lock():
+                    self._no_presort = True
+                    self._compiled = self._make_compiled(ctx, in_schema)
+                out, _ = self._execute_device(ctx, cfg_cap, big)
+                filtered = [self._apply_clustered_filter(ctx, b, None)
+                            for b in out]
+            out = filtered
+        return out
+
+    def _apply_clustered_filter(self, ctx, result, disorder):
+        """Clustered group-by early-HAVING (see
+        scheduler/physical_planner.py _clustered_having_pushdown): the
+        input is clustered on the single group key, so this partition's
+        partial state is FINAL for every key outside the neighbor-overlap
+        windows — apply the downstream HAVING predicate here and ship only
+        survivors plus the (few) window keys.  Collapses q18's 15M-state
+        exchange to ~hundreds of rows."""
+        pred_expr, intervals = self.clustered
+        with self.xla_lock():
+            if getattr(self, "_cl_compiled", None) is None:
+                comp = ExprCompiler(self._schema, "device")
+                pred = comp.compile_pred(
+                    _substitute_scalars(pred_expr, ctx.scalars))
+                key_name = self.group_exprs[0][1]
+
+                def keep_fn(cols, mask, aux, los, his):
+                    k = cols[key_name]
+                    shared = jnp.any(
+                        (k[:, None] >= los[None, :])
+                        & (k[:, None] <= his[None, :]), axis=1)
+                    keep = mask & (shared | pred.fn(cols, aux))
+                    # live count rides along: the result is tiny by
+                    # construction, so one scalar sync buys a shrink that
+                    # saves the shuffle writer a full-capacity repartition
+                    return keep, jnp.sum(keep)
+
+                # pad the window vectors to a power of two so every
+                # partition (and every instance at this schema) shares one
+                # compiled shape
+                from ..models.batch import round_capacity as _rc
+
+                n = max(1, len(intervals))
+                padn = _rc(n, 4)
+                los = np.full(padn, 1, dtype=np.int64)
+                his = np.full(padn, 0, dtype=np.int64)  # empty: lo > hi
+                for i, (lo, hi) in enumerate(intervals):
+                    los[i], his[i] = lo, hi
+                self._cl_compiled = (comp, jax.jit(keep_fn),
+                                     jnp.asarray(los), jnp.asarray(his))
+        comp, keep_fn, los, his = self._cl_compiled
+        aux = comp.aux_arrays(result.dicts)
+        new_mask, live = keep_fn(result.columns, result.mask, aux, los, his)
+        if disorder is not None:
+            # ONE device->host roundtrip for both scalars (device_get
+            # batches pytree leaves — a separate bool() + int() would pay
+            # the ~75 ms fixed transfer latency twice per task)
+            live_v, dis_v = jax.device_get((live, disorder))
+            if bool(dis_v):
+                return None  # caller re-runs the sorted path
+        else:
+            live_v = int(live)
+        self.metrics().add("clustered_early_filters", 1)
+        out = ColumnBatch(result.schema, result.columns, new_mask,
+                          result.dicts, num_rows=int(live_v))
+        return out.shrink()
 
     def _execute_passthrough(self, ctx, big, in_schema):
         with self.xla_lock():
@@ -482,23 +559,39 @@ class HashAggregateExec(ExecutionPlan):
             deferred_rows(self.metrics(), "output_rows", result)
         return [result]
 
+    def _presorted(self) -> bool:
+        """Clustered single-key partials group WITHOUT sorting (input is in
+        key order by construction; kernels.grouped_aggregate_presorted) —
+        on TPU the sort program is the one that compiles for minutes.
+        ``_no_presort`` latches after a runtime disorder detection."""
+        return (self.mode == "partial"
+                and getattr(self, "clustered", None) is not None
+                and len(self.group_exprs) == 1
+                and not getattr(self, "_no_presort", False))
+
+    def _make_compiled(self, ctx, in_schema):
+        """Build (or fetch shared) compiled closures and RETURN them —
+        callers assign to self._compiled in one atomic statement so
+        concurrent tasks never observe a half-published state."""
+        all_exprs = [e for e, _ in self.group_exprs] + \
+            [a.operand for a in self.aggs]
+        if not has_scalar_subquery(*all_exprs):
+            # job-independent program: share across jobs (re-running a
+            # query re-traces every program otherwise, ~0.2 s each on
+            # the remote TPU backend)
+            key = ("agg", self.mode, self._presorted(),
+                   schema_sig(in_schema),
+                   exprs_sig([e for e, _ in self.group_exprs]),
+                   tuple(n for _, n in self.group_exprs),
+                   tuple((a.func, a.name) for a in self.aggs),
+                   exprs_sig([a.operand for a in self.aggs]))
+            return shared_program(
+                key, lambda: self._build_compiled(ctx, in_schema))
+        return self._build_compiled(ctx, in_schema)
+
     def _ensure_compiled(self, ctx, in_schema):
         if self._compiled is None:
-            all_exprs = [e for e, _ in self.group_exprs] + \
-                [a.operand for a in self.aggs]
-            if not has_scalar_subquery(*all_exprs):
-                # job-independent program: share across jobs (re-running a
-                # query re-traces every program otherwise, ~0.2 s each on
-                # the remote TPU backend)
-                key = ("agg", self.mode, schema_sig(in_schema),
-                       exprs_sig([e for e, _ in self.group_exprs]),
-                       tuple(n for _, n in self.group_exprs),
-                       tuple((a.func, a.name) for a in self.aggs),
-                       exprs_sig([a.operand for a in self.aggs]))
-                self._compiled = shared_program(
-                    key, lambda: self._build_compiled(ctx, in_schema))
-            else:
-                self._compiled = self._build_compiled(ctx, in_schema)
+            self._compiled = self._make_compiled(ctx, in_schema)
 
     def _build_compiled(self, ctx, in_schema):
         comp = ExprCompiler(in_schema, "device")
@@ -520,6 +613,8 @@ class HashAggregateExec(ExecutionPlan):
         # count, so an all-NULL group can be restored to NULL afterwards
         tracked = [i for i, (cc, how, _, nc) in enumerate(agg_c)
                    if nc is not None and how in ("sum", "min", "max")]
+
+        presorted = self._presorted()
 
         def agg_fn(cols, mask, aux, out_cap, key_ranges):
             # literal keys/operands compile to scalars; kernels index
@@ -552,6 +647,9 @@ class HashAggregateExec(ExecutionPlan):
                 vals.append((v, how))
             for i in tracked:
                 vals.append((valids[i].astype(jnp.int64), K.AGG_SUM))
+            if presorted:
+                return K.grouped_aggregate_presorted(keys, vals, mask,
+                                                     out_cap)
             return K.grouped_aggregate(keys, vals, mask, out_cap,
                                        key_ranges=key_ranges)
 
@@ -600,11 +698,17 @@ class HashAggregateExec(ExecutionPlan):
         domain = K.dense_domain(key_ranges)
         if domain is not None:
             out_cap = min(out_cap, domain)
+        disorder = None
         with self.metrics().timer("agg_time"):
             aux = comp.aux_arrays(big.dicts)
             while True:
-                out_keys, out_vals, out_mask, overflow = jfn(
-                    big.columns, big.mask, aux, out_cap, key_ranges)
+                res = jfn(big.columns, big.mask, aux, out_cap, key_ranges)
+                if len(res) == 5:  # presorted path carries a disorder flag
+                    # NOT synced here: the clustered filter fetches it
+                    # together with its live count in one roundtrip
+                    out_keys, out_vals, out_mask, overflow, disorder = res
+                else:
+                    out_keys, out_vals, out_mask, overflow = res
                 # overflow None == statically impossible (kernel proved
                 # out_cap bounds the group count): skip the flag check — a
                 # scalar sync costs ~75 ms per task on remote devices
@@ -693,7 +797,7 @@ class HashAggregateExec(ExecutionPlan):
             self.metrics().add("output_rows", _finish())
         else:
             self.metrics().add_deferred("output_rows", _finish)
-        return [result]
+        return [result], disorder
 
     def _label(self):
         g = ", ".join(n for _, n in self.group_exprs)
@@ -998,8 +1102,16 @@ class JoinExec(ExecutionPlan):
                     f"join produced {total_est} candidate pairs, above the "
                     f"{ceiling}-row ceiling; likely an accidental near-cross "
                     f"join — check join keys, or raise {JOIN_MAX_CAPACITY}")
-            out_cap = max(64, 1 << max(0, total_est - 1).bit_length(),
-                          probe.capacity // 4)
+            # two capacity buckets per probe shape: selective joins (the
+            # common case after semi/HAVING reductions) share the LOW
+            # bucket instead of gathering cap//4-row buffers for a handful
+            # of matches; everything else shares the cap//4 bucket
+            low_floor = max(64, probe.capacity // 64)
+            if total_est <= low_floor:
+                out_cap = low_floor
+            else:
+                out_cap = max(1 << max(0, total_est - 1).bit_length(),
+                              probe.capacity // 4)
             if out_cap > ceiling:
                 out_cap = max(total_est, 64)
             # memory control (VERDICT r4 #6): when the expansion working set

@@ -829,6 +829,67 @@ class ParquetScanExec(ScanExec):
     def row_count_estimate(self) -> int:
         return self._total_rows
 
+    def clustered_ranges(self, col_name: str):
+        """If the data is CLUSTERED on ``col_name`` (per-row-group min/max
+        stats non-decreasing in row order), regroup this scan's partitions
+        into contiguous row-group runs and return the per-partition
+        (min, max) key ranges; else None.
+
+        Basis of the clustered group-by early-HAVING rewrite
+        (scheduler/physical_planner.py): for a clustered key, a partial
+        aggregate over a contiguous partition is already FINAL for every
+        key except those in range overlaps between neighboring partitions.
+        The reference has no analog — DataFusion's partial/final agg split
+        (the reference's stage shape for q18's subquery) always ships every
+        partial state through the exchange."""
+        from ..utils import object_store as obs
+
+        units = sorted(u for g in self.groups for u in g)
+        if len(units) <= 1 or not units:
+            return None
+        stats_per_unit = []
+        for f, rg, _rows in units:
+            pf = obs.parquet_file(f)
+            meta = pf.metadata
+            idx = None
+            for i in range(meta.num_columns):
+                if meta.schema.column(i).name == col_name:
+                    idx = i
+                    break
+            if idx is None:
+                return None
+            st = meta.row_group(rg).column(idx).statistics
+            if st is None or not st.has_min_max:
+                return None
+            if not isinstance(st.min, int) or not isinstance(st.max, int):
+                return None  # int keys only (exact, order-stable)
+            stats_per_unit.append((st.min, st.max))
+        # clustered iff unit ranges are non-decreasing in row order
+        for (lo_a, hi_a), (lo_b, hi_b) in zip(stats_per_unit,
+                                              stats_per_unit[1:]):
+            if hi_a > lo_b:
+                return None
+        # contiguous regroup at the same partition count, row-balanced
+        k = len(self.groups)
+        total = sum(u[2] for u in units)
+        per = max(1, -(-total // k))
+        new_groups, new_ranges = [], []
+        cur, cur_rows, cur_lo, cur_hi = [], 0, None, None
+        for u, (lo, hi) in zip(units, stats_per_unit):
+            cur.append(u)
+            cur_rows += u[2]
+            cur_lo = lo if cur_lo is None else min(cur_lo, lo)
+            cur_hi = hi if cur_hi is None else max(cur_hi, hi)
+            if cur_rows >= per and len(new_groups) < k - 1:
+                new_groups.append(cur)
+                new_ranges.append((cur_lo, cur_hi))
+                cur, cur_rows, cur_lo, cur_hi = [], 0, None, None
+        if cur:
+            new_groups.append(cur)
+            new_ranges.append((cur_lo, cur_hi))
+        self.groups = new_groups
+        return new_ranges
+
     def _label(self):
         pruned = f", {self.pruned_row_groups} row-groups pruned" if self.pruned_row_groups else ""
         n_units = sum(len(g) for g in self.groups)

@@ -147,6 +147,9 @@ class PhysicalPlanner:
         self._scalars = []
         self._resolve_auto_partitions(logical)
         plan = self.create(logical)
+        self._clustered_having_pushdown(plan)
+        for _sid, sub in self._scalars:
+            self._clustered_having_pushdown(sub)
         return PlannedQuery(plan, list(self._scalars))
 
     def create(self, node: L.LogicalPlan) -> ExecutionPlan:
@@ -414,6 +417,89 @@ class PhysicalPlanner:
                 return MeshTaskJoinExec(lpart, rpart, on, node.join_type)
         return O.JoinExec(lpart, rpart, on, node.join_type, filt, dist="partitioned")
 
+    def _clustered_having_pushdown(self, plan: ExecutionPlan) -> None:
+        """Clustered group-by early-HAVING rewrite.
+
+        Pattern: Filter(pred) <- HashAgg(final) <- Repartition(hash keys)
+        <- HashAgg(partial) <- Rename* <- ParquetScan, with ONE int group
+        key whose parquet row-group stats prove the data is clustered on
+        it.  Then a contiguous-partition partial aggregate is already
+        FINAL for every key outside neighbor-overlap windows, so the
+        HAVING predicate applies in-task and the exchange ships only
+        survivors + window keys (q18 SF10: 15M states -> ~700 rows).
+
+        The reference cannot do this: DataFusion's AggregateExec split
+        (the stage shape behind reference planner.rs:133-152) has no
+        notion of scan clustering.  Static-shape engines WANT it — the
+        exchange is the expensive, dynamic part."""
+        from ..ops.physical import ParquetScanExec
+        from ..ops.shuffle import RepartitionExec as Rep
+
+        def walk(node):
+            for c in node.children():
+                walk(c)
+            if not isinstance(node, O.FilterExec) or node.host_mode:
+                return
+            agg_f = node.input
+            if not isinstance(agg_f, O.HashAggregateExec) \
+                    or agg_f.mode != "final":
+                return
+            rep = agg_f.input
+            if not isinstance(rep, Rep):
+                return
+            agg_p = rep.input
+            if not isinstance(agg_p, O.HashAggregateExec) \
+                    or agg_p.mode != "partial" \
+                    or getattr(agg_p, "clustered", None) is not None:
+                return
+            if len(agg_p.group_exprs) != 1:
+                return
+            ge, _gname = agg_p.group_exprs[0]
+            if not isinstance(ge, E.Column):
+                return
+            if any(a.func not in ("sum", "count", "min", "max")
+                   for a in agg_p.aggs):
+                return
+            pred = node.predicate
+            from ..ops.physical import has_scalar_subquery
+
+            if has_scalar_subquery(pred):
+                return
+            if not pred.column_refs() <= set(agg_p.schema.names()):
+                return
+            # resolve the group key through renames down to the scan column
+            child, col = agg_p.input, ge.name
+            while isinstance(child, O.RenameExec):
+                rev = {new: old for old, new in child._mapping}
+                if col not in rev:
+                    return
+                col = rev[col]
+                child = child.input
+            if not isinstance(child, ParquetScanExec):
+                return
+            try:
+                if child.schema.field(col).dtype.np_dtype.kind not in "iu":
+                    return
+            except Exception:  # noqa: BLE001
+                return
+            ranges = child.clustered_ranges(col)
+            if not ranges or len(ranges) <= 1:
+                return
+            intervals = [(lo_b, hi_a)
+                         for (_lo_a, hi_a), (lo_b, _hi_b)
+                         in zip(ranges, ranges[1:]) if lo_b <= hi_a]
+            field = child.schema.field(col)
+            if field.nullable:
+                # NULL keys ride the in-band sentinel, which parquet
+                # min/max stats exclude — NULL-group partials can split
+                # across partitions, so the sentinel must always ship
+                # through the exchange (never be early-filtered as final)
+                sent = int(field.dtype.null_sentinel)
+                intervals.append((sent, sent))
+            agg_p.clustered = (pred, intervals)
+
+        walk(plan)
+
     def _mesh_worthwhile(self, est_rows: int) -> bool:
         """Adaptive per-exchange transport choice (the VERDICT r4 ask: pick
         mesh vs file from the scheduler's size knowledge, the same family
@@ -429,6 +515,13 @@ class PhysicalPlanner:
             est = n if n is not None else 10_000_000
             return max(1, est // (4 if node.filters else 1))
         if isinstance(node, L.Filter):
+            if isinstance(node.input, L.Aggregate):
+                # HAVING over an aggregate is selective by design (same 1%
+                # convention as semi-join subqueries below; q18's HAVING
+                # keeps 673 of 15M groups).  This is what lets the
+                # orders x (HAVING subquery) join pick broadcast and skip
+                # shuffling the big probe side.
+                return max(1, self._estimate_rows(node.input) // 100)
             return max(1, self._estimate_rows(node.input) // 4)
         if isinstance(node, (L.Projection, L.SubqueryAlias, L.Sort)):
             return self._estimate_rows(node.input)

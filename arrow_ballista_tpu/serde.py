@@ -194,7 +194,12 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
         return {"t": "parquetscan", "schema": schema_to_obj(p.schema),
                 "files": p.files, "partitions": len(p.groups),
                 "filters": [expr_to_obj(f) for f in p.filters],
-                "table_schema": schema_to_obj(p.table_schema)}
+                "table_schema": schema_to_obj(p.table_schema),
+                # explicit (file, row-group, rows) grouping: the clustered
+                # group-by rewrite regroups partitions CONTIGUOUSLY and its
+                # range annotations are only valid for that exact grouping,
+                # so the executor must not re-derive a heap-balanced one
+                "groups": [[list(u) for u in g] for g in p.groups]}
     if isinstance(p, P.CsvScanExec):
         return {"t": "csvscan", "schema": schema_to_obj(p.schema),
                 "files": p.files, "partitions": p.output_partition_count(),
@@ -222,11 +227,16 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
         return {"t": "filter", "input": plan_to_obj(p.input),
                 "pred": expr_to_obj(p.predicate), "host": p.host_mode}
     if isinstance(p, O.HashAggregateExec):
-        return {"t": "agg", "input": plan_to_obj(p.input),
-                "groups": [[expr_to_obj(e), n] for e, n in p.group_exprs],
-                "aggs": [{"func": a.func, "operand": expr_to_obj(a.operand),
-                          "name": a.name} for a in p.aggs],
-                "mode": p.mode}
+        out = {"t": "agg", "input": plan_to_obj(p.input),
+               "groups": [[expr_to_obj(e), n] for e, n in p.group_exprs],
+               "aggs": [{"func": a.func, "operand": expr_to_obj(a.operand),
+                         "name": a.name} for a in p.aggs],
+               "mode": p.mode}
+        cl = getattr(p, "clustered", None)
+        if cl is not None:  # clustered early-HAVING annotation
+            out["clustered"] = {"pred": expr_to_obj(cl[0]),
+                                "intervals": [list(iv) for iv in cl[1]]}
+        return out
     if isinstance(p, O.JoinExec):
         return {"t": "join", "left": plan_to_obj(p.left),
                 "right": plan_to_obj(p.right),
@@ -288,10 +298,13 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
                                 o["partitions"],
                                 [expr_from_obj(f) for f in o["filters"]])
     if t == "parquetscan":
-        return P.ParquetScanExec(schema_from_obj(o["schema"]), o["files"],
+        scan = P.ParquetScanExec(schema_from_obj(o["schema"]), o["files"],
                                  o["partitions"],
                                  [expr_from_obj(f) for f in o["filters"]],
                                  table_schema=schema_from_obj(o["table_schema"]))
+        if o.get("groups"):
+            scan.groups = [[tuple(u) for u in g] for g in o["groups"]]
+        return scan
     if t == "csvscan":
         return P.CsvScanExec(schema_from_obj(o["schema"]), o["files"],
                              o["partitions"],
@@ -318,12 +331,17 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
         return O.FilterExec(plan_from_obj(o["input"]), expr_from_obj(o["pred"]),
                             host_mode=o.get("host", False))
     if t == "agg":
-        return O.HashAggregateExec(
+        agg = O.HashAggregateExec(
             plan_from_obj(o["input"]),
             [(expr_from_obj(e), n) for e, n in o["groups"]],
             [O.AggSpec(a["func"], expr_from_obj(a["operand"]), a["name"])
              for a in o["aggs"]],
             o["mode"])
+        if "clustered" in o:
+            cl = o["clustered"]
+            agg.clustered = (expr_from_obj(cl["pred"]),
+                             [tuple(iv) for iv in cl["intervals"]])
+        return agg
     if t == "join":
         return O.JoinExec(plan_from_obj(o["left"]), plan_from_obj(o["right"]),
                           [(expr_from_obj(l), expr_from_obj(r)) for l, r in o["on"]],

@@ -1,0 +1,216 @@
+"""Clustered group-by early-HAVING rewrite (q18's subquery shape).
+
+When parquet stats prove the scan is clustered on the single group key,
+partial aggregates over contiguous partitions are final for all keys
+outside neighbor-overlap windows, so the HAVING predicate applies
+in-task and the exchange ships ~nothing (physical_planner.py
+_clustered_having_pushdown).
+"""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+
+def _write_clustered(path, n_keys=5000, seed=3):
+    rng = np.random.default_rng(seed)
+    # 1-7 rows per key, rows sorted by key (lineitem-like clustering);
+    # small row groups so keys straddle row-group boundaries
+    reps = rng.integers(1, 8, n_keys)
+    keys = np.repeat(np.arange(n_keys, dtype=np.int64), reps)
+    qty = rng.integers(1, 50, len(keys)).astype(np.int64)
+    pq.write_table(pa.table({"k": keys, "q": qty}), path,
+                   row_group_size=1000)
+    return pd.DataFrame({"k": keys, "q": qty})
+
+
+SQL = ("select k, sum(q) as sq from t group by k "
+       "having sum(q) > 150 order by k")
+
+
+def _oracle(df):
+    g = df.groupby("k").q.sum()
+    g = g[g > 150]
+    return g
+
+
+@pytest.mark.parametrize("partitions", ["4", "auto"])
+def test_clustered_having_matches_oracle(tmp_path, partitions):
+    path = str(tmp_path / "t.parquet")
+    df = _write_clustered(path)
+    ctx = BallistaContext.standalone(
+        BallistaConfig({"ballista.shuffle.partitions": partitions}),
+        concurrent_tasks=2)
+    ctx.register_parquet("t", path)
+    out = ctx.sql(SQL).to_pandas()
+    ora = _oracle(df)
+    assert out.k.tolist() == ora.index.tolist()
+    assert out.sq.tolist() == ora.values.tolist()
+    # the rewrite actually engaged: the partial-agg stage's shuffle wrote
+    # only survivors + window keys, not every state
+    sched = ctx._standalone.scheduler
+    graph = sched.jobs.get_graph(list(sched.jobs._status)[-1])
+    wrote = []
+    early = 0
+    for st in graph.stages.values():
+        m = st.aggregate_metrics()
+        ef = sum(v for k, v in m.items()
+                 if k.endswith("clustered_early_filters"))
+        early += ef
+        if ef:
+            wrote.append(sum(v for k, v in m.items()
+                             if k.endswith("ShuffleWriterExec.output_rows")))
+    if partitions == "4":  # auto collapses this small table to 1 partition
+        assert early > 0, "rewrite did not engage"
+        survivors = len(_oracle(df))
+        assert wrote and sum(wrote) < survivors + 200  # vs ~5000 states
+    ctx.shutdown()
+
+
+def test_unclustered_data_bails_and_stays_correct(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, 5000, 20_000).astype(np.int64)  # NOT sorted
+    qty = rng.integers(1, 50, len(keys)).astype(np.int64)
+    pq.write_table(pa.table({"k": keys, "q": qty}), path, row_group_size=1000)
+    df = pd.DataFrame({"k": keys, "q": qty})
+    ctx = BallistaContext.standalone(
+        BallistaConfig({"ballista.shuffle.partitions": "4"}),
+        concurrent_tasks=2)
+    ctx.register_parquet("t", path)
+    out = ctx.sql(SQL).to_pandas()
+    ora = _oracle(df)
+    assert out.k.tolist() == ora.index.tolist()
+    assert out.sq.tolist() == ora.values.tolist()
+    sched = ctx._standalone.scheduler
+    graph = sched.jobs.get_graph(list(sched.jobs._status)[-1])
+    early = sum(v for st in graph.stages.values()
+                for k, v in st.aggregate_metrics().items()
+                if k.endswith("clustered_early_filters"))
+    assert early == 0  # unclustered: the rule must bail
+    ctx.shutdown()
+
+
+def test_serde_round_trips_annotation(tmp_path):
+    from arrow_ballista_tpu import serde
+    from arrow_ballista_tpu.catalog import SchemaCatalog, ParquetTable
+    from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+    from arrow_ballista_tpu.sql.optimizer import optimize
+    from arrow_ballista_tpu.sql.planner import SqlToRel
+    from arrow_ballista_tpu.sql.parser import parse_sql
+    from arrow_ballista_tpu.ops import operators as O
+
+    path = str(tmp_path / "t.parquet")
+    _write_clustered(path)
+    cat = SchemaCatalog()
+    cat.register(ParquetTable("t", path))
+    planned = PhysicalPlanner(cat, BallistaConfig(
+        {"ballista.shuffle.partitions": "4"})).plan_query(
+        optimize(SqlToRel(cat).plan(parse_sql(SQL))))
+
+    def find_clustered(p):
+        if isinstance(p, O.HashAggregateExec) \
+                and getattr(p, "clustered", None) is not None:
+            return p
+        for c in p.children():
+            got = find_clustered(c)
+            if got is not None:
+                return got
+        return None
+
+    agg = find_clustered(planned.plan)
+    assert agg is not None, "rewrite did not annotate the plan"
+    rt = serde.plan_from_obj(serde.plan_to_obj(planned.plan))
+    agg2 = find_clustered(rt)
+    assert agg2 is not None
+    assert agg2.clustered[1] == agg.clustered[1]
+    # the contiguous regrouping survives serde too
+    from arrow_ballista_tpu.ops.physical import ParquetScanExec
+
+    def find_scan(p):
+        if isinstance(p, ParquetScanExec):
+            return p
+        for c in p.children():
+            got = find_scan(c)
+            if got is not None:
+                return got
+        return None
+
+    assert find_scan(rt).groups == find_scan(planned.plan).groups
+
+
+def test_within_rowgroup_disorder_falls_back(tmp_path):
+    """Row-group stats can prove range disjointness while rows INSIDE a
+    group are unordered; the presorted grouping detects the disorder at
+    runtime and re-runs the sorted path — results stay exact."""
+    rng = np.random.default_rng(11)
+    parts = []
+    for lo in range(0, 5000, 1000):
+        block = np.repeat(np.arange(lo, lo + 1000, dtype=np.int64),
+                          rng.integers(1, 4, 1000))
+        rng.shuffle(block)  # disjoint rg ranges, unsorted inside
+        parts.append(block)
+    keys = np.concatenate(parts)
+    qty = rng.integers(1, 50, len(keys)).astype(np.int64)
+    path = str(tmp_path / "t.parquet")
+    writer = pq.ParquetWriter(path, pa.schema([("k", pa.int64()),
+                                               ("q", pa.int64())]))
+    off = 0
+    for block in parts:
+        n = len(block)
+        writer.write_table(pa.table({"k": keys[off:off+n],
+                                     "q": qty[off:off+n]}))
+        off += n
+    writer.close()
+    df = pd.DataFrame({"k": keys, "q": qty})
+    ctx = BallistaContext.standalone(
+        BallistaConfig({"ballista.shuffle.partitions": "4"}),
+        concurrent_tasks=2)
+    ctx.register_parquet("t", path)
+    out = ctx.sql(SQL).to_pandas()
+    ora = _oracle(df)
+    assert out.k.tolist() == ora.index.tolist()
+    assert out.sq.tolist() == ora.values.tolist()
+    sched = ctx._standalone.scheduler
+    graph = sched.jobs.get_graph(list(sched.jobs._status)[-1])
+    metrics = {k: v for st in graph.stages.values()
+               for k, v in st.aggregate_metrics().items()}
+    assert any(k.endswith("presort_fallbacks") and v > 0
+               for k, v in metrics.items()), metrics
+    ctx.shutdown()
+
+
+def test_null_keys_never_early_filtered(tmp_path):
+    """NULL keys ride an in-band sentinel that parquet stats exclude, so
+    NULL-group partials can split across partitions; the rewrite must ship
+    them through the exchange (sentinel interval), never treat a partial
+    NULL-group state as final."""
+    rng = np.random.default_rng(17)
+    keys = np.repeat(np.arange(4000, dtype=np.float64),
+                     rng.integers(1, 4, 4000))
+    # scatter NULLs throughout: each partition's null partial-sum stays
+    # under the HAVING threshold while the merged sum passes it
+    null_pos = np.arange(50, len(keys), len(keys) // 16)
+    keys[null_pos] = np.nan
+    qty = np.full(len(keys), 1, dtype=np.int64)
+    qty[null_pos] = 40  # 16 nulls x 40 = 640 total, ~160/partition
+    pa_keys = pa.array([None if np.isnan(v) else int(v) for v in keys],
+                       type=pa.int64())
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": pa_keys, "q": pa.array(qty)}), path,
+                   row_group_size=1000)
+    ctx = BallistaContext.standalone(
+        BallistaConfig({"ballista.shuffle.partitions": "4"}),
+        concurrent_tasks=2)
+    ctx.register_parquet("t", path)
+    out = ctx.sql("select k, sum(q) as sq from t group by k "
+                  "having sum(q) > 300 order by k").to_pandas()
+    # only the NULL group passes the threshold
+    assert len(out) == 1
+    assert np.isnan(out.k.iloc[0])
+    assert out.sq.iloc[0] == 16 * 40
+    ctx.shutdown()
