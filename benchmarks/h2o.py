@@ -1,0 +1,120 @@
+"""h2o/db-benchmark groupby suite (reference ships the equivalent scripts
+next to its TPC-H harness — reference README benchmarks section).
+
+Generates the db-benchmark G1 dataset shape (id1-id3 strings, id4-id6
+ints, v1-v3 values) and runs the standard groupby queries that map onto
+this engine's SQL surface (q6 median/sd, q8 window top-n and q9
+correlation need median/window/corr functions — reported as skipped, not
+silently dropped).
+
+Usage:
+  python -m benchmarks.h2o generate --rows 10000000 --groups 100 --out DIR
+  python -m benchmarks.h2o benchmark --data DIR [--iterations 2]
+Prints one JSON line per query and a summary line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+QUERIES = {
+    "q1": "select id1, sum(v1) as v1 from x group by id1",
+    "q2": "select id1, id2, sum(v1) as v1 from x group by id1, id2",
+    "q3": "select id3, sum(v1) as v1, avg(v3) as v3 from x group by id3",
+    "q4": ("select id4, avg(v1) as v1, avg(v2) as v2, avg(v3) as v3 "
+           "from x group by id4"),
+    "q5": ("select id6, sum(v1) as v1, sum(v2) as v2, sum(v3) as v3 "
+           "from x group by id6"),
+    "q7": ("select id3, max(v1) - min(v2) as range_v1_v2 from x "
+           "group by id3"),
+    "q10": ("select id1, id2, id3, id4, id5, id6, sum(v3) as v3, "
+            "count(*) as cnt from x group by id1, id2, id3, id4, id5, id6"),
+}
+SKIPPED = {"q6": "median/sd", "q8": "window top-n", "q9": "corr"}
+
+
+def generate(rows: int, groups: int, out: str) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(42)
+    os.makedirs(out, exist_ok=True)
+    n_small = groups
+    n_big = max(1, rows // groups)
+    # label lookup tables: format each distinct label once, then index —
+    # per-row f-strings would cost minutes of pure Python at 10M rows
+    small_labels = np.array([f"id{i:03d}" for i in range(1, n_small + 1)])
+    big_labels = np.array([f"id{i:010d}" for i in range(1, n_big + 1)])
+    t = pa.table({
+        "id1": small_labels[rng.integers(0, n_small, rows)],
+        "id2": small_labels[rng.integers(0, n_small, rows)],
+        "id3": big_labels[rng.integers(0, n_big, rows)],
+        "id4": rng.integers(1, n_small + 1, rows).astype(np.int64),
+        "id5": rng.integers(1, n_small + 1, rows).astype(np.int64),
+        "id6": rng.integers(1, n_big + 1, rows).astype(np.int64),
+        "v1": rng.integers(1, 6, rows).astype(np.int64),
+        "v2": rng.integers(1, 16, rows).astype(np.int64),
+        "v3": np.round(rng.uniform(0, 100, rows), 6),
+    })
+    pq.write_table(t, os.path.join(out, "x.parquet"),
+                   row_group_size=1 << 20)
+    print(f"wrote {rows} rows to {out}/x.parquet")
+
+
+def benchmark(data: str, iterations: int) -> None:
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    ctx = BallistaContext.standalone(
+        BallistaConfig({"ballista.shuffle.partitions": "auto"}),
+        concurrent_tasks=4)
+    ctx.register_parquet("x", os.path.join(data, "x.parquet"))
+    results = {}
+    for name, sql in QUERIES.items():
+        per = []
+        rows = 0
+        try:
+            for _ in range(iterations):
+                t0 = time.perf_counter()
+                out = ctx.sql(sql).collect()
+                rows = sum(b.num_rows for b in out)
+                per.append(time.perf_counter() - t0)
+            results[name] = {"ms": round(min(per) * 1000, 1), "rows": rows}
+        except Exception as e:  # noqa: BLE001 — record, keep benching
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps({"query": name, **results[name]}), flush=True)
+    for name, why in SKIPPED.items():
+        print(json.dumps({"query": name, "skipped": why}), flush=True)
+    ok = [r["ms"] for r in results.values() if "ms" in r]
+    print(json.dumps({
+        "metric": "h2o_groupby_total_ms",
+        "value": round(sum(ok), 1),
+        "queries_ok": len(ok), "queries_failed": len(results) - len(ok),
+        "skipped": list(SKIPPED),
+    }))
+    ctx.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    g = sub.add_parser("generate")
+    g.add_argument("--rows", type=int, default=10_000_000)
+    g.add_argument("--groups", type=int, default=100)
+    g.add_argument("--out", default=".bench_data/h2o-g1")
+    b = sub.add_parser("benchmark")
+    b.add_argument("--data", default=".bench_data/h2o-g1")
+    b.add_argument("--iterations", type=int, default=2)
+    args = ap.parse_args()
+    if args.cmd == "generate":
+        generate(args.rows, args.groups, args.out)
+    else:
+        benchmark(args.data, args.iterations)
+
+
+if __name__ == "__main__":
+    main()

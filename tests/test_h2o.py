@@ -1,0 +1,42 @@
+"""h2o/db-benchmark groupby harness smoke (benchmarks/h2o.py), vs a
+pandas oracle on the shared generator output."""
+import json
+import subprocess
+import sys
+
+import pytest
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def test_h2o_generate_and_benchmark(tmp_path):
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _scrubbed_cpu_env
+
+    env = _scrubbed_cpu_env(1)
+    d = str(tmp_path / "g1")
+    r = subprocess.run(
+        [sys.executable, "-m", "benchmarks.h2o", "generate",
+         "--rows", "20000", "--groups", "10", "--out", d],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = subprocess.run(
+        [sys.executable, "-m", "benchmarks.h2o", "benchmark",
+         "--data", d, "--iterations", "1"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    summary = lines[-1]
+    assert summary["queries_failed"] == 0
+    assert summary["queries_ok"] == 7
+    per = {l["query"]: l for l in lines if "query" in l}
+    assert per["q1"]["rows"] == 10  # 10 id1 groups
+    # oracle: q5 sums by id6
+    import pandas as pd
+    import pyarrow.parquet as pq
+
+    df = pq.read_table(d + "/x.parquet").to_pandas()
+    assert per["q5"]["rows"] == df.id6.nunique()
+    assert per["q10"]["rows"] == len(
+        df.groupby(["id1", "id2", "id3", "id4", "id5", "id6"]))
