@@ -41,15 +41,15 @@ _jax.config.update("jax_enable_x64", True)
 # queries AND processes.  Opt out with BALLISTA_XLA_CACHE=0 or point it
 # elsewhere with BALLISTA_XLA_CACHE=<dir>.
 _cache = _os.environ.get("BALLISTA_XLA_CACHE", "")
-if _cache != "0" and not (
-        not _cache
-        and _os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu"):
-    # cpu-forced processes skip the implicit cache: CPU compiles are cheap,
-    # and the cache's AOT entries are machine-feature-stamped — loading
-    # them emits a ~3KB LOG(ERROR) per entry (enough to fill a captured
-    # stdout pipe and freeze a daemon) and risks SIGILL when the host
-    # changes generations.  TPU keeps it (sort compiles cost 30-110s);
-    # set BALLISTA_XLA_CACHE=<dir> to opt a cpu process back in.
+if _cache != "0":
+    # CPU processes use the cache too (round 5): the host-CPU fingerprint
+    # in the cache path (below) keys entries per machine GENERATION, which
+    # removes the cross-migration hazards that once argued for skipping it
+    # (machine-feature-stamped AOT entries: ~3KB LOG(ERROR) per mismatched
+    # load — enough to fill a captured stdout pipe and freeze a daemon —
+    # and SIGILL risk).  And "CPU compiles are cheap" stopped being true:
+    # the migrating VM measured ~35s of first-run compiles for TPC-H q3.
+    # Disable with BALLISTA_XLA_CACHE=0, relocate with =<dir>.
     if not _cache:
         # per-platform dirs: entries carry machine-specific AOT artifacts
         # (a TPU-tunnel process compiles host programs on the REMOTE
