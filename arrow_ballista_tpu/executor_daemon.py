@@ -40,6 +40,16 @@ def main(argv=None) -> None:
                     choices=["minutely", "hourly", "daily", "never"])
     args = ap.parse_args(argv)
 
+    # XLA's C++ stderr (absl) logs bypass python logging; persistent-cache
+    # AOT loads emit a ~3KB benign feature-mismatch ERROR per program
+    # (prefer-no-* tuning pseudo-features never match the host probe) —
+    # enough to wedge a daemon whose stderr pipe nobody drains.  Daemons
+    # report operational errors through python logging, so silence the
+    # C++ channel unless the operator overrides.
+    import os as _os
+
+    _os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
     from .utils.logsetup import init_logging
 
     init_logging(args.log_level, args.log_dir, args.log_file_name_prefix,
