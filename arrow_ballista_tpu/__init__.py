@@ -42,6 +42,12 @@ _jax.config.update("jax_enable_x64", True)
 # elsewhere with BALLISTA_XLA_CACHE=<dir>.
 _cache = _os.environ.get("BALLISTA_XLA_CACHE", "")
 if _cache != "0":
+    # every persistent-cache AOT load emits a ~3KB benign ERROR pair on
+    # XLA's C++ stderr channel (the prefer-no-scatter/gather tuning
+    # pseudo-features never appear in the host probe, so same-machine
+    # entries still "mismatch").  Engine errors surface as Python
+    # exceptions; silence the C++ diagnostics unless the user overrides.
+    _os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
     # CPU processes use the cache too (round 5): the host-CPU fingerprint
     # in the cache path (below) keys entries per machine GENERATION, which
     # removes the cross-migration hazards that once argued for skipping it
