@@ -944,6 +944,11 @@ class JoinExec(ExecutionPlan):
         rnames = [f.name for f in rsch]
         rfill = {f.name: f.dtype.null_sentinel for f in rsch}
         lfill = {f.name: f.dtype.null_sentinel for f in lsch}
+        # pair filter: gather ONLY the columns the predicate references.
+        # q21's semi join (l2.suppkey <> l1.suppkey over ~7 build rows per
+        # orderkey) was gathering all ~20 lineitem columns into multi-M-row
+        # pair buffers to evaluate a 2-column predicate.
+        fnames = self.filter.column_refs() if self.filter is not None else set()
 
         def prep_fn(bcols, bmask, raux):
             # build-side hash + sort, hoisted out of the per-task probe:
@@ -975,8 +980,9 @@ class JoinExec(ExecutionPlan):
                 if rkey_valid[i] is not None:
                     ok = ok & rkey_valid[i](bcols, raux)[bidx]
             if fpred is not None:
-                pair_cols = {n: pcols[n][pi] for n in lnames}
-                pair_cols.update({n: bcols[n][bidx] for n in rnames})
+                pair_cols = {n: pcols[n][pi] for n in lnames if n in fnames}
+                pair_cols.update({n: bcols[n][bidx] for n in rnames
+                                  if n in fnames})
                 ok = ok & fpred.fn(pair_cols, faux)
 
             if jt in ("semi", "anti"):
