@@ -1,0 +1,44 @@
+"""bench.py parent-side merge logic: the rules that shape the driver's
+BENCH artifact (TPU headline whenever the TPU worker measured an engine
+query; CPU otherwise, with TPU partial evidence attached)."""
+import importlib.util
+import sys
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+spec = importlib.util.spec_from_file_location("bench_mod", REPO + "/bench.py")
+bench = importlib.util.module_from_spec(spec)
+sys.modules["bench_mod"] = bench
+spec.loader.exec_module(bench)
+
+
+CPU = {"metric": "tpch_q1_sf1_engine_rows_per_sec", "value": 100.0,
+       "unit": "rows/s", "vs_baseline": 0.5, "platform": "cpu",
+       "engine": {"q1_ms": 400.0}}
+
+
+def test_tpu_engine_wins_headline():
+    tpu = {"metric": "tpch_q1_sf1_engine_rows_per_sec", "value": 50.0,
+           "unit": "rows/s", "vs_baseline": 0.25, "platform": "tpu",
+           "engine": {"q1_ms": 800.0}}
+    out = bench._merge(CPU, tpu)
+    assert out["platform"] == "tpu"
+    assert out["value"] == 50.0
+    assert out["cpu"]["value"] == 100.0  # CPU evidence rides along
+
+
+def test_partial_tpu_attaches_to_cpu_headline():
+    tpu = {"metric": "tpch_q1_sf1_engine_rows_per_sec", "value": 0.0,
+           "unit": "rows/s", "vs_baseline": 0.0, "platform": "tpu",
+           "partial": "kernel-q1", "kernel_q1_ms": 12.0}
+    out = bench._merge(CPU, tpu)
+    assert out["platform"] == "cpu" and out["value"] == 100.0
+    assert out["tpu_partial"]["kernel_q1_ms"] == 12.0
+
+
+def test_each_side_alone_and_neither():
+    assert bench._merge(CPU, None)["platform"] == "cpu"
+    tpu = {"metric": "m", "value": 1.0, "unit": "rows/s", "vs_baseline": 0,
+           "platform": "tpu", "engine": {"q1_ms": 5.0}}
+    assert bench._merge(None, tpu)["platform"] == "tpu"
+    out = bench._merge(None, None)
+    assert out["value"] == 0.0 and "error" in out
