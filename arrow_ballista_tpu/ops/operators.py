@@ -425,23 +425,39 @@ class HashAggregateExec(ExecutionPlan):
             self._ensure_compiled(ctx, in_schema)
         out, disorder = self._execute_device(ctx, cfg_cap, big)
         if self.mode == "partial" and getattr(self, "clustered", None) \
+                is not None and self.clustered[0] is None:
+            # presorted-only clustering: no early filter, but the disorder
+            # flag must still gate.  The scalar sync costs ~75 ms/task on
+            # remote devices — a deliberate trade against the sort-program
+            # family it replaces, which COMPILES 30-110 s per shape on the
+            # TPU backend (capacity ladders mint several shapes per query)
+            if disorder is not None and bool(disorder):
+                out = self._latch_sorted_fallback(ctx, in_schema, cfg_cap,
+                                                  big)
+            return out
+        if self.mode == "partial" and getattr(self, "clustered", None) \
                 is not None:
             filtered = [self._apply_clustered_filter(ctx, b, disorder)
                         for b in out]
             if any(f is None for f in filtered):
-                # stats promised clustering but rows inside a row group
-                # were unordered: latch off the presorted grouping,
-                # recompile the sorted path, redo (correctness first).
-                # _make_compiled returns the tuple so the shared instance
-                # is swapped atomically — concurrent tasks never see None.
-                self.metrics().add("presort_fallbacks", 1)
-                with self.xla_lock():
-                    self._no_presort = True
-                    self._compiled = self._make_compiled(ctx, in_schema)
-                out, _ = self._execute_device(ctx, cfg_cap, big)
+                out = self._latch_sorted_fallback(ctx, in_schema, cfg_cap,
+                                                  big)
                 filtered = [self._apply_clustered_filter(ctx, b, None)
                             for b in out]
             out = filtered
+        return out
+
+    def _latch_sorted_fallback(self, ctx, in_schema, cfg_cap, big):
+        """Row groups lied about ordering (runtime disorder detection):
+        latch off the presorted grouping, recompile the sorted path, and
+        re-run — correctness first.  _make_compiled returns the tuple, so
+        the shared instance swaps atomically and concurrent tasks never
+        observe a half-published state."""
+        self.metrics().add("presort_fallbacks", 1)
+        with self.xla_lock():
+            self._no_presort = True
+            self._compiled = self._make_compiled(ctx, in_schema)
+        out, _ = self._execute_device(ctx, cfg_cap, big)
         return out
 
     def _apply_clustered_filter(self, ctx, result, disorder):

@@ -841,7 +841,20 @@ class ParquetScanExec(ScanExec):
         key except those in range overlaps between neighboring partitions.
         The reference has no analog — DataFusion's partial/final agg split
         (the reference's stage shape for q18's subquery) always ships every
-        partial state through the exchange."""
+        partial state through the exchange.
+
+        Memoized per column: the planner pass may probe the same scan
+        twice (presorted-only annotate, then the early-HAVING upgrade),
+        and the stats sweep walks every row group's metadata."""
+        cache = getattr(self, "_clustered_cache", None)
+        if cache is None:
+            self._clustered_cache = cache = {}
+        if col_name in cache:
+            return cache[col_name]
+        cache[col_name] = self._clustered_ranges_impl(col_name)
+        return cache[col_name]
+
+    def _clustered_ranges_impl(self, col_name: str):
         from ..utils import object_store as obs
 
         units = sorted(u for g in self.groups for u in g)

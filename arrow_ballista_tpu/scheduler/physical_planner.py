@@ -435,56 +435,44 @@ class PhysicalPlanner:
         from ..ops.physical import ParquetScanExec
         from ..ops.shuffle import RepartitionExec as Rep
 
-        def walk(node):
-            for c in node.children():
-                walk(c)
-            if not isinstance(node, O.FilterExec) or node.host_mode:
-                return
-            agg_f = node.input
-            if not isinstance(agg_f, O.HashAggregateExec) \
-                    or agg_f.mode != "final":
-                return
-            rep = agg_f.input
-            if not isinstance(rep, Rep):
-                return
-            agg_p = rep.input
-            if not isinstance(agg_p, O.HashAggregateExec) \
-                    or agg_p.mode != "partial" \
-                    or getattr(agg_p, "clustered", None) is not None:
-                return
+        def annotate(agg_p, pred) -> bool:
+            """Mark a partial agg clustered if eligible.  ``pred`` is the
+            downstream HAVING predicate (early-filter form) or None
+            (presorted-only form: sort-free grouping, exchange unchanged —
+            on TPU this alone removes the minutes-compile sort family)."""
             if len(agg_p.group_exprs) != 1:
-                return
+                return False
             ge, _gname = agg_p.group_exprs[0]
             if not isinstance(ge, E.Column):
-                return
+                return False
             if any(a.func not in ("sum", "count", "min", "max")
                    for a in agg_p.aggs):
-                return
-            pred = node.predicate
-            from ..ops.physical import has_scalar_subquery
+                return False
+            if pred is not None:
+                from ..ops.physical import has_scalar_subquery
 
-            if has_scalar_subquery(pred):
-                return
-            if not pred.column_refs() <= set(agg_p.schema.names()):
-                return
+                if has_scalar_subquery(pred):
+                    return False
+                if not pred.column_refs() <= set(agg_p.schema.names()):
+                    return False
             # resolve the group key through renames down to the scan column
             child, col = agg_p.input, ge.name
             while isinstance(child, O.RenameExec):
                 rev = {new: old for old, new in child._mapping}
                 if col not in rev:
-                    return
+                    return False
                 col = rev[col]
                 child = child.input
             if not isinstance(child, ParquetScanExec):
-                return
+                return False
             try:
                 if child.schema.field(col).dtype.np_dtype.kind not in "iu":
-                    return
+                    return False
             except Exception:  # noqa: BLE001
-                return
+                return False
             ranges = child.clustered_ranges(col)
             if not ranges or len(ranges) <= 1:
-                return
+                return False
             intervals = [(lo_b, hi_a)
                          for (_lo_a, hi_a), (lo_b, _hi_b)
                          in zip(ranges, ranges[1:]) if lo_b <= hi_a]
@@ -497,6 +485,36 @@ class PhysicalPlanner:
                 sent = int(field.dtype.null_sentinel)
                 intervals.append((sent, sent))
             agg_p.clustered = (pred, intervals)
+            return True
+
+        def walk(node):
+            for c in node.children():
+                walk(c)
+            if isinstance(node, O.HashAggregateExec) \
+                    and node.mode == "partial" \
+                    and getattr(node, "clustered", None) is None:
+                annotate(node, None)  # presorted-only; upgraded below
+                return
+            if not isinstance(node, O.FilterExec) or node.host_mode:
+                return
+            agg_f = node.input
+            if not isinstance(agg_f, O.HashAggregateExec) \
+                    or agg_f.mode != "final":
+                return
+            rep = agg_f.input
+            if not isinstance(rep, Rep):
+                return
+            agg_p = rep.input
+            if not isinstance(agg_p, O.HashAggregateExec) \
+                    or agg_p.mode != "partial":
+                return
+            cl = getattr(agg_p, "clustered", None)
+            if cl is not None and cl[0] is not None:
+                return  # already carries an early-HAVING annotation
+            # upgrade a presorted-only annotation to the early-HAVING form
+            agg_p.clustered = None
+            if not annotate(agg_p, node.predicate):
+                agg_p.clustered = cl  # keep presorted-only if it existed
 
         walk(plan)
 

@@ -528,6 +528,9 @@ class SqlToRel:
             jt = "anti" if pred.negated else "semi"
             return L.Join(plan, sub, [(pred.operand, sub_col)], jt)
         if isinstance(pred, _ExistsPred):
+            rewritten = self._exists_minmax_rewrite(plan, pred)
+            if rewritten is not None:
+                return rewritten
             jt = "anti" if pred.negated else "semi"
             return L.Join(plan, pred.subplan, pred.on_pairs, jt, pred.residual)
         if isinstance(pred, _ScalarCmpPred):
@@ -735,6 +738,73 @@ class SqlToRel:
         if not on_pairs:
             raise PlanningError("EXISTS subquery must have at least one correlated equality")
         return _ExistsPred(inner_plan, on_pairs, E.and_all(residual), node.negated)
+
+    def _exists_minmax_rewrite(self, plan: L.LogicalPlan,
+                               pred: "_ExistsPred"):
+        """Decorrelate [NOT] EXISTS whose residual is a single
+        ``inner.C <> outer.O`` inequality into a grouped min/max aggregate
+        plus a join — q21's two lineitem self-probes expand ~266M candidate
+        pairs as semi/anti joins (7 build rows per orderkey), while the
+        aggregate form groups lineitem ONCE (clustered -> sort-free) and
+        joins 1:1:
+
+          EXISTS(t2: t2.K = o.K AND t2.C <> o.O)
+            == group K exists AND (min(C) <> O OR max(C) <> O)
+          NOT EXISTS(...)  == group K absent OR (min(C) = O AND max(C) = O)
+
+        Applies only when K, C and O are non-nullable non-string columns
+        (the engine's in-band NULL sentinels would otherwise leak into
+        min/max and the <>/= comparisons need no 3-valued logic).  Helper
+        columns are projected away, so the plan's schema is unchanged.
+        The reference has no analog — DataFusion plans these as
+        nested-loop-ish joins the same way our fallback does."""
+        if len(pred.on_pairs) != 1 or pred.residual is None:
+            return None
+        conjs = E.conjuncts(pred.residual)
+        if len(conjs) != 1:
+            return None
+        c = conjs[0]
+        if not (isinstance(c, E.BinOp) and c.op == "<>"):
+            return None
+        sub_schema = pred.subplan.schema
+        sides = []
+        for side in (c.left, c.right):
+            if not isinstance(side, E.Column):
+                return None
+            sides.append(side)
+        inner_c = outer_o = None
+        for a, b in (sides, sides[::-1]):
+            if a.name in sub_schema and a.name not in plan.schema \
+                    and b.name in plan.schema and b.name not in sub_schema:
+                inner_c, outer_o = a, b
+        if inner_c is None:
+            return None
+        outer_k, inner_k = pred.on_pairs[0]
+        if not (isinstance(inner_k, E.Column) and isinstance(outer_k, E.Column)):
+            return None
+        for sch, col in ((sub_schema, inner_c), (sub_schema, inner_k),
+                         (plan.schema, outer_o), (plan.schema, outer_k)):
+            f = sch.field(col.name)
+            if f.nullable or f.dtype.is_string:
+                return None
+        tag = self._fresh("ex")
+        kname, mn, mx = f"{tag}_k", f"{tag}_mn", f"{tag}_mx"
+        agg = L.Aggregate(pred.subplan, [(inner_k, kname)],
+                          [(E.Agg("min", inner_c), mn),
+                           (E.Agg("max", inner_c), mx)])
+        keep_schema = [(E.Column(f.name), f.name) for f in plan.schema]
+        if pred.negated:
+            joined = L.Join(plan, agg, [(outer_k, E.Column(kname))], "left")
+            cond = E.BinOp("or", E.IsNull(E.Column(mn)),
+                           E.BinOp("and",
+                                   E.BinOp("=", E.Column(mn), outer_o),
+                                   E.BinOp("=", E.Column(mx), outer_o)))
+        else:
+            joined = L.Join(plan, agg, [(outer_k, E.Column(kname))], "inner")
+            cond = E.BinOp("or",
+                           E.BinOp("<>", E.Column(mn), outer_o),
+                           E.BinOp("<>", E.Column(mx), outer_o))
+        return L.Projection(L.Filter(joined, cond), keep_schema)
 
     def _correlated_equi_pair(self, c: E.Expr):
         """outer_expr = inner_expr -> (outer, inner) join pair."""

@@ -101,9 +101,16 @@ def test_q21_exists_and_not_exists():
           and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA'
         group by s_name order by numwait desc, s_name limit 100""")
     kinds = [j.join_type for j in collect(p, L.Join)]
-    assert "semi" in kinds and "anti" in kinds
-    semi = [j for j in collect(p, L.Join) if j.join_type == "semi"][0]
-    assert semi.filter is not None, "non-equi correlation must become a residual filter"
+    # r5: both EXISTS subqueries decorrelate into grouped min/max
+    # aggregates (SqlToRel._exists_minmax_rewrite) — EXISTS becomes an
+    # inner join + filter, NOT EXISTS a left join + IS NULL/equality
+    # filter; no semi/anti pair-explosion joins remain
+    assert "semi" not in kinds and "anti" not in kinds
+    assert "left" in kinds
+    aggs = collect(p, L.Aggregate)
+    minmax = [a for a in aggs
+              if any(x.func in ("min", "max") for x, _ in a.agg_exprs)]
+    assert len(minmax) >= 2  # one per EXISTS subquery
 
 
 def test_q2_correlated_scalar_decorrelates():
