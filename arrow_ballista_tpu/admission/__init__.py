@@ -1,0 +1,15 @@
+"""Admission control & multi-tenant workload management.
+
+Sits between job submission and the scheduler's ``JobQueued`` planning
+event: per-tenant quotas (max concurrent / max queued jobs, optional
+task-slot share), a priority-aware bounded wait queue with timeouts, and
+load shedding tied to live cluster signals.  Default configuration is
+pass-through — the subsystem activates only when limits are configured
+(``ballista.admission.*`` keys, utils/config.py).
+"""
+from .controller import (  # noqa: F401
+    AdmissionController,
+    AdmissionPolicy,
+    AdmissionRequest,
+    SlotShareGate,
+)

@@ -1,0 +1,427 @@
+"""AdmissionController: the gate between job submission and planning.
+
+The scheduler's ``submit_job`` used to post ``JobQueued`` unconditionally;
+every submission planned and launched immediately.  The controller sits on
+that edge and decides, per job, one of three outcomes:
+
+- **admit** — post ``JobQueued`` (possibly later, when capacity frees up);
+- **wait** — park the job in a priority-aware bounded queue (priority
+  descending, FIFO within a priority) while its status stays ``queued``;
+- **shed** — fail the job immediately with a *retriable* status carrying a
+  ``retry after N s`` hint (tenant queue full, or queue timeout expired).
+
+Quotas are per **tenant** (by default the session id): max concurrent
+running jobs, max queued jobs, and an optional share of the cluster's task
+slots (enforced at task hand-out time via :class:`SlotShareGate`).  Load
+shedding is tied to live cluster signals — ``pending_task_count`` and
+registered executor slots — so a saturated cluster makes new jobs wait
+instead of piling more planned graphs onto the executors.  Completions,
+cancellations, failures and executor registrations all ``pump()`` the
+queue to release the next admissible job.
+
+Everything defaults to pass-through (all limits 0 = unlimited): with no
+``ballista.admission.*`` keys configured the controller admits
+synchronously and adds one dict lookup to the submit path.
+
+Locking: decisions are made under one lock; the admit/fail callbacks run
+*outside* it, because failing a job fires ``JobState`` subscribers which
+re-enter the controller through ``release``.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_TERMINAL = ("successful", "failed", "cancelled")
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionPolicy:
+    """Per-tenant limits; 0 / 0.0 means unlimited (pass-through)."""
+
+    max_concurrent_jobs: int = 0
+    max_queued_jobs: int = 0
+    queue_timeout_s: float = 0.0
+    max_pending_tasks: int = 0
+    slot_share: float = 0.0
+    retry_after_s: int = 5
+
+    @property
+    def pass_through(self) -> bool:
+        return (self.max_concurrent_jobs <= 0 and self.max_queued_jobs <= 0
+                and self.queue_timeout_s <= 0 and self.max_pending_tasks <= 0
+                and self.slot_share <= 0)
+
+    @classmethod
+    def from_config(cls, config) -> "AdmissionPolicy":
+        from ..utils.config import (
+            ADMISSION_MAX_CONCURRENT_JOBS,
+            ADMISSION_MAX_PENDING_TASKS,
+            ADMISSION_MAX_QUEUED_JOBS,
+            ADMISSION_QUEUE_TIMEOUT_S,
+            ADMISSION_RETRY_AFTER_S,
+            ADMISSION_SLOT_SHARE,
+        )
+
+        return cls(
+            max_concurrent_jobs=config.get(ADMISSION_MAX_CONCURRENT_JOBS),
+            max_queued_jobs=config.get(ADMISSION_MAX_QUEUED_JOBS),
+            queue_timeout_s=config.get(ADMISSION_QUEUE_TIMEOUT_S),
+            max_pending_tasks=config.get(ADMISSION_MAX_PENDING_TASKS),
+            slot_share=config.get(ADMISSION_SLOT_SHARE),
+            retry_after_s=config.get(ADMISSION_RETRY_AFTER_S),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionRequest:
+    """Submission-side identity + QoS: who is asking, how urgent, and which
+    limits apply to them."""
+
+    tenant: str = "default"
+    priority: int = 0
+    policy: AdmissionPolicy = AdmissionPolicy()
+
+    @classmethod
+    def from_config(cls, config, default_tenant: str = "default"
+                    ) -> "AdmissionRequest":
+        from ..utils.config import ADMISSION_PRIORITY, ADMISSION_TENANT
+
+        tenant = config.get(ADMISSION_TENANT) or default_tenant or "default"
+        return cls(tenant=tenant, priority=config.get(ADMISSION_PRIORITY),
+                   policy=AdmissionPolicy.from_config(config))
+
+
+@dataclasses.dataclass
+class _QueuedJob:
+    job_id: str
+    plan_fn: Callable
+    request: AdmissionRequest
+    enqueued_at: float          # monotonic
+    deadline: Optional[float]   # monotonic, None = wait forever
+
+
+class SlotShareGate:
+    """Caps task hand-out per tenant at ``ceil(share * total_slots)``.
+
+    Built fresh for each ``_offer``/``poll_work`` round from the current
+    per-job running-task counts; ``allows`` is consulted before popping a
+    task from a job's graph and ``took`` charges the tenant for each task
+    actually handed out during the round.
+    """
+
+    def __init__(self, caps: Dict[str, int], running: Dict[str, int],
+                 tenant_of: Dict[str, str]):
+        self._caps = caps
+        self._running = dict(running)
+        self._tenant_of = tenant_of
+
+    def allows(self, job_id: str) -> bool:
+        tenant = self._tenant_of.get(job_id)
+        cap = self._caps.get(tenant) if tenant is not None else None
+        if cap is None:
+            return True
+        return self._running.get(tenant, 0) < cap
+
+    def took(self, job_id: str) -> None:
+        tenant = self._tenant_of.get(job_id)
+        if tenant is not None and tenant in self._caps:
+            self._running[tenant] = self._running.get(tenant, 0) + 1
+
+
+class AdmissionController:
+    """See module docstring.  Wiring (scheduler/scheduler.py):
+
+    - ``admit_cb(job_id, plan_fn)`` posts ``JobQueued`` to the event loop;
+    - ``fail_cb(job_id, message)`` publishes a retriable failed status;
+    - ``pending_tasks_fn()`` / ``total_slots_fn()`` are the live cluster
+      signals that drive load shedding.
+    """
+
+    def __init__(self, admit_cb: Callable[[str, Callable], None],
+                 fail_cb: Callable[[str, str], None],
+                 pending_tasks_fn: Callable[[], int],
+                 total_slots_fn: Callable[[], int],
+                 metrics=None):
+        self._admit_cb = admit_cb
+        self._fail_cb = fail_cb
+        self._pending_tasks_fn = pending_tasks_fn
+        self._total_slots_fn = total_slots_fn
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._seq = 0
+        # sorted by (-priority, seq): highest priority first, FIFO within
+        self._queue: List[Tuple[Tuple[int, int], _QueuedJob]] = []
+        self._queued: Dict[str, _QueuedJob] = {}
+        self._running: Dict[str, AdmissionRequest] = {}
+        self._tenant_running: Dict[str, int] = {}
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.timed_out_total = 0
+        self._sweeper: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # --- submission ------------------------------------------------------
+    def submit(self, job_id: str, plan_fn: Callable,
+               request: Optional[AdmissionRequest] = None) -> None:
+        req = request or AdmissionRequest()
+        pol = req.policy
+        with self._lock:
+            if pol.pass_through and not self._queue:
+                self._mark_running(job_id, req)
+                actions = [("admit", job_id, plan_fn, 0.0)]
+            elif self._tenant_queue_full(req):
+                self.shed_total += 1
+                actions = [("fail", job_id,
+                            f"admission queue full for tenant "
+                            f"'{req.tenant}' "
+                            f"({pol.max_queued_jobs} queued); "
+                            f"retry after {pol.retry_after_s}s")]
+            elif self._admissible(req) and not self._queue_has_runnable(req):
+                self._mark_running(job_id, req)
+                actions = [("admit", job_id, plan_fn, 0.0)]
+            else:
+                self._enqueue(job_id, plan_fn, req)
+                actions = []
+        self._run(actions)
+
+    # --- release / pump --------------------------------------------------
+    def release(self, job_id: str) -> None:
+        """A job reached a terminal state (or was shed while queued): drop
+        its running reservation and admit the next admissible job.  No-op
+        for jobs the controller never saw (e.g. recovered jobs)."""
+        with self._lock:
+            req = self._running.pop(job_id, None)
+            if req is not None:
+                n = self._tenant_running.get(req.tenant, 0) - 1
+                if n > 0:
+                    self._tenant_running[req.tenant] = n
+                else:
+                    self._tenant_running.pop(req.tenant, None)
+            actions = self._pump_locked()
+        self._run(actions)
+
+    def pump(self) -> None:
+        """Re-evaluate the wait queue against live cluster signals; called
+        on every scheduling round (task updates, executor registration or
+        loss, job planned)."""
+        with self._lock:
+            actions = self._pump_locked()
+        self._run(actions)
+
+    def take_queued(self, job_id: str) -> bool:
+        """Remove a still-queued job (cancellation path).  True if the job
+        was waiting in the admission queue."""
+        with self._lock:
+            found = self._remove(job_id) is not None
+            actions = self._pump_locked() if found else []
+        self._run(actions)
+        return found
+
+    # --- slot-share enforcement -----------------------------------------
+    def slot_gate(self, running_by_job_fn: Callable[[], Dict[str, int]]
+                  ) -> Optional[SlotShareGate]:
+        """Build a per-round gate for task hand-out, or None when no
+        running job has a slot share configured (the fast path —
+        ``running_by_job_fn`` is only invoked when a share is active)."""
+        with self._lock:
+            shared = {jid: req for jid, req in self._running.items()
+                      if req.policy.slot_share > 0}
+            if not shared:
+                return None
+            tenant_of = {jid: req.tenant
+                         for jid, req in self._running.items()}
+        total = max(0, self._total_slots_fn())
+        caps: Dict[str, int] = {}
+        for jid, req in shared.items():
+            share = min(1.0, req.policy.slot_share)
+            # ceil(share * total) in milli-units to dodge float fuzz, but
+            # never 0: a tenant with any share can always run one task
+            caps[req.tenant] = max(1, -(-round(share * total * 1000)
+                                        // 1000)) if total else 1
+        running: Dict[str, int] = {}
+        for jid, n in running_by_job_fn().items():
+            t = tenant_of.get(jid)
+            if t is not None:
+                running[t] = running.get(t, 0) + n
+        return SlotShareGate(caps, running, tenant_of)
+
+    # --- introspection ---------------------------------------------------
+    def snapshot(self) -> Dict:
+        """Queue state per tenant, for /api/admission."""
+        now = time.monotonic()
+        with self._lock:
+            tenants: Dict[str, Dict] = {}
+            for tenant, n in self._tenant_running.items():
+                tenants.setdefault(tenant, {"running": 0, "queued": 0})
+                tenants[tenant]["running"] = n
+            queue = []
+            for _key, e in self._queue:
+                t = tenants.setdefault(e.request.tenant,
+                                       {"running": 0, "queued": 0})
+                t["queued"] += 1
+                queue.append({
+                    "job_id": e.job_id,
+                    "tenant": e.request.tenant,
+                    "priority": e.request.priority,
+                    "waited_s": round(now - e.enqueued_at, 3),
+                })
+            return {
+                "queued": len(self._queue),
+                "running": len(self._running),
+                "admitted_total": self.admitted_total,
+                "shed_total": self.shed_total,
+                "timed_out_total": self.timed_out_total,
+                "tenants": tenants,
+                "queue": queue,
+            }
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._cond.notify_all()
+
+    # --- internals (call with self._lock held) ---------------------------
+    def _mark_running(self, job_id: str, req: AdmissionRequest) -> None:
+        self._running[job_id] = req
+        self._tenant_running[req.tenant] = \
+            self._tenant_running.get(req.tenant, 0) + 1
+        self.admitted_total += 1
+
+    def _tenant_queue_full(self, req: AdmissionRequest) -> bool:
+        limit = req.policy.max_queued_jobs
+        if limit <= 0:
+            return False
+        depth = sum(1 for _k, e in self._queue
+                    if e.request.tenant == req.tenant)
+        return depth >= limit
+
+    def _queue_has_runnable(self, req: AdmissionRequest) -> bool:
+        """Fairness: a fresh submission must not jump over an equal-or-
+        higher-priority queued job that is itself currently admissible."""
+        for _key, e in self._queue:
+            if e.request.priority >= req.priority and self._admissible(e.request):
+                return True
+        return False
+
+    def _admissible(self, req: AdmissionRequest) -> bool:
+        pol = req.policy
+        if (pol.max_concurrent_jobs > 0 and
+                self._tenant_running.get(req.tenant, 0)
+                >= pol.max_concurrent_jobs):
+            return False
+        if pol.max_pending_tasks > 0:
+            try:
+                pending = self._pending_tasks_fn()
+            except Exception:  # noqa: BLE001 — signals are advisory
+                pending = 0
+            if pending >= pol.max_pending_tasks:
+                return False
+        return True
+
+    def _enqueue(self, job_id: str, plan_fn: Callable,
+                 req: AdmissionRequest) -> None:
+        self._seq += 1
+        deadline = None
+        if req.policy.queue_timeout_s > 0:
+            deadline = time.monotonic() + req.policy.queue_timeout_s
+        e = _QueuedJob(job_id, plan_fn, req, time.monotonic(), deadline)
+        bisect.insort(self._queue, ((-req.priority, self._seq), e),
+                      key=lambda item: item[0])
+        self._queued[job_id] = e
+        self._report_depth()
+        if deadline is not None:
+            self._ensure_sweeper()
+            self._cond.notify_all()
+
+    def _remove(self, job_id: str) -> Optional[_QueuedJob]:
+        e = self._queued.pop(job_id, None)
+        if e is None:
+            return None
+        self._queue = [item for item in self._queue if item[1] is not e]
+        self._report_depth()
+        return e
+
+    def _pump_locked(self) -> List[tuple]:
+        actions: List[tuple] = []
+        now = time.monotonic()
+        # expire first so a timed-out head never blocks the tenant quota
+        for _key, e in list(self._queue):
+            if e.deadline is not None and now >= e.deadline:
+                self._remove(e.job_id)
+                self.shed_total += 1
+                self.timed_out_total += 1
+                actions.append((
+                    "fail", e.job_id,
+                    f"admission queue timeout after "
+                    f"{e.request.policy.queue_timeout_s:g}s "
+                    f"(tenant '{e.request.tenant}'); "
+                    f"retry after {e.request.policy.retry_after_s}s"))
+        # then admit in (priority, FIFO) order, skipping quota-blocked
+        # tenants so one tenant at its cap can't head-of-line-block others
+        for _key, e in list(self._queue):
+            if not self._admissible(e.request):
+                continue
+            self._remove(e.job_id)
+            self._mark_running(e.job_id, e.request)
+            actions.append(("admit", e.job_id, e.plan_fn,
+                            now - e.enqueued_at))
+        return actions
+
+    def _report_depth(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_admission_queue_depth(len(self._queue))
+
+    def _run(self, actions: List[tuple]) -> None:
+        """Execute decisions collected under the lock.  Must be called
+        without the lock: fail_cb fires JobState subscribers which re-enter
+        through release()."""
+        for action in actions:
+            try:
+                if action[0] == "admit":
+                    _, job_id, plan_fn, waited = action
+                    if self._metrics is not None:
+                        self._metrics.record_admitted(job_id, waited)
+                    self._admit_cb(job_id, plan_fn)
+                else:
+                    _, job_id, message = action
+                    if self._metrics is not None:
+                        self._metrics.record_shed(job_id)
+                    self._fail_cb(job_id, message)
+            except Exception:  # noqa: BLE001 — one job must not wedge the rest
+                log.exception("admission callback failed for %s", action[1])
+
+    # --- queue-timeout sweeper ------------------------------------------
+    def _ensure_sweeper(self) -> None:
+        if self._sweeper is not None or self._stopped:
+            return
+        self._sweeper = threading.Thread(target=self._sweep_loop,
+                                         name="admission-sweeper",
+                                         daemon=True)
+        self._sweeper.start()
+
+    def _sweep_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                deadlines = [e.deadline for _k, e in self._queue
+                             if e.deadline is not None]
+                wait = (min(deadlines) - time.monotonic()) if deadlines else None
+                if wait is None or wait > 0:
+                    self._cond.wait(timeout=wait)
+                if self._stopped:
+                    return
+                actions = self._pump_locked()
+            self._run(actions)

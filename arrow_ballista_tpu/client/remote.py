@@ -15,7 +15,7 @@ from .. import serde
 from ..models.batch import ColumnBatch
 from ..net import wire
 from ..utils.config import BallistaConfig
-from ..utils.errors import ExecutionError
+from ..utils.errors import ExecutionError, ResourceExhausted
 
 POLL_INTERVAL_S = 0.1  # reference: 100 ms
 
@@ -96,6 +96,11 @@ class RemoteCluster:
             if state == "successful":
                 break
             if state in ("failed", "cancelled", "not_found"):
+                if status.get("retriable"):
+                    # admission shed (queue full / timeout): transient
+                    # back-pressure, surfaced distinctly so callers retry
+                    raise ResourceExhausted(
+                        f"job {job_id} shed: {status.get('error', '')}")
                 raise ExecutionError(
                     f"job {job_id} {state}: {status.get('error', '')}")
             if time.monotonic() > deadline:

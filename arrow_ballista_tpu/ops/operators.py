@@ -431,18 +431,40 @@ class HashAggregateExec(ExecutionPlan):
             # remote devices — a deliberate trade against the sort-program
             # family it replaces, which COMPILES 30-110 s per shape on the
             # TPU backend (capacity ladders mint several shapes per query)
-            if disorder is not None and bool(disorder):
-                out = self._latch_sorted_fallback(ctx, in_schema, cfg_cap,
-                                                  big)
+            if disorder is not None:
+                # stale-stats guard rides the same sync: declared range
+                # vs observed min/max (both device scalars, one roundtrip)
+                mismatch = self._declared_range_mismatch(ctx, big, partition)
+                if mismatch is not None:
+                    dis_v, mis_v = jax.device_get((disorder, mismatch))
+                    if bool(mis_v):
+                        self.metrics().add("clustered_range_mismatches", 1)
+                    bad = bool(dis_v) or bool(mis_v)
+                else:
+                    bad = bool(disorder)
+                if bad:
+                    out = self._latch_sorted_fallback(ctx, in_schema,
+                                                      cfg_cap, big)
             return out
         if self.mode == "partial" and getattr(self, "clustered", None) \
                 is not None:
-            filtered = [self._apply_clustered_filter(ctx, b, disorder)
+            if getattr(self, "_stale_ranges", False):
+                # parquet stats lied about key ranges earlier in this
+                # stage: the overlap windows are untrustworthy, ship full
+                # partials (the downstream HAVING still applies after the
+                # final agg, so this only costs exchange volume)
+                return out
+            mismatch = (self._declared_range_mismatch(ctx, big, partition)
+                        if disorder is not None else None)
+            filtered = [self._apply_clustered_filter(ctx, b, disorder,
+                                                     mismatch)
                         for b in out]
             if any(f is None for f in filtered):
                 out = self._latch_sorted_fallback(ctx, in_schema, cfg_cap,
                                                   big)
-                filtered = [self._apply_clustered_filter(ctx, b, None)
+                if getattr(self, "_stale_ranges", False):
+                    return out
+                filtered = [self._apply_clustered_filter(ctx, b, None, None)
                             for b in out]
             out = filtered
         return out
@@ -460,7 +482,50 @@ class HashAggregateExec(ExecutionPlan):
         out, _ = self._execute_device(ctx, cfg_cap, big)
         return out
 
-    def _apply_clustered_filter(self, ctx, result, disorder):
+    def _declared_range_mismatch(self, ctx, big, partition):
+        """Stale-parquet-stats guard for the clustered annotation: compare
+        this partition's OBSERVED key min/max (the same cheap masked
+        reduction family as the disorder flag) against the range the
+        planner declared from row-group stats.  A mutated file whose stats
+        were not rewritten would otherwise let the early filter drop
+        non-final partials.  Returns a device bool scalar (True = the
+        declared range is wrong), or None when no declared range applies
+        to this partition (legacy annotation, or partition out of range
+        after a repartition)."""
+        cl = getattr(self, "clustered", None)
+        ranges = cl[2] if cl is not None and len(cl) > 2 else None
+        if not ranges or not (0 <= partition < len(ranges)):
+            return None
+        comp, group_c = self._compiled[0], self._compiled[1]
+        kc, key_name = group_c[0]
+        with self.xla_lock():
+            if getattr(self, "_range_check", None) is None:
+                field = self._schema.field(key_name)
+                # NULL keys ride an in-band sentinel that parquet min/max
+                # stats exclude — it must not trip the range check
+                sent = int(field.dtype.null_sentinel) if field.nullable \
+                    else None
+
+                def check(cols, mask, aux, lo, hi):
+                    k = kc.fn(cols, aux)
+                    if k.ndim == 0:
+                        k = jnp.broadcast_to(k, mask.shape)
+                    k = k.astype(jnp.int64)
+                    live = mask if sent is None else mask & (k != sent)
+                    kmin = jnp.min(jnp.where(live, k,
+                                             jnp.iinfo(jnp.int64).max))
+                    kmax = jnp.max(jnp.where(live, k,
+                                             jnp.iinfo(jnp.int64).min))
+                    return jnp.any(live) & ((kmin < lo) | (kmax > hi))
+
+                self._range_check = jax.jit(check)
+        lo, hi = ranges[partition]
+        aux = comp.aux_arrays(big.dicts)
+        return self._range_check(big.columns, big.mask, aux,
+                                 jnp.asarray(int(lo), jnp.int64),
+                                 jnp.asarray(int(hi), jnp.int64))
+
+    def _apply_clustered_filter(self, ctx, result, disorder, mismatch=None):
         """Clustered group-by early-HAVING (see
         scheduler/physical_planner.py _clustered_having_pushdown): the
         input is clustered on the single group key, so this partition's
@@ -468,7 +533,7 @@ class HashAggregateExec(ExecutionPlan):
         windows — apply the downstream HAVING predicate here and ship only
         survivors plus the (few) window keys.  Collapses q18's 15M-state
         exchange to ~hundreds of rows."""
-        pred_expr, intervals = self.clustered
+        pred_expr, intervals = self.clustered[0], self.clustered[1]
         with self.xla_lock():
             if getattr(self, "_cl_compiled", None) is None:
                 comp = ExprCompiler(self._schema, "device")
@@ -504,10 +569,20 @@ class HashAggregateExec(ExecutionPlan):
         aux = comp.aux_arrays(result.dicts)
         new_mask, live = keep_fn(result.columns, result.mask, aux, los, his)
         if disorder is not None:
-            # ONE device->host roundtrip for both scalars (device_get
-            # batches pytree leaves — a separate bool() + int() would pay
-            # the ~75 ms fixed transfer latency twice per task)
-            live_v, dis_v = jax.device_get((live, disorder))
+            # ONE device->host roundtrip for all scalars (device_get
+            # batches pytree leaves — separate bool() + int() calls would
+            # pay the ~75 ms fixed transfer latency once per scalar)
+            fetch = (live, disorder,
+                     mismatch if mismatch is not None else np.False_)
+            live_v, dis_v, mis_v = jax.device_get(fetch)
+            if bool(mis_v):
+                # declared ranges are wrong (stale stats): the overlap
+                # windows can't be trusted, so the early filter itself is
+                # invalid — latch it off; the caller re-runs sorted and
+                # ships unfiltered partials
+                self.metrics().add("clustered_range_mismatches", 1)
+                self._stale_ranges = True
+                return None
             if bool(dis_v):
                 return None  # caller re-runs the sorted path
         else:

@@ -831,9 +831,17 @@ class ParquetScanExec(ScanExec):
 
     def clustered_ranges(self, col_name: str):
         """If the data is CLUSTERED on ``col_name`` (per-row-group min/max
-        stats non-decreasing in row order), regroup this scan's partitions
-        into contiguous row-group runs and return the per-partition
-        (min, max) key ranges; else None.
+        stats non-decreasing in row order), compute a regroup of this
+        scan's partitions into contiguous row-group runs and return
+        ``(groups, ranges)`` — the new partition groups and their
+        per-partition (min, max) key ranges; else None.
+
+        Side-effect free: the caller commits ``groups`` to ``self.groups``
+        only when the annotation is accepted.  (Probing used to mutate the
+        scan in place, so a probe that produced a single range — possible
+        when one huge trailing row group absorbs the whole regroup — was
+        rejected by the planner AFTER having already collapsed the scan's
+        partitions.)
 
         Basis of the clustered group-by early-HAVING rewrite
         (scheduler/physical_planner.py): for a clustered key, a partial
@@ -900,8 +908,7 @@ class ParquetScanExec(ScanExec):
         if cur:
             new_groups.append(cur)
             new_ranges.append((cur_lo, cur_hi))
-        self.groups = new_groups
-        return new_ranges
+        return new_groups, new_ranges
 
     def _label(self):
         pruned = f", {self.pruned_row_groups} row-groups pruned" if self.pruned_row_groups else ""

@@ -152,6 +152,12 @@ class ClusterState:
         with self._lock:
             return sum(self._available.values())
 
+    def total_slots(self) -> int:
+        """Registered capacity (free + occupied) — the denominator for
+        per-tenant slot shares (admission control)."""
+        with self._lock:
+            return sum(m.task_slots for m in self._executors.values())
+
 
 class JobState:
     """Job registry + graph store + completion signalling (parity:

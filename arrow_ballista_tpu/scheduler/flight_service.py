@@ -34,6 +34,32 @@ log = logging.getLogger(__name__)
 _SQL_NS = "type.googleapis.com/arrow.flight.protocol.sql."
 
 
+def like_pattern(pattern: str):
+    """SQL LIKE filter pattern -> compiled regex (Flight SQL
+    CommandGetTables): ``%`` -> ``.*``, ``_`` -> ``.``, and a backslash
+    escapes the next character (``\\%`` / ``\\_`` match literal ``%`` /
+    ``_`` — re.escape alone would turn ``\\%`` into an escaped backslash
+    followed by a live wildcard)."""
+    import re as _re
+
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\" and i + 1 < len(pattern):
+            out.append(_re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(_re.escape(c))
+        i += 1
+    return _re.compile("^" + "".join(out) + "$", _re.IGNORECASE)
+
+
 # --------------------------------------------------------------------------
 # minimal protobuf (length-delimited fields only)
 # --------------------------------------------------------------------------
@@ -213,14 +239,7 @@ class BallistaFlightServer:
             # db_schema_filter_pattern=2, table_name_filter_pattern=3,
             # table_types=4 (repeated string), include_schema=5 (bool)
             f = pb_decode(value)
-
-            def _like(pattern: str):
-                import re as _re
-
-                return _re.compile(
-                    "^" + _re.escape(pattern).replace("%", ".*")
-                    .replace("_", ".") + "$", _re.IGNORECASE)
-
+            _like = like_pattern
             names = sorted(self.svc.catalog.table_names())
             catalog = f[1][0].decode("utf-8") if 1 in f else None
             if catalog not in (None, "", self.CATALOG_NAME):

@@ -436,6 +436,11 @@ class KvClusterState:
         return [ExecutorMetadata(**json.loads(v))
                 for _, v in self.store.scan(EXECUTORS)]
 
+    def total_slots(self) -> int:
+        """Registered capacity (free + occupied) — the slot-share
+        denominator (see cluster.ClusterState.total_slots)."""
+        return sum(m.task_slots for m in self.executors())
+
     def get_executor(self, executor_id: str):
         from .types import ExecutorMetadata
 

@@ -42,6 +42,10 @@ class SchedulerMetricsCollector:
     def record_failed(self, job_id: str) -> None: ...
     def record_cancelled(self, job_id: str) -> None: ...
     def set_pending_tasks_queue_size(self, value: int) -> None: ...
+    # admission control (arrow_ballista_tpu/admission/)
+    def record_admitted(self, job_id: str, queue_wait_s: float) -> None: ...
+    def record_shed(self, job_id: str) -> None: ...
+    def set_admission_queue_depth(self, value: int) -> None: ...
     def gather(self) -> str:
         return ""
 
@@ -62,6 +66,12 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.pending_tasks = 0
         self.planning_time = Histogram([0.01, 0.05, 0.1, 0.5, 1.0, 5.0])
         self.exec_time = Histogram()
+        self.admitted = 0
+        self.shed = 0
+        self.admission_queue_depth = 0
+        self.admission_queue_depth_max = 0
+        self.admission_wait = Histogram([0.001, 0.01, 0.1, 0.5, 1.0, 5.0,
+                                         30.0, 120.0])
 
     def record_submitted(self, job_id, queued_at_ms, submitted_at_ms):
         with self._lock:
@@ -85,6 +95,21 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.pending_tasks = value
 
+    def record_admitted(self, job_id, queue_wait_s):
+        with self._lock:
+            self.admitted += 1
+            self.admission_wait.observe(max(0.0, queue_wait_s))
+
+    def record_shed(self, job_id):
+        with self._lock:
+            self.shed += 1
+
+    def set_admission_queue_depth(self, value):
+        with self._lock:
+            self.admission_queue_depth = value
+            self.admission_queue_depth_max = max(
+                self.admission_queue_depth_max, value)
+
     def gather(self) -> str:
         with self._lock:
             lines = []
@@ -98,12 +123,21 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             counter("job_completed_total", self.completed, "jobs completed")
             counter("job_failed_total", self.failed, "jobs failed")
             counter("job_cancelled_total", self.cancelled, "jobs cancelled")
+            counter("job_admitted_total", self.admitted,
+                    "jobs admitted by admission control")
+            counter("job_shed_total", self.shed,
+                    "jobs shed by admission control (queue full / timeout)")
             lines.append("# HELP pending_task_queue_size pending tasks")
             lines.append("# TYPE pending_task_queue_size gauge")
             lines.append(f"pending_task_queue_size {self.pending_tasks}")
+            lines.append("# HELP admission_queue_depth jobs waiting for admission")
+            lines.append("# TYPE admission_queue_depth gauge")
+            lines.append(f"admission_queue_depth {self.admission_queue_depth}")
             for name, h, help_ in [
                 ("planning_time_seconds", self.planning_time, "job planning time"),
                 ("job_exec_time_seconds", self.exec_time, "job execution time"),
+                ("admission_queue_wait_seconds", self.admission_wait,
+                 "time jobs waited for admission"),
             ]:
                 lines.append(f"# HELP {name} {help_}")
                 lines.append(f"# TYPE {name} histogram")

@@ -351,7 +351,15 @@ class SchedulerNetService:
                     self._final_schemas.popitem(last=False)
             return planned.plan, scalars
 
-        self.server.submit_job(job_id, plan_fn)
+        # tenant identity + quotas ride on the session config (plus any
+        # per-request overrides already merged into session_config)
+        if session is not None:
+            request = session.admission_request(session_config)
+        else:
+            from ..admission import AdmissionRequest
+
+            request = AdmissionRequest.from_config(session_config)
+        self.server.submit_job(job_id, plan_fn, admission=request)
         return {"job_id": job_id}, b""
 
     def _get_job_status(self, payload: dict, _bin: bytes):
@@ -359,7 +367,8 @@ class SchedulerNetService:
         status = self.server.get_job_status(job_id)
         if status is None:
             return {"state": "not_found"}, b""
-        out = {"state": status.state, "error": status.error}
+        out = {"state": status.state, "error": status.error,
+               "retriable": status.retriable}
         if status.state == "successful":
             out["locations"] = {
                 str(part): [serde.location_to_obj(l) for l in locs]

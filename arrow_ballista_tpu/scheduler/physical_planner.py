@@ -470,8 +470,13 @@ class PhysicalPlanner:
                     return False
             except Exception:  # noqa: BLE001
                 return False
-            ranges = child.clustered_ranges(col)
+            probe = child.clustered_ranges(col)
+            if probe is None:
+                return False
+            groups, ranges = probe
             if not ranges or len(ranges) <= 1:
+                # a rejected probe must leave the scan untouched (the
+                # regroup would have collapsed its partitions)
                 return False
             intervals = [(lo_b, hi_a)
                          for (_lo_a, hi_a), (lo_b, _hi_b)
@@ -484,7 +489,11 @@ class PhysicalPlanner:
                 # through the exchange (never be early-filtered as final)
                 sent = int(field.dtype.null_sentinel)
                 intervals.append((sent, sent))
-            agg_p.clustered = (pred, intervals)
+            # accepted: commit the contiguous regroup to the scan, and
+            # carry the declared per-partition key ranges so the runtime
+            # can detect stale stats (operators.HashAggregateExec)
+            child.groups = groups
+            agg_p.clustered = (pred, intervals, [tuple(r) for r in ranges])
             return True
 
         def walk(node):

@@ -10,6 +10,10 @@ api/mod.rs:85-137 + handlers.rs):
     GET  /api/job/<id>/dot     graphviz of the execution graph
     PATCH /api/job/<id>        cancel (body ignored)
     GET  /api/metrics          prometheus text exposition
+
+Beyond the reference surface:
+
+    GET  /api/admission        admission-control queue state per tenant
 """
 from __future__ import annotations
 
@@ -97,6 +101,8 @@ class RestApi:
                 h._send(200, graph_to_dot(graph), ctype="text/vnd.graphviz")
         elif rest == ["metrics"]:
             h._send(200, self.server.metrics.gather(), ctype="text/plain")
+        elif rest == ["admission"]:
+            h._send(200, json.dumps(self.server.admission.snapshot()))
         elif rest == ["scaler"]:
             # KEDA-scaler-shaped endpoint (reference external_scaler.rs:14-60
             # reports inflight_tasks = pending task count); consumed by a

@@ -24,6 +24,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..admission import AdmissionController, AdmissionRequest
 from .cluster import ClusterState, JobState
 from .event_loop import EventLoop
 from .execution_graph import ExecutionGraph
@@ -168,6 +169,17 @@ class SchedulerServer:
         self._stopped = threading.Event()
         self._cleanup_timers: Dict[str, threading.Timer] = {}
         self._cleanup_lock = threading.Lock()
+        # admission gate between submit_job and JobQueued planning; with no
+        # ballista.admission.* limits configured this is pass-through
+        self.admission = AdmissionController(
+            admit_cb=self._admission_admit,
+            fail_cb=self._admission_reject,
+            pending_tasks_fn=self.pending_task_count,
+            total_slots_fn=self.cluster.total_slots,
+            metrics=self.metrics)
+        # terminal transitions release the tenant's concurrency reservation
+        # and pull the next admissible job out of the wait queue
+        self.jobs.subscribe(self._on_job_terminal)
 
     # --- lifecycle -------------------------------------------------------
     def init(self, start_reaper: bool = True) -> None:
@@ -183,6 +195,7 @@ class SchedulerServer:
         # pool.shutdown (round-2 bench crash: "cannot schedule new futures
         # after shutdown" killed the event loop mid-run)
         self._stopped.set()
+        self.admission.stop()
         with self._cleanup_lock:
             timers = list(self._cleanup_timers.values())
             self._cleanup_timers.clear()
@@ -226,10 +239,30 @@ class SchedulerServer:
         self._event_loop.post(ExecutorLost(executor_id, reason))
 
     def submit_job(self, job_id: str,
-                   plan_fn: Callable[[], Tuple[object, Dict[str, object]]]) -> None:
+                   plan_fn: Callable[[], Tuple[object, Dict[str, object]]],
+                   admission: Optional[AdmissionRequest] = None) -> None:
         self.jobs.accept_job(job_id)
         self._queued_at_ms[job_id] = int(time.time() * 1000)
+        self.admission.submit(job_id, plan_fn, admission)
+
+    # --- admission callbacks (see arrow_ballista_tpu/admission/) ---------
+    def _admission_admit(self, job_id: str, plan_fn: Callable) -> None:
+        if self._stopped.is_set():
+            return
         self._event_loop.post(JobQueued(job_id, plan_fn))
+
+    def _admission_reject(self, job_id: str, message: str) -> None:
+        """Shed (queue full / queue timeout): a *retriable* failure — the
+        client should back off and resubmit, not treat it as a query
+        error."""
+        self._queued_at_ms.pop(job_id, None)
+        self.jobs.set_status(JobStatus(job_id, "failed", error=message,
+                                       retriable=True))
+        self.metrics.record_failed(job_id)
+
+    def _on_job_terminal(self, status: JobStatus) -> None:
+        if status.state in ("successful", "failed", "cancelled"):
+            self.admission.release(status.job_id)
 
     def update_task_status(self, executor_id: str,
                            statuses: List[TaskStatus]) -> None:
@@ -377,6 +410,12 @@ class SchedulerServer:
     def _on_job_cancel(self, ev: JobCancel) -> None:
         graph = self.jobs.get_graph(ev.job_id)
         if graph is None or graph.status != "running":
+            # the job may still be waiting in the admission queue: pull it
+            # out so it never plans, and free its tenant's queue slot
+            if self.admission.take_queued(ev.job_id):
+                self._queued_at_ms.pop(ev.job_id, None)
+                self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
+                self.metrics.record_cancelled(ev.job_id)
             return
         graph.cancel()
         self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
@@ -450,11 +489,17 @@ class SchedulerServer:
             if ev.statuses:
                 self._absorb_statuses(ev.executor_id, ev.statuses)
             graphs = self.jobs.active_graphs()
+            gate = self.admission.slot_gate(
+                lambda: {g.job_id: len(g.running_tasks()) for g in graphs})
             while len(tasks) < ev.num_free_slots:
                 task = None
                 for graph in graphs:
+                    if gate is not None and not gate.allows(graph.job_id):
+                        continue
                     task = graph.pop_next_task(ev.executor_id)
                     if task is not None:
+                        if gate is not None:
+                            gate.took(graph.job_id)
                         break
                 if task is None:
                     break
@@ -536,6 +581,10 @@ class SchedulerServer:
         state/mod.rs:195-233 offer_reservation + fill_reservations)."""
         pending = self.pending_task_count()
         self.metrics.set_pending_tasks_queue_size(pending)
+        # every scheduling round re-evaluates the admission queue against
+        # live signals (completions, executor registrations/losses all
+        # funnel through here)
+        self.admission.pump()
         if self.config.policy != "push":
             return  # pull mode: executors come to us via poll_work
         alive = set(self.cluster.alive_executors(self.config.executor_timeout_s))
@@ -547,11 +596,17 @@ class SchedulerServer:
         assignments: Dict[str, List[TaskDescription]] = {}
         unused: List[ExecutorReservation] = []
         graphs = self.jobs.active_graphs()
+        gate = self.admission.slot_gate(
+            lambda: {g.job_id: len(g.running_tasks()) for g in graphs})
         for r in reservations:
             task = None
             for graph in graphs:
+                if gate is not None and not gate.allows(graph.job_id):
+                    continue
                 task = graph.pop_next_task(r.executor_id)
                 if task is not None:
+                    if gate is not None:
+                        gate.took(graph.job_id)
                     break
             if task is None:
                 unused.append(r)

@@ -60,6 +60,24 @@ class Session:
     def touch(self):
         self.last_used = time.time()
 
+    @property
+    def tenant(self) -> str:
+        """Admission-control identity: ``ballista.admission.tenant`` when
+        set (several sessions can share one quota pool), else the session
+        id — each session is its own tenant."""
+        from ..utils.config import ADMISSION_TENANT
+
+        return self.config.get(ADMISSION_TENANT) or self.id
+
+    def admission_request(self, config: Optional[BallistaConfig] = None):
+        """Build the AdmissionRequest for a submission from this session;
+        ``config`` overrides (session settings + per-request overlays)
+        default to the session config."""
+        from ..admission import AdmissionRequest
+
+        return AdmissionRequest.from_config(config or self.config,
+                                            default_tenant=self.tenant)
+
 
 class SessionManager:
     """Create/update/expire sessions (reference session_manager.rs:27-57).

@@ -110,13 +110,20 @@ class StandaloneCluster:
             scalars[sid] = extract_scalar(splan, scalar_ctx)
 
         job_id = random_job_id()
-        self.scheduler.submit_job(job_id, lambda: (planned.plan, scalars))
+        from ..admission import AdmissionRequest
+
+        self.scheduler.submit_job(job_id, lambda: (planned.plan, scalars),
+                                  admission=AdmissionRequest.from_config(self.config))
         # deadline is config-driven (round-2 failure mode: a slow first-compile
         # TPU run blew through a hard-coded 300 s wait and "failed" a job that
         # would have finished)
         status = self.scheduler.wait_for_job(job_id,
                                              timeout=float(self.config.job_timeout_s))
         if status.state == "failed":
+            if status.retriable:
+                from ..utils.errors import ResourceExhausted
+
+                raise ResourceExhausted(f"job {job_id} shed: {status.error}")
             raise ExecutionError(f"job {job_id} failed: {status.error}")
         if status.state != "successful":
             raise ExecutionError(f"job {job_id} ended as {status.state}")

@@ -128,3 +128,6 @@ class JobStatus:
     error: str = ""
     # successful: per output-partition locations of the final stage
     locations: Dict[int, List[PartitionLocation]] = dataclasses.field(default_factory=dict)
+    # failed + retriable: the failure is transient back-pressure (admission
+    # queue full / timed out) — clients should back off and resubmit
+    retriable: bool = False

@@ -236,6 +236,11 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
         if cl is not None:  # clustered early-HAVING annotation
             out["clustered"] = {"pred": expr_to_obj(cl[0]),
                                 "intervals": [list(iv) for iv in cl[1]]}
+            if len(cl) > 2 and cl[2]:
+                # declared per-partition key ranges: the runtime stale-
+                # stats guard (operators.py) compares observed min/max
+                # against these
+                out["clustered"]["ranges"] = [list(r) for r in cl[2]]
         return out
     if isinstance(p, O.JoinExec):
         return {"t": "join", "left": plan_to_obj(p.left),
@@ -340,7 +345,9 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
         if "clustered" in o:
             cl = o["clustered"]
             agg.clustered = (expr_from_obj(cl["pred"]),
-                             [tuple(iv) for iv in cl["intervals"]])
+                             [tuple(iv) for iv in cl["intervals"]],
+                             [tuple(r) for r in cl["ranges"]]
+                             if cl.get("ranges") else None)
         return agg
     if t == "join":
         return O.JoinExec(plan_from_obj(o["left"]), plan_from_obj(o["right"]),

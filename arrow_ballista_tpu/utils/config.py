@@ -34,6 +34,16 @@ BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides s
 JOB_TIMEOUT_S = "ballista.job.timeout.seconds"  # client-side wait_for_job deadline
 SCAN_CACHE_BYTES = "ballista.scan.cache.bytes"  # HBM-resident scan cache budget ('auto' | bytes | 0=off)
 MEM_TASK_BUDGET = "ballista.memory.task.budget.bytes"  # per-task device working-set bound ('auto' | bytes | 0=unlimited)
+# admission control / multi-tenancy (arrow_ballista_tpu/admission/) — all
+# default to 0/"" = pass-through, the subsystem activates only when set
+ADMISSION_TENANT = "ballista.admission.tenant"
+ADMISSION_PRIORITY = "ballista.admission.priority"
+ADMISSION_MAX_CONCURRENT_JOBS = "ballista.admission.max_concurrent_jobs"
+ADMISSION_MAX_QUEUED_JOBS = "ballista.admission.max_queued_jobs"
+ADMISSION_QUEUE_TIMEOUT_S = "ballista.admission.queue.timeout.seconds"
+ADMISSION_MAX_PENDING_TASKS = "ballista.admission.max_pending_tasks"
+ADMISSION_SLOT_SHARE = "ballista.admission.tenant.slot_share"
+ADMISSION_RETRY_AFTER_S = "ballista.admission.retry_after.seconds"
 
 
 @dataclasses.dataclass
@@ -118,14 +128,44 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(JOB_TIMEOUT_S, 3600, int,
                     "seconds a client waits for a submitted job before giving up"),
         ConfigEntry(SCAN_CACHE_BYTES, "auto", str,
-                    "device-resident scan cache budget: 'auto' (6 GiB), "
-                    "a byte count, or 0 to disable; see utils/table_cache.py"),
+                    "device-resident scan cache budget: 'auto' (6 GiB on "
+                    "accelerator backends, 1 GiB on CPU), a byte count, or "
+                    "0 to disable; see utils/table_cache.py"),
         ConfigEntry(MEM_TASK_BUDGET, "auto", str,
                     "memory control: per-task device working-set budget in "
                     "bytes; joins chunk their probe side and 'auto' shuffle "
                     "partition counts scale to keep task state under it.  "
                     "'auto' = 4 GiB on accelerator backends, unlimited on "
                     "CPU; 0 = unlimited"),
+        ConfigEntry(ADMISSION_TENANT, "", str,
+                    "tenant identity for admission control; empty = the "
+                    "session id (each session is its own tenant)"),
+        ConfigEntry(ADMISSION_PRIORITY, 0, int,
+                    "admission queue priority (higher runs first; FIFO "
+                    "within a priority)"),
+        ConfigEntry(ADMISSION_MAX_CONCURRENT_JOBS, 0, int,
+                    "max jobs a tenant may have running at once; excess "
+                    "submissions wait in the admission queue (0 = "
+                    "unlimited)"),
+        ConfigEntry(ADMISSION_MAX_QUEUED_JOBS, 0, int,
+                    "max jobs a tenant may have waiting for admission; "
+                    "beyond this, submissions fail immediately with a "
+                    "retriable 'queue full' status (0 = unlimited)"),
+        ConfigEntry(ADMISSION_QUEUE_TIMEOUT_S, 0.0, float,
+                    "seconds a job may wait for admission before failing "
+                    "with a retriable 'queue timeout' status (0 = wait "
+                    "forever)"),
+        ConfigEntry(ADMISSION_MAX_PENDING_TASKS, 0, int,
+                    "load shedding: hold new jobs in the admission queue "
+                    "while the scheduler's pending task count is at or "
+                    "above this (0 = never shed)"),
+        ConfigEntry(ADMISSION_SLOT_SHARE, 0.0, float,
+                    "fraction (0..1] of the cluster's registered task "
+                    "slots this tenant's running jobs may occupy at once "
+                    "(0 = unlimited)"),
+        ConfigEntry(ADMISSION_RETRY_AFTER_S, 5, int,
+                    "retry-after hint (seconds) embedded in retriable "
+                    "admission failures (queue full / queue timeout)"),
     ]
 }
 
@@ -176,6 +216,8 @@ class BallistaConfig:
             except Exception as e:
                 raise ConfigurationError(f"invalid value for {key}: {e}") from e
         expected = type(entry.default)
+        if expected is float and isinstance(value, int) and not isinstance(value, bool):
+            value = float(value)
         if not isinstance(value, expected) or (expected is int and isinstance(value, bool)):
             raise ConfigurationError(
                 f"invalid value for {key}: expected {expected.__name__}, got {type(value).__name__} ({value!r})"

@@ -47,6 +47,15 @@ class CancelledError(BallistaError):
     pass
 
 
+class ResourceExhausted(BallistaError):
+    """Admission control shed the job (tenant queue full, or the queue
+    timeout expired before capacity freed up).  Transient back-pressure,
+    not a query error: back off and resubmit — the message carries a
+    ``retry after N s`` hint."""
+
+    retryable = True
+
+
 class FetchFailedError(BallistaError):
     """A shuffle fetch from ``executor_id`` failed.
 

@@ -26,6 +26,9 @@ from collections import OrderedDict
 from typing import List, Optional, Tuple
 
 DEFAULT_BUDGET = 6 << 30  # fits SF10 lineitem device form in 16 GB HBM
+# CPU backends: "device" arrays ARE host RAM, and every CPU-only daemon
+# process would pin its own duplicate copy — keep the pool small there
+DEFAULT_BUDGET_CPU = 1 << 30
 
 
 def _batch_bytes(b) -> int:
@@ -103,9 +106,13 @@ CACHE = DeviceTableCache()
 
 
 def resolve_budget(value) -> int:
-    """Config value -> bytes.  'auto' -> DEFAULT_BUDGET, '0'/0 -> disabled."""
+    """Config value -> bytes.  '0'/0 -> disabled.  'auto' is keyed on the
+    backend platform like ``resolve_task_budget`` (utils/config.py):
+    accelerators get the HBM-sized default, CPU backends the small one."""
     if isinstance(value, str):
         if value.strip().lower() == "auto":
-            return DEFAULT_BUDGET
+            from ..models.batch import _platform_remote
+
+            return DEFAULT_BUDGET if _platform_remote() else DEFAULT_BUDGET_CPU
         value = int(value)
     return int(value)

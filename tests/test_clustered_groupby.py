@@ -214,3 +214,123 @@ def test_null_keys_never_early_filtered(tmp_path):
     assert np.isnan(out.k.iloc[0])
     assert out.sq.iloc[0] == 16 * 40
     ctx.shutdown()
+
+
+def _find_op(plan, pred):
+    if pred(plan):
+        return plan
+    for c in plan.children():
+        got = _find_op(c, pred)
+        if got is not None:
+            return got
+    return None
+
+
+def _plan(path, partitions="4"):
+    from arrow_ballista_tpu.catalog import ParquetTable, SchemaCatalog
+    from arrow_ballista_tpu.scheduler.physical_planner import PhysicalPlanner
+    from arrow_ballista_tpu.sql.optimizer import optimize
+    from arrow_ballista_tpu.sql.parser import parse_sql
+    from arrow_ballista_tpu.sql.planner import SqlToRel
+
+    cat = SchemaCatalog()
+    cat.register(ParquetTable("t", path))
+    cfg = BallistaConfig({"ballista.shuffle.partitions": partitions})
+    planned = PhysicalPlanner(cat, cfg).plan_query(
+        optimize(SqlToRel(cat).plan(parse_sql(SQL))))
+    return planned, cfg
+
+
+def test_single_range_probe_rejected_without_partition_collapse(tmp_path):
+    """A probe whose contiguous regroup collapses to ONE range (a huge
+    trailing row group absorbs the whole regroup) is rejected by the
+    planner — and, being side-effect free, must leave the scan's original
+    partitioning untouched instead of serializing the whole scan."""
+    from arrow_ballista_tpu.catalog import ParquetTable
+    from arrow_ballista_tpu.ops import operators as O
+    from arrow_ballista_tpu.ops.physical import ParquetScanExec
+
+    rng = np.random.default_rng(3)
+    reps = rng.integers(1, 8, 2000)
+    keys = np.repeat(np.arange(2000, dtype=np.int64), reps)
+    qty = rng.integers(1, 50, len(keys)).astype(np.int64)
+    table = pa.table({"k": keys, "q": qty})
+    path = str(tmp_path / "t.parquet")
+    writer = pq.ParquetWriter(path, table.schema)
+    writer.write_table(table.slice(0, 10))      # tiny row group ...
+    writer.write_table(table.slice(10))         # ... then one huge one
+    writer.close()
+
+    scan = ParquetTable("t", path).scan(None, [], 2)
+    before = [list(g) for g in scan.groups]
+    assert len(before) == 2
+    probe = scan.clustered_ranges("k")
+    assert probe is not None
+    groups, ranges = probe
+    assert len(ranges) == 1, "regroup should collapse to one range here"
+    assert [list(g) for g in scan.groups] == before, \
+        "probe must not mutate the scan's partitioning"
+
+    # planner end-to-end: annotation rejected, scan parallelism preserved
+    planned, _cfg = _plan(path, partitions="2")
+    agg = _find_op(planned.plan,
+                   lambda p: isinstance(p, O.HashAggregateExec)
+                   and getattr(p, "clustered", None) is not None)
+    assert agg is None, "single-range annotation must be rejected"
+    scan_op = _find_op(planned.plan,
+                       lambda p: isinstance(p, ParquetScanExec))
+    assert len(scan_op.groups) == 2, "rejected probe collapsed the scan"
+
+    # and the query is still correct
+    df = pd.DataFrame({"k": keys, "q": qty})
+    ctx = BallistaContext.standalone(
+        BallistaConfig({"ballista.shuffle.partitions": "2"}),
+        concurrent_tasks=2)
+    ctx.register_parquet("t", path)
+    out = ctx.sql(SQL).to_pandas()
+    ora = _oracle(df)
+    assert out.k.tolist() == ora.index.tolist()
+    assert out.sq.tolist() == ora.values.tolist()
+    ctx.shutdown()
+
+
+def test_stale_declared_ranges_disable_early_filter(tmp_path):
+    """Stale parquet stats guard: when a partition's observed key min/max
+    leaves the range the annotation declared (file rewritten after
+    planning), the runtime check must drop the early HAVING filter —
+    trusting stale overlap windows would silently drop boundary groups."""
+    from arrow_ballista_tpu.ops import operators as O
+    from arrow_ballista_tpu.scheduler.standalone import StandaloneCluster
+
+    path = str(tmp_path / "t.parquet")
+    df = _write_clustered(path)
+    planned, cfg = _plan(path)
+    agg = _find_op(planned.plan,
+                   lambda p: isinstance(p, O.HashAggregateExec)
+                   and getattr(p, "clustered", None) is not None)
+    assert agg is not None, "rewrite did not annotate the plan"
+    pred, _intervals, ranges = agg.clustered
+    # simulate a post-planning rewrite: declared ranges (and the overlap
+    # windows derived from them) no longer describe the file's keys
+    shifted = [(lo + 10_000_000, hi + 10_000_000) for lo, hi in ranges]
+    agg.clustered = (pred, [], shifted)
+
+    cluster = StandaloneCluster(cfg, concurrent_tasks=2)
+    try:
+        batches = cluster.execute(planned)
+        out = pd.concat([b.to_pandas() for b in batches],
+                        ignore_index=True).sort_values("k")
+        ora = _oracle(df)
+        assert out.k.tolist() == ora.index.tolist()
+        assert out.sq.tolist() == ora.values.tolist()
+        graph = cluster.scheduler.jobs.get_graph(
+            list(cluster.scheduler.jobs._status)[-1])
+        metrics = {k: v for st in graph.stages.values()
+                   for k, v in st.aggregate_metrics().items()}
+        assert any(k.endswith("clustered_range_mismatches") and v > 0
+                   for k, v in metrics.items()), metrics
+        assert sum(v for k, v in metrics.items()
+                   if k.endswith("clustered_early_filters")) == 0, \
+            "stale ranges must disable the early filter"
+    finally:
+        cluster.shutdown()

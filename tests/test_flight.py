@@ -232,3 +232,32 @@ def test_adbc_driver_session(cluster):
             rows = cur.fetchall()
             assert len(rows) == 3
             assert sum(r[1] for r in rows) == 1000
+
+
+def test_like_pattern_escape_sequences():
+    """SQL LIKE escapes in CommandGetTables filters: ``\\%`` / ``\\_``
+    match literal chars, bare ``%`` / ``_`` stay wildcards."""
+    from arrow_ballista_tpu.scheduler.flight_service import like_pattern
+
+    assert like_pattern("t%").match("trades")
+    assert like_pattern("t_").match("t2")
+    assert not like_pattern("t_").match("t")
+    # escaped wildcards are literals
+    assert like_pattern(r"100\%").match("100%")
+    assert not like_pattern(r"100\%").match("100x")
+    assert like_pattern(r"a\_b").match("a_b")
+    assert not like_pattern(r"a\_b").match("axb")
+    # escaped backslash, then a LIVE wildcard
+    assert like_pattern(r"a\\%").match("a\\anything")
+    assert not like_pattern(r"a\\%").match("ab")
+    # trailing lone backslash is a literal; matching stays case-insensitive
+    assert like_pattern("t\\").match("t\\")
+    assert like_pattern(r"T\_x").match("t_X")
+
+
+def test_get_tables_like_escapes_end_to_end(client):
+    """``_`` matches the one-char table name 't'; ``\\_`` must not."""
+    t = _fetch(client, _cmd("CommandGetTables", pb_field(3, b"_")))
+    assert "t" in t.column("table_name").to_pylist()
+    t = _fetch(client, _cmd("CommandGetTables", pb_field(3, b"\\_")))
+    assert t.num_rows == 0

@@ -127,3 +127,14 @@ def test_end_to_end_warm_query_correct(parquet_file):
     warm = ctx.sql(q).to_pandas()
     assert cold.equals(warm)
     assert table_cache.CACHE.stats()["hits"] >= 1
+
+
+def test_auto_budget_is_keyed_on_backend_platform():
+    """'auto' resolves per backend: accelerators get the HBM-sized pool,
+    CPU backends the small one (tests run with JAX_PLATFORMS=cpu, where
+    'device' arrays are host RAM pinned per daemon process)."""
+    assert table_cache.resolve_budget("auto") == table_cache.DEFAULT_BUDGET_CPU
+    assert table_cache.DEFAULT_BUDGET_CPU < table_cache.DEFAULT_BUDGET
+    # explicit sizes still pass through untouched
+    assert table_cache.resolve_budget("123") == 123
+    assert table_cache.resolve_budget(0) == 0
