@@ -86,8 +86,14 @@ class RemoteCluster:
     def execute_sql(self, sql: str, timeout: Optional[float] = None) -> List[ColumnBatch]:
         if timeout is None:
             timeout = float(self.config.job_timeout_s)
+        from ..obs import new_trace_context
+
+        # the client owns the trace root: the scheduler parents its job
+        # span on this context, executors parent task spans below that
         payload, _ = self._call("execute_query",
-                                {"sql": sql, "config": dict(self.config._settings)})
+                                {"sql": sql,
+                                 "config": dict(self.config._settings),
+                                 "trace": new_trace_context()})
         job_id = payload["job_id"]
         deadline = time.monotonic() + timeout
         while True:

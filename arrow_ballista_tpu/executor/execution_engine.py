@@ -31,7 +31,13 @@ class DefaultQueryStageExecutor(QueryStageExecutor):
 
     def execute_query_stage(self, partition: int, ctx: TaskContext
                             ) -> List[ShuffleWritePartition]:
-        return self.plan.execute_write(partition, ctx)
+        writes = self.plan.execute_write(partition, ctx)
+        rec = getattr(ctx, "span_recorder", None)
+        if rec is not None and writes:
+            rec.annotate(
+                rows_written=int(sum(w.num_rows for w in writes)),
+                bytes_shuffled=int(sum(w.num_bytes for w in writes)))
+        return writes
 
     def collect_plan_metrics(self) -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}

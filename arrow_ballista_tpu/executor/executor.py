@@ -78,13 +78,60 @@ class Executor:
         self._max_cancelled = 1024
         self._lock = threading.Lock()
         self._active = 0
+        # prometheus-style process counters (served by ExecutorServer's
+        # /metrics listener; always collected — they are a few ints)
+        from .metrics import ExecutorMetrics
+
+        self.metrics = ExecutorMetrics()
+        from ..utils.config import OBS_TRACING
+
+        self._tracing = bool(self.config.get(OBS_TRACING))
 
     # --- task execution --------------------------------------------------
     def run_task(self, task: TaskDescription) -> TaskStatus:
         """Execute one task synchronously (callers use ``submit_task`` for
-        pool execution)."""
+        pool execution).
+
+        This wrapper owns observability — the task span tree (parented on
+        the job's execution span via ``task.trace``) and the process
+        counters; ``_run_task_inner`` owns execution and the failure
+        taxonomy.  Spans attach to every outcome, so failed tasks profile
+        too."""
         tid = task.task
         launch_ms = int(time.time() * 1000)
+        recorder = None
+        if self._tracing:
+            from ..obs.tracing import TaskSpanRecorder
+
+            trace = task.trace or {}
+            recorder = TaskSpanRecorder(
+                trace.get("trace_id"), trace.get("span_id", ""),
+                name=f"task {tid.job_id}/{tid.stage_id}/{tid.partition}",
+                kind="executor",
+                attrs={"job_id": tid.job_id, "stage_id": tid.stage_id,
+                       "partition": tid.partition,
+                       "task_attempt": tid.task_attempt,
+                       "executor_id": self.metadata.executor_id,
+                       "actor": f"executor {self.metadata.executor_id}",
+                       "lane": f"stage {tid.stage_id} / p{tid.partition}"})
+        t0 = time.perf_counter()
+        status = self._run_task_inner(task, launch_ms, recorder)
+        if recorder is not None:
+            if status.shuffle_writes:
+                recorder.annotate(
+                    rows_written=int(sum(w.num_rows
+                                         for w in status.shuffle_writes)),
+                    bytes_shuffled=int(sum(w.num_bytes
+                                           for w in status.shuffle_writes)),
+                    output_partitions=len(status.shuffle_writes))
+            status.spans = recorder.finish(
+                "ok" if status.state == "success" else status.state)
+        self.metrics.record_task(status, time.perf_counter() - t0)
+        return status
+
+    def _run_task_inner(self, task: TaskDescription, launch_ms: int,
+                        recorder) -> TaskStatus:
+        tid = task.task
         with self._lock:
             self._active += 1
         try:
@@ -96,7 +143,8 @@ class Executor:
                               work_dir=self.work_dir, job_id=tid.job_id,
                               stage_id=tid.stage_id,
                               executor_id=self.metadata.executor_id,
-                              cancelled=lambda: tid.job_id in self._cancelled_jobs)
+                              cancelled=lambda: tid.job_id in self._cancelled_jobs,
+                              span_recorder=recorder)
             start_ms = int(time.time() * 1000)
             writes = stage_exec.execute_query_stage(tid.partition, ctx)
             end_ms = int(time.time() * 1000)

@@ -1,0 +1,79 @@
+"""Executor metrics: process counters + prometheus text exposition.
+
+Mirrors the scheduler's exposition format (scheduler/metrics.py) so one
+scrape config covers both roles; parity target is the reference
+executor's ExecutorMetricsCollector surface.  Served by the
+``ExecutorServer`` observability listener (``--metrics-port``) at
+``/metrics``, with ``/health`` alongside for liveness probes.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..scheduler.metrics import Histogram
+
+
+class ExecutorMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.launched = 0
+        self.completed = 0
+        self.failed = 0
+        self.killed = 0
+        self.shuffle_bytes = 0
+        self.shuffle_rows = 0
+        self.task_duration = Histogram([0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                                        30.0, 120.0])
+
+    def record_task(self, status, duration_s: float) -> None:
+        """Fold one finished task's outcome (every run_task return path)."""
+        with self._lock:
+            self.launched += 1
+            if status.state == "success":
+                self.completed += 1
+            elif status.state == "killed":
+                self.killed += 1
+            else:
+                self.failed += 1
+            for w in status.shuffle_writes or []:
+                self.shuffle_bytes += int(w.num_bytes)
+                self.shuffle_rows += int(w.num_rows)
+            self.task_duration.observe(max(0.0, duration_s))
+
+    def gather(self, active_tasks: int = 0) -> str:
+        with self._lock:
+            lines = []
+
+            def counter(name, v, help_):
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {v}")
+
+            counter("executor_tasks_launched_total", self.launched,
+                    "tasks this executor started")
+            counter("executor_tasks_completed_total", self.completed,
+                    "tasks that finished successfully")
+            counter("executor_tasks_failed_total", self.failed,
+                    "tasks that finished in failure")
+            counter("executor_tasks_killed_total", self.killed,
+                    "tasks killed by job cancellation")
+            counter("executor_shuffle_bytes_written_total",
+                    self.shuffle_bytes, "shuffle bytes written")
+            counter("executor_shuffle_rows_written_total",
+                    self.shuffle_rows, "shuffle rows written")
+            lines.append("# HELP executor_active_tasks tasks currently "
+                         "executing")
+            lines.append("# TYPE executor_active_tasks gauge")
+            lines.append(f"executor_active_tasks {active_tasks}")
+            h = self.task_duration
+            name = "executor_task_duration_seconds"
+            lines.append(f"# HELP {name} wall time per task")
+            lines.append(f"# TYPE {name} histogram")
+            acc = 0
+            for b, c in zip(h.buckets, h.counts):
+                acc += c
+                lines.append(f'{name}_bucket{{le="{b}"}} {acc}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {h.n}')
+            lines.append(f"{name}_sum {h.total}")
+            lines.append(f"{name}_count {h.n}")
+            return "\n".join(lines) + "\n"

@@ -64,7 +64,8 @@ class StagePlanCache:
             # cache hit: only the cheap task envelope is decoded
             return TaskDescription(TaskId(**t["task"]), cached,
                                    t.get("internal_id", 0),
-                                   dict(t.get("scalars", {})))
+                                   dict(t.get("scalars", {})),
+                                   trace=dict(t.get("trace", {})))
         td = serde.task_from_obj(t)
         with self._lock:
             # re-check: a racing decode of the same stage wins ties
@@ -124,7 +125,8 @@ class ExecutorServer:
                  policy: str = "push",
                  job_data_ttl_s: float = 3600.0,
                  janitor_interval_s: float = 300.0,
-                 flight_port: int = -1):
+                 flight_port: int = -1,
+                 metrics_port: int = -1):
         import socket as socketmod
         import tempfile
         import uuid
@@ -188,6 +190,31 @@ class ExecutorServer:
             self.flight = ExecutorFlightServer(self.work_dir, self._dp_token,
                                                host, flight_port)
 
+        # observability listener mirroring the scheduler's exposition:
+        # prometheus /metrics + /health (-1 = disabled, 0 = ephemeral port)
+        self.obs_http = None
+        if metrics_port >= 0:
+            import json as jsonmod
+
+            from ..obs.http import PROM_CTYPE, ObsHttpServer
+
+            def _metrics():
+                return (self.executor.metrics.gather(
+                    self.executor.active_tasks()), PROM_CTYPE)
+
+            def _health():
+                return (jsonmod.dumps({
+                    "status": "draining" if self._draining else "ok",
+                    "executor_id": self.metadata.executor_id,
+                    "policy": self.policy,
+                    "task_slots": self.metadata.task_slots,
+                    "active_tasks": self.executor.active_tasks(),
+                }), "application/json")
+
+            self.obs_http = ObsHttpServer(host, metrics_port,
+                                          {"/metrics": _metrics,
+                                           "/health": _health})
+
         self.rpc.register("launch_multi_task", self._launch_multi_task)
         self.rpc.register("cancel_tasks", self._cancel_tasks)
         self.rpc.register("fetch_partition", self._fetch_partition)
@@ -200,6 +227,8 @@ class ExecutorServer:
         self.rpc.start()
         if self.flight is not None:
             self.flight.start()
+        if self.obs_http is not None:
+            self.obs_http.start()
         if register:
             self.scheduler.register_executor(self.metadata)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
@@ -307,6 +336,9 @@ class ExecutorServer:
         self.rpc.stop()
         if self.flight is not None:
             self.flight.stop()
+        if self.obs_http is not None:
+            self.obs_http.stop()
+            self.obs_http = None
         if self._native_dp is not None:
             self._native_dp.dp_stop()
             self._native_dp = None

@@ -32,6 +32,10 @@ def main(argv=None) -> None:
     ap.add_argument("--flight-port", type=int, default=-1,
                     help="standard Arrow Flight data plane port "
                          "(0 = ephemeral, -1 = disabled)")
+    ap.add_argument("--metrics-port", type=int, default=-1,
+                    help="observability HTTP port serving prometheus "
+                         "/metrics and /health (0 = ephemeral, "
+                         "-1 = disabled)")
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument("--log-dir", default=None,
                     help="write rotating log files here instead of stderr")
@@ -77,7 +81,7 @@ def main(argv=None) -> None:
         args.scheduler_host, args.scheduler_port, args.bind_host,
         args.bind_port, args.work_dir, args.concurrent_tasks,
         external_host=args.external_host, policy=args.scheduling_policy,
-        flight_port=args.flight_port)
+        flight_port=args.flight_port, metrics_port=args.metrics_port)
     server.start()
     logging.info("executor %s on %s:%s (work_dir %s)",
                  server.metadata.executor_id, server.rpc.host, server.rpc.port,

@@ -1,0 +1,24 @@
+"""Observability: distributed tracing spans + per-job query profiles.
+
+Parity: the reference crate's `ballista/core/src/metrics` +
+tracing-opentelemetry wiring, reduced to the pieces this engine needs —
+a span layer propagated client -> scheduler -> executor -> operator, a
+per-job profile ring buffer behind the REST API, and a pluggable span
+collector (noop / in-memory / OTLP-shaped export hook).
+"""
+from .tracing import (  # noqa: F401
+    InMemorySpanCollector,
+    NoopSpanCollector,
+    OtlpSpanCollector,
+    Span,
+    SpanCollector,
+    TaskSpanRecorder,
+    make_collector,
+    new_span_id,
+    new_trace_context,
+    new_trace_id,
+    span_from_obj,
+    span_to_obj,
+)
+from .profile import JobObservability, ProfileStore  # noqa: F401
+from .trace_event import spans_to_chrome  # noqa: F401

@@ -256,6 +256,10 @@ class MeshAggregateExec(ExecutionPlan):
         return Partitioning.single()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         from ..parallel.distributed import distributed_filter_aggregate
         from ..parallel.mesh import MESH_DISPATCH_LOCK, make_mesh, row_sharding
 
@@ -371,6 +375,10 @@ class MeshPartialAggregateExec(ExecutionPlan):
         return self.input.output_partition_count()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         from ..parallel.distributed import distributed_partial_aggregate
         from ..parallel.mesh import MESH_DISPATCH_LOCK, make_mesh, row_sharding
 
@@ -497,6 +505,10 @@ class MeshJoinExec(ExecutionPlan):
         return Partitioning.single()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         assert partition == 0
         lsch, rsch = self.left.schema, self.right.schema
         probe = concat_batches(lsch, [b for p in range(self.left.output_partition_count())
@@ -693,7 +705,7 @@ class MeshTaskJoinExec(MeshJoinExec):
     def output_partitioning(self):
         return self.left.output_partitioning()
 
-    def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         probe = concat_batches(
             self.left.schema, self.left.execute(partition, ctx)).shrink()
         build = concat_batches(

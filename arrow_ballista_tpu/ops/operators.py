@@ -181,6 +181,10 @@ class ProjectionExec(ExecutionPlan):
         return comp, compiled, jfn
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         with self.xla_lock():
             if self._compiled is None:
                 if has_scalar_subquery(*[e for e, _ in self.exprs]):
@@ -247,6 +251,10 @@ class RenameExec(ExecutionPlan):
         return self.input.output_partitioning()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         out = []
         for b in self.input.execute(partition, ctx):
             cols = {new: b.columns[old] for old, new in self._mapping}
@@ -282,6 +290,10 @@ class FilterExec(ExecutionPlan):
         return self.input.output_partitioning()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         with self.xla_lock():
             if self._compiled is None:
                 def build():
@@ -399,6 +411,10 @@ class HashAggregateExec(ExecutionPlan):
         return Partitioning.unknown(self.output_partition_count())
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         ctx.check_cancelled()
         cfg_cap = ctx.config.get(AGG_CAPACITY)
         batches = self.input.execute(partition, ctx)
@@ -963,6 +979,10 @@ class JoinExec(ExecutionPlan):
         return self.left.output_partitioning()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         ctx.check_cancelled()
         probe = concat_batches(self.left.schema, self.left.execute(partition, ctx)).shrink()
         ctx.check_cancelled()
@@ -1420,6 +1440,10 @@ class SortExec(ExecutionPlan):
         return Partitioning.single()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         parts = []
         for p in range(self.input.output_partition_count()):
             ctx.check_cancelled()
@@ -1477,6 +1501,10 @@ class LimitExec(ExecutionPlan):
         return 1
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         parts = []
         for p in range(self.input.output_partition_count()):
             parts.extend(self.input.execute(p, ctx))
@@ -1509,6 +1537,10 @@ class CoalescePartitionsExec(ExecutionPlan):
         return Partitioning.single()
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         out = []
         for p in range(self.input.output_partition_count()):
             out.extend(self.input.execute(p, ctx))

@@ -12,6 +12,7 @@ stream of small batches.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import threading
 import time
@@ -213,12 +214,21 @@ class TaskContext:
     # cancelled job frees its slot without waiting out the whole plan
     # (reference: abortable execution, executor.rs:114-144)
     cancelled: Optional[Callable[[], bool]] = None
+    # obs.tracing.TaskSpanRecorder for the running task; None = tracing off
+    span_recorder: Optional[object] = None
 
     def check_cancelled(self) -> None:
         if self.cancelled is not None and self.cancelled():
             from ..utils.errors import CancelledError
 
             raise CancelledError(f"job {self.job_id} cancelled")
+
+    def op_span(self, op):
+        """Context manager spanning one operator's execute call (a no-op
+        without a recorder, so operators instrument unconditionally)."""
+        if self.span_recorder is None:
+            return contextlib.nullcontext()
+        return self.span_recorder.op_span(op)
 
 
 # --------------------------------------------------------------------------
@@ -487,6 +497,10 @@ class ScanExec(ExecutionPlan):
             return table_to_batches(table, self._schema, capacity)
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         import jax
         import jax.numpy as jnp
 

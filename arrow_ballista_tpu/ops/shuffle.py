@@ -95,6 +95,10 @@ class ShuffleWriterExec(ExecutionPlan):
         return self.partitioning or Partitioning.unknown(self.output_partition_count())
 
     def execute_write(self, partition: int, ctx: TaskContext) -> List[ShuffleWritePartition]:
+        with ctx.op_span(self):
+            return self._execute_write(partition, ctx)
+
+    def _execute_write(self, partition: int, ctx: TaskContext) -> List[ShuffleWritePartition]:
         """Run the child for ``partition`` and write shuffle files."""
         ctx.check_cancelled()
         batches = self.input.execute(partition, ctx)
@@ -214,6 +218,10 @@ class ShuffleReaderExec(ExecutionPlan):
         return self.partition_count
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         locs = self.locations.get(partition)
         if locs is None:
             locs = ctx.shuffle_locations.get((self.stage_id, partition))
@@ -357,6 +365,10 @@ class RepartitionExec(ExecutionPlan):
         self._cache = parts
 
     def execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
+        with ctx.op_span(self):
+            return self._execute(partition, ctx)
+
+    def _execute(self, partition: int, ctx: TaskContext) -> List[ColumnBatch]:
         if self._cache is None:
             self._materialize(ctx)
         return self._cache[partition]

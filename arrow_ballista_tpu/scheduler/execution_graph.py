@@ -92,10 +92,12 @@ class ExecutionStage:
         # map partition -> (executor_id, [ShuffleWritePartition])
         self.outputs: Dict[int, Tuple[str, List[ShuffleWritePartition]]] = {}
 
-    def aggregate_metrics(self) -> Dict[str, float]:
-        """Fold completed tasks' per-operator metrics into one
-        '<op>.<metric>' -> total dict (consumed by the REST stage view and
-        the bench profiler).
+    def operator_metrics(self) -> Dict[str, Dict[str, float]]:
+        """Fold completed tasks' per-operator metrics into a
+        per-operator dict keyed by the ``collect_plan_metrics`` path key
+        (e.g. ``'0.1:HashAggregateExec'``) — the structured view behind
+        the profile endpoint and the dot annotations
+        (``aggregate_metrics`` flattens it for the legacy stage view).
 
         Same-stage tasks in one executor process share operator instances,
         so each task status snapshots the *cumulative* counters at its
@@ -108,7 +110,7 @@ class ExecutionStage:
         and plan-cache evictions alike (id() reuse after GC could in
         principle alias two instances; metrics are observability, not
         correctness)."""
-        per_exec: Dict[str, Dict[str, float]] = {}
+        per_exec: Dict[str, Dict[Tuple[str, str], float]] = {}
         for t in self.task_infos:
             st = getattr(t, "status", None)
             if st is None:
@@ -118,14 +120,21 @@ class ExecutionStage:
                 {})
             for op, mm in (st.metrics or {}).items():
                 for k, v in mm.items():
-                    kk = f"{op}.{k}"
-                    if v > dst.get(kk, float("-inf")):
-                        dst[kk] = v
-        agg: Dict[str, float] = {}
+                    if v > dst.get((op, k), float("-inf")):
+                        dst[(op, k)] = v
+        agg: Dict[str, Dict[str, float]] = {}
         for mm in per_exec.values():
-            for kk, v in mm.items():
-                agg[kk] = agg.get(kk, 0.0) + v
+            for (op, k), v in mm.items():
+                d = agg.setdefault(op, {})
+                d[k] = d.get(k, 0.0) + v
         return agg
+
+    def aggregate_metrics(self) -> Dict[str, float]:
+        """Flattened '<op>.<metric>' -> total view of
+        ``operator_metrics`` (the REST stage view and bench profiler)."""
+        return {f"{op}.{k}": v
+                for op, mm in self.operator_metrics().items()
+                for k, v in mm.items()}
 
     # --- queries ---------------------------------------------------------
     @property
@@ -276,6 +285,9 @@ class ExecutionGraph:
         self.status = "running"
         self.error = ""
         self.scalars: Dict[str, object] = {}
+        # trace propagation context handed to every task of this job
+        # ({"trace_id", "span_id"}; empty when tracing is off)
+        self.trace: Dict[str, str] = {}
         # executor_id -> (host, port) of the data plane; None = local-only
         self.addr_resolver = None
         self._task_id_gen = itertools.count()
@@ -325,7 +337,8 @@ class ExecutionGraph:
                          stage_attempt=stage.stage_attempt)
             return TaskDescription(tid, stage.resolved_plan,
                                    task_internal_id=next(self._task_id_gen),
-                                   scalars=self.scalars)
+                                   scalars=self.scalars,
+                                   trace=dict(self.trace))
         return None
 
     # --- status intake ---------------------------------------------------

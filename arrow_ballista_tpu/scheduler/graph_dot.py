@@ -16,6 +16,20 @@ def _esc(s: str) -> str:
     return s.replace('"', '\\"').replace("\n", "\\n")
 
 
+def _metric_label(mm) -> str:
+    """Fold one operator's metric dict into a short 'rows · time' line so
+    the DAG doubles as a flame view (rows from output_rows, time as the
+    sum of the operator's *_time timers, which are seconds)."""
+    parts = []
+    rows = mm.get("output_rows")
+    if rows:
+        parts.append(f"{int(rows):,} rows")
+    t = sum(v for k, v in mm.items() if k.endswith("_time"))
+    if t:
+        parts.append(f"{t * 1000.0:.1f} ms")
+    return " · ".join(parts)
+
+
 def graph_to_dot(graph: ExecutionGraph) -> str:
     lines: List[str] = [
         "digraph G {",
@@ -33,16 +47,25 @@ def graph_to_dot(graph: ExecutionGraph) -> str:
                      f'attempt {stage.stage_attempt}";')
         plan = stage.resolved_plan or stage.plan
         counter = [0]
+        # per-operator metrics keyed by the executor-side walk's path key
+        # ("0.1:HashAggregateExec", execution_engine.collect_plan_metrics)
+        op_metrics = stage.operator_metrics()
 
-        def walk(node, parent_id=None, sid=sid, counter=counter, out=lines):
+        def walk(node, parent_id=None, path="0", sid=sid, counter=counter,
+                 out=lines):
             nid = f"s{sid}_n{counter[0]}"
             counter[0] += 1
-            out.append(f'    {nid} [label="{_esc(node._label())}"];')
+            label = node._label()
+            extra = _metric_label(
+                op_metrics.get(f"{path}:{type(node).__name__}", {}))
+            if extra:
+                label += "\n" + extra
+            out.append(f'    {nid} [label="{_esc(label)}"];')
             if parent_id is not None:
                 out.append(f"    {nid} -> {parent_id};")
             if not isinstance(node, (ShuffleReaderExec, UnresolvedShuffleExec)):
-                for c in node.children():
-                    walk(c, nid)
+                for i, c in enumerate(node.children()):
+                    walk(c, nid, f"{path}.{i}")
             return nid
 
         walk(plan)

@@ -133,6 +133,11 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             lines.append("# HELP admission_queue_depth jobs waiting for admission")
             lines.append("# TYPE admission_queue_depth gauge")
             lines.append(f"admission_queue_depth {self.admission_queue_depth}")
+            lines.append("# HELP admission_queue_depth_max high-water mark "
+                         "of jobs waiting for admission")
+            lines.append("# TYPE admission_queue_depth_max gauge")
+            lines.append(
+                f"admission_queue_depth_max {self.admission_queue_depth_max}")
             for name, h, help_ in [
                 ("planning_time_seconds", self.planning_time, "job planning time"),
                 ("job_exec_time_seconds", self.exec_time, "job execution time"),

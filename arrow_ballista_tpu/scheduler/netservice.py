@@ -82,7 +82,8 @@ def group_tasks_by_plan(objs: List[dict]) -> List[dict]:
         g = groups.setdefault(id(o["plan"]), {"plan": o["plan"], "tasks": []})
         g["tasks"].append({"task": o["task"],
                            "internal_id": o["internal_id"],
-                           "scalars": o["scalars"]})
+                           "scalars": o["scalars"],
+                           "trace": o.get("trace", {})})
     return list(groups.values())
 
 
@@ -95,7 +96,8 @@ def ungroup_tasks(payload: dict) -> List[dict]:
         for env in st["tasks"]:
             out.append({"task": env["task"], "plan": st["plan"],
                         "internal_id": env.get("internal_id", 0),
-                        "scalars": env.get("scalars", {})})
+                        "scalars": env.get("scalars", {}),
+                        "trace": env.get("trace", {})})
     return out
 
 
@@ -174,9 +176,13 @@ class SchedulerNetService:
             from .persistence import FileJobStateBackend
 
             job_backend = FileJobStateBackend(state_dir)
-        self.server = SchedulerServer(launcher, scheduler_config,
-                                      job_backend=job_backend,
-                                      cluster_state=cluster_state)
+        from ..obs import JobObservability
+
+        self.server = SchedulerServer(
+            launcher, scheduler_config,
+            job_backend=job_backend,
+            cluster_state=cluster_state,
+            observability=JobObservability.from_config(self.config))
         launcher.scheduler = self.server
         self.rpc = RpcServer(host, port)
         self.host, self.port = self.rpc.host, self.rpc.port
@@ -359,7 +365,8 @@ class SchedulerNetService:
             from ..admission import AdmissionRequest
 
             request = AdmissionRequest.from_config(session_config)
-        self.server.submit_job(job_id, plan_fn, admission=request)
+        self.server.submit_job(job_id, plan_fn, admission=request,
+                               trace=payload.get("trace"))
         return {"job_id": job_id}, b""
 
     def _get_job_status(self, payload: dict, _bin: bytes):

@@ -14,6 +14,8 @@ api/mod.rs:85-137 + handlers.rs):
 Beyond the reference surface:
 
     GET  /api/admission        admission-control queue state per tenant
+    GET  /api/job/<id>/profile per-stage -> per-task -> per-operator profile
+    GET  /api/job/<id>/trace   Chrome trace-event JSON (Perfetto-loadable)
 """
 from __future__ import annotations
 
@@ -93,6 +95,21 @@ class RestApi:
             h._send(200, json.dumps(self._jobs()))
         elif len(rest) == 3 and rest[0] == "job" and rest[2] == "stages":
             h._send(200, json.dumps(self._stages(rest[1])))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "profile":
+            prof = self.server.obs.get_profile(
+                rest[1], self.server.jobs.get_graph(rest[1]),
+                self.server.jobs.get_status(rest[1]))
+            if prof is None:
+                h._send(404, json.dumps({"error": "no profile for job"}))
+            else:
+                h._send(200, json.dumps(prof))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "trace":
+            trace = self.server.obs.get_trace(
+                rest[1], self.server.jobs.get_graph(rest[1]))
+            if trace is None:
+                h._send(404, json.dumps({"error": "no trace for job"}))
+            else:
+                h._send(200, json.dumps(trace))
         elif len(rest) == 3 and rest[0] == "job" and rest[2] == "dot":
             graph = self.server.jobs.get_graph(rest[1])
             if graph is None:

@@ -142,9 +142,10 @@ class SchedulerServer:
                  config: Optional[SchedulerConfig] = None,
                  metrics: Optional["SchedulerMetricsCollector"] = None,
                  job_backend=None, scheduler_id: Optional[str] = None,
-                 cluster_state=None):
+                 cluster_state=None, observability=None):
         import uuid
 
+        from ..obs import JobObservability
         from .metrics import InMemoryMetricsCollector
 
         self.config = config or SchedulerConfig()
@@ -154,6 +155,10 @@ class SchedulerServer:
         self.jobs = JobState()
         self.launcher = launcher
         self.metrics = metrics if metrics is not None else InMemoryMetricsCollector()
+        # tracing + profile retention (arrow_ballista_tpu/obs/): phase
+        # spans per job, task span intake, /api/job/<id>/profile|trace
+        self.obs = observability if observability is not None \
+            else JobObservability()
         # optional persistence: checkpoint graphs on every transition so a
         # restarted/sibling scheduler can adopt them (reference JobState
         # backends + try_acquire_job)
@@ -240,8 +245,10 @@ class SchedulerServer:
 
     def submit_job(self, job_id: str,
                    plan_fn: Callable[[], Tuple[object, Dict[str, object]]],
-                   admission: Optional[AdmissionRequest] = None) -> None:
+                   admission: Optional[AdmissionRequest] = None,
+                   trace: Optional[Dict[str, str]] = None) -> None:
         self.jobs.accept_job(job_id)
+        self.obs.on_submitted(job_id, trace)
         self._queued_at_ms[job_id] = int(time.time() * 1000)
         self.admission.submit(job_id, plan_fn, admission)
 
@@ -249,6 +256,7 @@ class SchedulerServer:
     def _admission_admit(self, job_id: str, plan_fn: Callable) -> None:
         if self._stopped.is_set():
             return
+        self.obs.on_admitted(job_id)
         self._event_loop.post(JobQueued(job_id, plan_fn))
 
     def _admission_reject(self, job_id: str, message: str) -> None:
@@ -263,6 +271,14 @@ class SchedulerServer:
     def _on_job_terminal(self, status: JobStatus) -> None:
         if status.state in ("successful", "failed", "cancelled"):
             self.admission.release(status.job_id)
+            # finalize the job's trace/profile off the retained graph —
+            # one hook covers success, failure, cancel and admission shed
+            try:
+                self.obs.on_finished(status,
+                                     self.jobs.get_graph(status.job_id))
+            except Exception:  # noqa: BLE001 — observability is best-effort
+                log.exception("profile finalization failed for %s",
+                              status.job_id)
 
     def update_task_status(self, executor_id: str,
                            statuses: List[TaskStatus]) -> None:
@@ -355,6 +371,9 @@ class SchedulerServer:
             self.metrics.record_failed(ev.job_id)
             self._queued_at_ms.pop(ev.job_id, None)
             return
+        self.obs.on_planned(ev.job_id)
+        # hand the execution span's context to every task of this job
+        ev.graph.trace = self.obs.task_parent(ev.job_id)
         self.jobs.submit_job(ev.job_id, ev.graph)
         self.metrics.record_submitted(ev.job_id,
                                       self._queued_at_ms.get(ev.job_id, 0),

@@ -67,8 +67,12 @@ class StandaloneCluster:
         self.config = config or BallistaConfig()
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-")
         self._owns_work_dir = work_dir is None
+        from ..obs import JobObservability
+
         self.launcher = InProcessTaskLauncher()
-        self.scheduler = SchedulerServer(self.launcher, scheduler_config)
+        self.scheduler = SchedulerServer(
+            self.launcher, scheduler_config,
+            observability=JobObservability.from_config(self.config))
         self.launcher.scheduler = self.scheduler
         self.scheduler.init()
         self.executors: List[Executor] = []
@@ -111,9 +115,11 @@ class StandaloneCluster:
 
         job_id = random_job_id()
         from ..admission import AdmissionRequest
+        from ..obs import new_trace_context
 
         self.scheduler.submit_job(job_id, lambda: (planned.plan, scalars),
-                                  admission=AdmissionRequest.from_config(self.config))
+                                  admission=AdmissionRequest.from_config(self.config),
+                                  trace=new_trace_context())
         # deadline is config-driven (round-2 failure mode: a slow first-compile
         # TPU run blew through a hard-coded 300 s wait and "failed" a job that
         # would have finished)

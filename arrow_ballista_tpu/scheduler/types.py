@@ -44,6 +44,9 @@ class TaskDescription:
     # job-level scalar-subquery values, shipped with every task (the
     # reference ships session props the same way, ballista.proto:446-449)
     scalars: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # trace propagation context ({"trace_id", "span_id"} of the job's
+    # execution span); empty when tracing is disabled
+    trace: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -81,6 +84,9 @@ class TaskStatus:
     # executors share one process and thus one plan instance / MetricsSet;
     # stage metric aggregation must dedupe cumulative snapshots per process)
     process_id: str = ""
+    # task span tree (obs.tracing.Span objects; serialized with the
+    # status over the wire, empty when tracing is disabled)
+    spans: List[object] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

@@ -482,15 +482,19 @@ def task_to_obj(td: TaskDescription, plan_obj: dict = None) -> dict:
     netservice.serialize_tasks_or_fail)."""
     return {"task": vars(td.task),
             "plan": plan_obj if plan_obj is not None else plan_to_obj(td.plan),
-            "internal_id": td.task_internal_id, "scalars": dict(td.scalars)}
+            "internal_id": td.task_internal_id, "scalars": dict(td.scalars),
+            "trace": dict(td.trace)}
 
 
 def task_from_obj(o: dict) -> TaskDescription:
     return TaskDescription(TaskId(**o["task"]), plan_from_obj(o["plan"]),
-                           o.get("internal_id", 0), dict(o.get("scalars", {})))
+                           o.get("internal_id", 0), dict(o.get("scalars", {})),
+                           trace=dict(o.get("trace", {})))
 
 
 def status_to_obj(st: TaskStatus) -> dict:
+    from .obs.tracing import span_to_obj
+
     return {
         "task": vars(st.task), "executor_id": st.executor_id, "state": st.state,
         "writes": [vars(w) for w in st.shuffle_writes],
@@ -498,13 +502,17 @@ def status_to_obj(st: TaskStatus) -> dict:
         "launch_ms": st.launch_time_ms, "start_ms": st.start_time_ms,
         "end_ms": st.end_time_ms, "metrics": st.metrics,
         "process_id": st.process_id,
+        "spans": [span_to_obj(s) for s in (st.spans or [])],
     }
 
 
 def status_from_obj(o: dict) -> TaskStatus:
+    from .obs.tracing import span_from_obj
+
     return TaskStatus(
         TaskId(**o["task"]), o["executor_id"], o["state"],
         [ShuffleWritePartition(**w) for w in o["writes"]],
         FailedReason(**o["failure"]) if o.get("failure") else None,
         o.get("launch_ms", 0), o.get("start_ms", 0), o.get("end_ms", 0),
-        o.get("metrics", {}), o.get("process_id", ""))
+        o.get("metrics", {}), o.get("process_id", ""),
+        spans=[span_from_obj(s) for s in o.get("spans", [])])

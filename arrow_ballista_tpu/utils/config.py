@@ -44,6 +44,11 @@ ADMISSION_QUEUE_TIMEOUT_S = "ballista.admission.queue.timeout.seconds"
 ADMISSION_MAX_PENDING_TASKS = "ballista.admission.max_pending_tasks"
 ADMISSION_SLOT_SHARE = "ballista.admission.tenant.slot_share"
 ADMISSION_RETRY_AFTER_S = "ballista.admission.retry_after.seconds"
+# observability / tracing (arrow_ballista_tpu/obs/)
+OBS_TRACING = "ballista.observability.tracing"
+OBS_PROFILE_RETENTION = "ballista.observability.profile.retention"
+OBS_COLLECTOR = "ballista.observability.collector"
+OBS_OTLP_ENDPOINT = "ballista.observability.otlp.endpoint"
 
 
 @dataclasses.dataclass
@@ -163,6 +168,24 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "fraction (0..1] of the cluster's registered task "
                     "slots this tenant's running jobs may occupy at once "
                     "(0 = unlimited)"),
+        ConfigEntry(OBS_TRACING, True, _parse_bool,
+                    "distributed tracing: span propagation client -> "
+                    "scheduler -> executor -> operator, the per-job profile "
+                    "ring buffer, and the /api/job/<id>/profile|trace "
+                    "endpoints (False = spans off, endpoints return 404)"),
+        ConfigEntry(OBS_PROFILE_RETENTION, 64, int,
+                    "finished job profiles (and their span sets) the "
+                    "scheduler retains in a ring buffer for "
+                    "/api/job/<id>/profile and /trace"),
+        ConfigEntry(OBS_COLLECTOR, "noop", str,
+                    "span export collector: 'noop' (default), 'memory' "
+                    "(bounded in-process buffer), or 'otlp' (best-effort "
+                    "OTLP/HTTP JSON POST to "
+                    "ballista.observability.otlp.endpoint)"),
+        ConfigEntry(OBS_OTLP_ENDPOINT, "", str,
+                    "OTLP/HTTP endpoint (e.g. "
+                    "http://localhost:4318/v1/traces) used when the 'otlp' "
+                    "collector is selected"),
         ConfigEntry(ADMISSION_RETRY_AFTER_S, 5, int,
                     "retry-after hint (seconds) embedded in retriable "
                     "admission failures (queue full / queue timeout)"),

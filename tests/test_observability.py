@@ -19,7 +19,7 @@ def stack(tmp_path_factory):
     sched.start()
     ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
                         work_dir=str(tmp_path_factory.mktemp("obs")),
-                        executor_id="obs-exec")
+                        executor_id="obs-exec", metrics_port=0)
     ex.start()
     ctx = BallistaContext.remote("127.0.0.1", sched.port)
     ctx.register_table("t", pa.table({
@@ -103,6 +103,252 @@ def test_keda_scaler_endpoint(stack):
     sched, ex, ctx = stack
     out = _get(sched, "/api/scaler")
     assert "inflight_tasks" in out and isinstance(out["inflight_tasks"], int)
+
+
+def _run_job(sched, ctx, sql="select g, sum(v) as s from t group by g"):
+    """Run a query through the remote stack and return its job id."""
+    ctx.sql(sql).to_pandas()
+    jobs = [j for j in _get(sched, "/api/jobs") if j["state"] == "successful"]
+    assert jobs
+    return jobs[-1]["job_id"]
+
+
+def test_job_profile_endpoint(stack):
+    """GET /api/job/<id>/profile: per-stage -> per-task -> per-operator
+    breakdown for a completed multi-stage query (acceptance criterion)."""
+    sched, ex, ctx = stack
+    job_id = _run_job(sched, ctx,
+                      "select g, sum(v) as s from t group by g order by g")
+    prof = _get(sched, f"/api/job/{job_id}/profile")
+    assert prof["job_id"] == job_id and prof["state"] == "successful"
+    assert prof["trace_id"] and prof["wall_time_ms"] > 0
+    assert set(prof["phases"]) == {"admission", "planning", "execution"}
+    assert len(prof["stages"]) >= 2  # group-by + order-by force shuffles
+    op_names = set()
+    for stage in prof["stages"]:
+        assert stage["state"] == "successful"
+        # per-stage aggregated operator metrics, keyed by plan path
+        assert any(k.endswith("ShuffleWriterExec")
+                   for k in stage["operators"])
+        assert stage["tasks"], stage
+        for task in stage["tasks"]:
+            assert task["state"] == "success"
+            assert task["executor_id"] == "obs-exec"
+            # per-task span tree: at least the stage's shuffle writer
+            assert task["operators"], task
+            for op in task["operators"]:
+                assert op["duration_ms"] >= 0
+                op_names.add(op["op"])
+            # cumulative per-operator metric snapshot rides along too
+            assert task["metrics"]
+    assert "ShuffleWriterExec" in op_names
+    assert {"HashAggregateExec", "SortExec"} & op_names
+    # unknown jobs 404
+    with pytest.raises(urllib.request.HTTPError):
+        _get(sched, "/api/job/zzzzzzz/profile")
+
+
+def test_job_trace_endpoint_chrome_schema_and_coverage(stack):
+    """GET /api/job/<id>/trace: valid Chrome trace-event JSON whose spans
+    cover >= 95% of the job's wall time (acceptance criterion)."""
+    sched, ex, ctx = stack
+    job_id = _run_job(sched, ctx)
+    trace = _get(sched, f"/api/job/{job_id}/trace")
+    assert trace["traceId"]
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert xs and metas
+    # schema: every X event is a complete event with numeric us timing
+    for e in xs:
+        assert isinstance(e["name"], str) and e["cat"]
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float)) and e["dur"] > 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert "span_id" in e["args"]
+    # named processes for scheduler + executor
+    pnames = {e["args"]["name"] for e in metas
+              if e["name"] == "process_name"}
+    assert "scheduler" in pnames and "executor obs-exec" in pnames
+    # operator spans propagated back from the executor share the trace
+    assert any(e["cat"] == "operator" for e in xs)
+    # coverage: union of span intervals vs the root job span
+    root = next(e for e in xs if e["name"] == f"job {job_id}")
+    lo, hi = root["ts"], root["ts"] + root["dur"]
+    covered, cur = 0.0, None
+    for a, b in sorted((e["ts"], e["ts"] + e["dur"]) for e in xs):
+        a, b = max(a, lo), min(b, hi)
+        if b <= a:
+            continue
+        if cur is None or a > cur[1]:
+            if cur is not None:
+                covered += cur[1] - cur[0]
+            cur = [a, b]
+        else:
+            cur[1] = max(cur[1], b)
+    if cur is not None:
+        covered += cur[1] - cur[0]
+    assert covered / (hi - lo) >= 0.95
+
+
+def test_dot_metric_annotations(stack):
+    """The graphviz DAG carries per-operator rows/time labels once task
+    metrics are in (flame-view satellite)."""
+    sched, ex, ctx = stack
+    job_id = _run_job(sched, ctx)
+    dot = _get(sched, f"/api/job/{job_id}/dot", as_json=False)
+    assert "rows" in dot and "ms" in dot
+
+
+def test_executor_metrics_and_health_endpoint(stack):
+    """Executor-side prometheus /metrics + /health listener satellite."""
+    sched, ex, ctx = stack
+    _run_job(sched, ctx)
+    port = ex.obs_http.port
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        body = r.read().decode()
+    for name in ("executor_tasks_launched_total",
+                 "executor_tasks_completed_total",
+                 "executor_tasks_failed_total",
+                 "executor_tasks_killed_total",
+                 "executor_shuffle_bytes_written_total",
+                 "executor_active_tasks",
+                 "executor_task_duration_seconds_count"):
+        assert name in body, name
+    completed = [l for l in body.splitlines()
+                 if l.startswith("executor_tasks_completed_total ")][0]
+    assert int(completed.split()[-1]) >= 1
+    written = [l for l in body.splitlines()
+               if l.startswith("executor_shuffle_bytes_written_total ")][0]
+    assert int(written.split()[-1]) > 0
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/health",
+                                timeout=10) as r:
+        health = json.loads(r.read().decode())
+    assert health["status"] == "ok"
+    assert health["executor_id"] == "obs-exec"
+    assert isinstance(health["active_tasks"], int)
+    with pytest.raises(urllib.request.HTTPError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=10)
+
+
+def test_span_propagation_remote_path(stack):
+    """Task/operator spans produced in the executor cross the wire with
+    the status update and land in the scheduler's trace, parented on the
+    job's execution span."""
+    sched, ex, ctx = stack
+    job_id = _run_job(sched, ctx)
+    spans = sched.server.obs.profiles.get_spans(job_id)
+    assert spans is not None
+    by_id = {s.span_id: s for s in spans}
+    trace_ids = {s.trace_id for s in spans}
+    assert len(trace_ids) == 1  # one trace from client context to kernels
+    task_spans = [s for s in spans if s.kind == "executor"]
+    op_spans = [s for s in spans if s.kind == "operator"]
+    assert task_spans and op_spans
+    exec_phase = next(s for s in spans
+                      if s.kind == "scheduler" and s.name == "execution")
+    for t in task_spans:
+        assert t.parent_id == exec_phase.span_id
+        assert t.attrs["executor_id"] == "obs-exec"
+    for o in op_spans:
+        # operator spans nest (ShuffleWriterExec -> HashAggregateExec ->
+        # scan); every chain must climb to its task span
+        cur, hops = o, 0
+        while cur.kind == "operator" and hops < 50:
+            cur = by_id[cur.parent_id]
+            hops += 1
+        assert cur.kind == "executor"
+        assert o.end_ms >= o.start_ms
+
+
+def test_span_propagation_standalone_path(tmp_path):
+    """Same trace spine through the in-proc standalone cluster, with the
+    pluggable in-memory collector receiving the export."""
+    import pandas as pd
+
+    from arrow_ballista_tpu.utils.config import (
+        OBS_COLLECTOR,
+        OBS_PROFILE_RETENTION,
+    )
+
+    ctx = BallistaContext.standalone(
+        config=BallistaConfig({OBS_COLLECTOR: "memory",
+                               OBS_PROFILE_RETENTION: 8}))
+    try:
+        ctx.register_table("t", pd.DataFrame({
+            "g": np.arange(200) % 5, "v": np.arange(200)}))
+        out = ctx.sql("select g, count(*) c from t group by g").to_pandas()
+        assert len(out) == 5
+        sched = ctx._standalone.scheduler
+        job_id = sched.jobs.job_ids()[-1]
+        prof = sched.obs.get_profile(job_id, sched.jobs.get_graph(job_id),
+                                     sched.jobs.get_status(job_id))
+        assert prof["state"] == "successful"
+        assert any(t["operators"] for s in prof["stages"]
+                   for t in s["tasks"])
+        # the configured collector got the export (pluggability satellite)
+        exported = sched.obs.collector.snapshot(prof["trace_id"])
+        assert any(s.kind == "operator" for s in exported)
+        assert sched.obs.profiles.capacity == 8
+    finally:
+        ctx.shutdown()
+
+
+def test_trace_event_json_schema_unit():
+    """spans_to_chrome on a synthetic tree: JSON-serializable, metadata
+    events name processes/threads, nesting preserved via args."""
+    from arrow_ballista_tpu.obs.tracing import Span, new_trace_id
+    from arrow_ballista_tpu.obs.trace_event import spans_to_chrome
+
+    tid = new_trace_id()
+    root = Span("job j1", tid, attrs={"actor": "scheduler", "lane": "job j1"})
+    child = Span("task j1/1/0", tid, parent_id=root.span_id,
+                 kind="executor",
+                 attrs={"actor": "executor e1", "lane": "stage 1 / p0"})
+    child.end()
+    root.end()
+    doc = spans_to_chrome([root, child])
+    encoded = json.loads(json.dumps(doc))
+    assert encoded["traceId"] == tid
+    xs = [e for e in encoded["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"job j1", "task j1/1/0"}
+    assert all(e["dur"] >= 1.0 for e in xs)
+    pids = {e["pid"] for e in xs}
+    assert len(pids) == 2  # scheduler + executor processes
+    child_ev = next(e for e in xs if e["name"] == "task j1/1/0")
+    root_ev = next(e for e in xs if e["name"] == "job j1")
+    assert child_ev["args"]["parent_id"] == root_ev["args"]["span_id"]
+
+
+def test_admission_queue_depth_max_gauge():
+    """Satellite fix: the high-water mark tracked by
+    set_admission_queue_depth is actually exported by gather()."""
+    from arrow_ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+
+    c = InMemoryMetricsCollector()
+    c.set_admission_queue_depth(3)
+    c.set_admission_queue_depth(1)
+    text = c.gather()
+    assert "# TYPE admission_queue_depth_max gauge" in text
+    lines = dict(l.rsplit(" ", 1) for l in text.splitlines()
+                 if l and not l.startswith("#"))
+    assert lines["admission_queue_depth"] == "1"
+    assert lines["admission_queue_depth_max"] == "3"
+
+
+def test_metrics_docs_consistency():
+    """CI satellite: every emitted metric name appears in metrics.md."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = Path(__file__).resolve().parent.parent / "tools" / \
+        "check_metrics_docs.py"
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
 
 
 def test_rotating_file_logging(tmp_path):
