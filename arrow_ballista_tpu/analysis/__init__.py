@@ -1,0 +1,33 @@
+"""Static analysis: AST lint suite + pre-launch plan sanity validation.
+
+Run the lint suite with ``python -m arrow_ballista_tpu.analysis``; the plan
+validator (``plan_checks.validate_graph``) runs automatically on every
+``ExecutionGraph`` before task launch when ``ballista.analysis.plan_checks``
+is on (the default).  See docs/developer-guide/static-analysis.md.
+"""
+from .framework import (
+    Project,
+    Rule,
+    SourceFile,
+    Violation,
+    all_rules,
+    json_report,
+    register,
+    run_lints,
+    text_report,
+)
+from .plan_checks import check_graph, validate_graph
+
+__all__ = [
+    "Project",
+    "Rule",
+    "SourceFile",
+    "Violation",
+    "all_rules",
+    "check_graph",
+    "json_report",
+    "register",
+    "run_lints",
+    "text_report",
+    "validate_graph",
+]

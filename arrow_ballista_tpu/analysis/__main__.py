@@ -1,0 +1,51 @@
+"""CLI runner: ``python -m arrow_ballista_tpu.analysis``.
+
+Exit status 0 = clean, 1 = violations found, 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .framework import all_rules, json_report, run_lints, text_report
+
+
+def default_root() -> str:
+    # .../repo/arrow_ballista_tpu/analysis/__main__.py -> repo
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m arrow_ballista_tpu.analysis",
+        description="Run the project's static-analysis lint suite.")
+    parser.add_argument("--root", default=default_root(),
+                        help="repo root to analyze (default: this checkout)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a JSON report instead of text")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list registered rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(all_rules().items()):
+            print(f"{name}: {cls.description}")
+        return 0
+
+    rule_names = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                  if args.rules else None)
+    try:
+        violations = run_lints(args.root, rule_names=rule_names)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(json_report(violations) if args.json else text_report(violations))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,242 @@
+"""Custom lint framework: rule registry, suppressions, reporters.
+
+The codebase's correctness rests on conventions no general-purpose linter
+knows about (device-residency in operator hot paths, ``ctx.op_span``
+coverage, the serde wire-type registry, the config-key registry, scheduler
+lock discipline).  Zerrow (arxiv 2504.06151) and the zero-cost
+Arrow<->Spark interface work (arxiv 2106.13020) both show that a single
+accidental host<->device materialization silently erases zero-copy wins —
+exactly the regression class a static pass catches before a benchmark
+does.  This module is the harness; the rules live in ``rules.py``.
+
+Usage:
+
+    python -m arrow_ballista_tpu.analysis            # text report, exit 1 on hits
+    python -m arrow_ballista_tpu.analysis --json     # machine-readable
+
+Per-line suppression::
+
+    x = np.asarray(v)  # ballista: allow=hot-path-purity — host-mode path
+
+A suppression comment on its own line applies to the next line.  Every
+suppression should carry a justification after the rule name.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Type
+
+_SUPPRESS_RE = re.compile(r"#\s*ballista:\s*allow=([A-Za-z0-9_,*-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding: ``rule`` fired at ``path:line``."""
+
+    rule: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """A parsed python source plus its per-line suppression map."""
+
+    def __init__(self, relpath: str, text: str):
+        self.path = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text, filename=relpath)
+        except SyntaxError as e:  # surfaced as a violation by the runner
+            self.parse_error = str(e)
+        # line (1-based) -> set of suppressed rule names ('*' = all)
+        self.suppressions: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppressions[i] = {r.strip() for r in m.group(1).split(",")}
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """True when ``rule`` is allowed at ``line`` — by a trailing comment
+        on the line itself, or by a comment-only line directly above it."""
+        for cand in (line, line - 1):
+            rules = self.suppressions.get(cand)
+            if rules is None:
+                continue
+            if cand == line - 1 and not self._comment_only(cand):
+                continue  # a trailing comment suppresses its OWN line only
+            if rule in rules or "*" in rules:
+                return True
+        return False
+
+    def _comment_only(self, line: int) -> bool:
+        if not (1 <= line <= len(self.lines)):
+            return False
+        return self.lines[line - 1].lstrip().startswith("#")
+
+
+class Project:
+    """The analyzed tree: repo root + the python package under it.
+
+    Tests point this at fixture trees with the same relative layout, so
+    rules never hard-code absolute paths.
+    """
+
+    def __init__(self, root: str, package: str = "arrow_ballista_tpu"):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self._files: Dict[str, Optional[SourceFile]] = {}
+
+    def abspath(self, relpath: str) -> str:
+        return os.path.join(self.root, *relpath.split("/"))
+
+    def exists(self, relpath: str) -> bool:
+        return os.path.exists(self.abspath(relpath))
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        try:
+            with open(self.abspath(relpath), encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        if relpath not in self._files:
+            text = self.read_text(relpath)
+            self._files[relpath] = (SourceFile(relpath, text)
+                                    if text is not None else None)
+        return self._files[relpath]
+
+    def source_files(self) -> List[SourceFile]:
+        """Every ``.py`` file under the package, sorted by path."""
+        out = []
+        pkg_dir = self.abspath(self.package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                rel = rel.replace(os.sep, "/")
+                sf = self.file(rel)
+                if sf is not None:
+                    out.append(sf)
+        return out
+
+
+class Rule:
+    """Base lint rule.  ``check(project)`` yields raw violations; the
+    runner applies suppressions afterward, so rules never special-case
+    them."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, project: Project) -> Iterable[Violation]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding a rule to the global registry."""
+    if not cls.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if cls.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    from . import rules  # noqa: F401 — importing registers the built-ins
+
+    return dict(_REGISTRY)
+
+
+def run_lints(root: str, rule_names: Optional[Sequence[str]] = None,
+              package: str = "arrow_ballista_tpu") -> List[Violation]:
+    """Run the lint suite over ``root``; returns unsuppressed violations
+    sorted by (path, line, rule)."""
+    registry = all_rules()
+    if rule_names is None:
+        selected = list(registry.values())
+    else:
+        unknown = [n for n in rule_names if n not in registry]
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(unknown)}; "
+                             f"known: {', '.join(sorted(registry))}")
+        selected = [registry[n] for n in rule_names]
+    project = Project(root, package=package)
+    findings: List[Violation] = []
+    for sf in project.source_files():
+        if sf.parse_error:
+            findings.append(Violation("syntax", sf.path, 0,
+                                      f"cannot parse: {sf.parse_error}"))
+    for cls in selected:
+        for v in cls().check(project):
+            sf = project.file(v.path) if v.path.endswith(".py") else None
+            if sf is not None and sf.is_suppressed(v.rule, v.line):
+                continue
+            findings.append(v)
+    return sorted(findings, key=lambda v: (v.path, v.line, v.rule))
+
+
+def text_report(violations: Sequence[Violation]) -> str:
+    if not violations:
+        return "analysis: clean (0 violations)"
+    lines = [v.format() for v in violations]
+    lines.append(f"analysis: {len(violations)} violation(s)")
+    return "\n".join(lines)
+
+
+def json_report(violations: Sequence[Violation]) -> str:
+    return json.dumps({"violations": [dataclasses.asdict(v) for v in violations],
+                       "count": len(violations)}, indent=2)
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers (used by rules.py)
+# --------------------------------------------------------------------------
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Map local name -> imported module/object dotted path."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_self_attr(node: ast.AST, attrs: Set[str]) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in attrs)

@@ -1,0 +1,190 @@
+"""Pre-launch physical-plan sanity validation.
+
+DataFusion runs ``SanityCheckPlan`` after physical optimization to reject
+plans whose invariants the optimizer silently broke; this is the
+distributed-stage analog, run on every ``ExecutionGraph`` before the first
+task launches (gated by ``ballista.analysis.plan_checks``, default on).
+Catching a writer/reader partition mismatch here costs microseconds; the
+same bug at runtime surfaces as a fetch failure on some reducer minutes in,
+after a full map-stage of wasted work.
+
+Checks:
+
+- stage DAG sanity: producers exist, no cycles, no orphan stages
+  (unreachable from the final stage);
+- shuffle boundaries: every ``UnresolvedShuffleExec`` agrees with its
+  producer's ``ShuffleWriterExec`` on output partition count and schema;
+- repartitioned joins: both build/probe shuffle inputs hash-partitioned
+  with the same bucket count and key arity (a disagreement means rows with
+  equal keys land in different buckets — wrong answers, not a crash);
+- pass-through operators (filter/sort/limit/coalesce/shuffle-write) carry
+  exactly their child's schema.
+
+All failures are collected, then raised together as ``PlanValidationError``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..ops.operators import (
+    CoalescePartitionsExec,
+    FilterExec,
+    JoinExec,
+    LimitExec,
+    SortExec,
+)
+from ..ops.physical import ExecutionPlan, Partitioning
+from ..ops.shuffle import ShuffleWriterExec, UnresolvedShuffleExec
+from ..utils.errors import PlanValidationError
+
+PASS_THROUGH = (FilterExec, SortExec, LimitExec, CoalescePartitionsExec,
+                ShuffleWriterExec)
+
+
+def _writer_output_count(writer: ShuffleWriterExec) -> int:
+    part = writer.partitioning
+    return part.count if part is not None else 1
+
+
+def _writer_partitioning(writer: ShuffleWriterExec) -> Optional[Partitioning]:
+    return writer.partitioning
+
+
+def _walk(plan: ExecutionPlan):
+    yield plan
+    for child in plan.children():
+        yield from _walk(child)
+
+
+def _shuffle_leaves(plan: ExecutionPlan) -> List[UnresolvedShuffleExec]:
+    return [n for n in _walk(plan) if isinstance(n, UnresolvedShuffleExec)]
+
+
+def validate_graph(graph) -> None:
+    """Raise ``PlanValidationError`` if ``graph`` breaks a launch invariant.
+
+    ``graph`` is an ``ExecutionGraph`` (duck-typed: ``stages`` mapping,
+    ``final_stage_id``, each stage with ``plan``/``producer_ids``)."""
+    errors = check_graph(graph)
+    if errors:
+        raise PlanValidationError(graph.job_id, errors)
+
+
+def check_graph(graph) -> List[str]:
+    """Like ``validate_graph`` but returns the error list (for tooling)."""
+    errors: List[str] = []
+    stages = graph.stages
+
+    # --- DAG shape: unknown producers, cycles, orphans -------------------
+    for sid, stage in sorted(stages.items()):
+        for pid in stage.producer_ids:
+            if pid not in stages:
+                errors.append(f"stage {sid} reads unknown producer stage {pid}")
+        if sid in stage.producer_ids:
+            errors.append(f"stage {sid} reads its own output")
+
+    color: Dict[int, int] = {}  # 0 visiting, 1 done
+
+    def has_cycle(sid: int, path: List[int]) -> bool:
+        state = color.get(sid)
+        if state == 0:
+            errors.append("cyclic stage dependency: "
+                          + " -> ".join(map(str, path + [sid])))
+            return True
+        if state == 1:
+            return False
+        color[sid] = 0
+        for pid in stages[sid].producer_ids:
+            if pid in stages and has_cycle(pid, path + [sid]):
+                break  # report one cycle per root, not every unwind frame
+        color[sid] = 1
+        return False
+
+    for sid in sorted(stages):
+        has_cycle(sid, [])
+
+    reachable = set()
+    frontier = [graph.final_stage_id] if graph.final_stage_id in stages else []
+    while frontier:
+        sid = frontier.pop()
+        if sid in reachable:
+            continue
+        reachable.add(sid)
+        frontier.extend(p for p in stages[sid].producer_ids if p in stages)
+    for sid in sorted(set(stages) - reachable):
+        errors.append(f"orphan stage {sid}: unreachable from final stage "
+                      f"{graph.final_stage_id}")
+
+    # --- shuffle boundaries ----------------------------------------------
+    for sid, stage in sorted(stages.items()):
+        for leaf in _shuffle_leaves(stage.plan):
+            producer = stages.get(leaf.stage_id)
+            if producer is None:
+                continue  # already reported as unknown producer
+            writer = producer.plan
+            if not isinstance(writer, ShuffleWriterExec):
+                errors.append(f"stage {leaf.stage_id} feeds a shuffle read "
+                              f"in stage {sid} but its root is not a "
+                              f"ShuffleWriterExec")
+                continue
+            want = leaf.output_partition_count()
+            got = _writer_output_count(writer)
+            if want != got:
+                errors.append(
+                    f"shuffle partition mismatch across stages "
+                    f"{leaf.stage_id} -> {sid}: writer produces {got} "
+                    f"partitions, reader expects {want}")
+            if leaf.schema != writer.schema:
+                errors.append(
+                    f"shuffle schema mismatch across stages "
+                    f"{leaf.stage_id} -> {sid}: writer emits "
+                    f"{writer.schema.names()} but reader expects "
+                    f"{leaf.schema.names()}")
+
+    # --- repartitioned-join hash agreement -------------------------------
+    for sid, stage in sorted(stages.items()):
+        for node in _walk(stage.plan):
+            if not isinstance(node, JoinExec):
+                continue
+            kids = node.children()
+            if len(kids) != 2:
+                continue
+            sides = [_shuffle_leaves(k) for k in kids]
+            if not (len(sides[0]) == 1 and len(sides[1]) == 1):
+                continue  # not a both-sides-repartitioned join
+            parts: List[Optional[Partitioning]] = []
+            for leaf in (sides[0][0], sides[1][0]):
+                producer = stages.get(leaf.stage_id)
+                writer = producer.plan if producer is not None else None
+                parts.append(_writer_partitioning(writer)
+                             if isinstance(writer, ShuffleWriterExec) else None)
+            left, right = parts
+            if left is None or right is None:
+                continue
+            if left.kind == "hash" and right.kind == "hash":
+                if left.count != right.count:
+                    errors.append(
+                        f"join in stage {sid}: build/probe shuffle inputs "
+                        f"use different hash partition counts "
+                        f"({left.count} vs {right.count})")
+                if len(left.exprs) != len(right.exprs):
+                    errors.append(
+                        f"join in stage {sid}: build/probe shuffle inputs "
+                        f"hash on different key arity "
+                        f"({len(left.exprs)} vs {len(right.exprs)})")
+
+    # --- pass-through schema consistency ---------------------------------
+    for sid, stage in sorted(stages.items()):
+        for node in _walk(stage.plan):
+            if not isinstance(node, PASS_THROUGH):
+                continue
+            kids = node.children()
+            if len(kids) != 1:
+                continue
+            if node.schema != kids[0].schema:
+                errors.append(
+                    f"stage {sid}: {type(node).__name__} changes its "
+                    f"child's schema ({kids[0].schema.names()} -> "
+                    f"{node.schema.names()}) but is a pass-through operator")
+
+    return errors

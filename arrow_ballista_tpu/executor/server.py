@@ -86,13 +86,14 @@ class SchedulerClient:
         self.host, self.port = host, port
 
     def register_executor(self, meta: ExecutorMetadata) -> None:
-        wire.call(self.host, self.port, "register_executor", {"meta": vars(meta)})
+        wire.call(self.host, self.port, "register_executor",
+                  {"meta": serde.executor_metadata_to_obj(meta)})
 
     def heartbeat(self, executor_id: str, status: str = "active",
                   meta: Optional[ExecutorMetadata] = None) -> None:
         payload = {"executor_id": executor_id, "status": status}
         if meta is not None:
-            payload["meta"] = vars(meta)
+            payload["meta"] = serde.executor_metadata_to_obj(meta)
         wire.call(self.host, self.port, "heartbeat", payload)
 
     def update_task_status(self, executor_id: str,

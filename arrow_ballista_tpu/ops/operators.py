@@ -205,9 +205,13 @@ class ProjectionExec(ExecutionPlan):
                     if c.dict_fn is not None:
                         dicts[n] = c.dict_fn(b.dicts)
                 if self.host_mode:
+                    # host_mode exists precisely to run python UDF exprs on
+                    # host — the materialization IS the execution model here
+                    # ballista: allow=hot-path-purity — host-mode UDF path
                     cols_np = {k: np.asarray(v) for k, v in b.columns.items()}
                     aux = comp.aux_arrays(b.dicts)
                     with np.errstate(divide="ignore", invalid="ignore"):
+                        # ballista: allow=hot-path-purity — host-mode UDF path
                         new_cols = {n: np.broadcast_to(np.asarray(c.fn(cols_np, aux)), (b.capacity,))
                                     for c, n in compiled}
                     out.append(ColumnBatch(
@@ -321,10 +325,13 @@ class FilterExec(ExecutionPlan):
             with self.metrics().timer("compute_time"):
                 aux = comp.aux_arrays(b.dicts)
                 if self.host_mode:
+                    # ballista: allow=hot-path-purity — host-mode UDF path
                     cols_np = {k: np.asarray(v) for k, v in b.columns.items()}
                     with np.errstate(divide="ignore", invalid="ignore"):
                         keep = np.broadcast_to(
+                            # ballista: allow=hot-path-purity — host-mode UDF path
                             np.asarray(pred.fn(cols_np, aux)), (b.capacity,))
+                    # ballista: allow=hot-path-purity — host-mode UDF path
                     mask = jnp.asarray(np.asarray(b.mask) & keep)
                 else:
                     mask = jfn(b.columns, b.mask, aux)
@@ -452,6 +459,7 @@ class HashAggregateExec(ExecutionPlan):
                 # vs observed min/max (both device scalars, one roundtrip)
                 mismatch = self._declared_range_mismatch(ctx, big, partition)
                 if mismatch is not None:
+                    # ballista: allow=hot-path-purity — deliberate single batched scalar sync
                     dis_v, mis_v = jax.device_get((disorder, mismatch))
                     if bool(mis_v):
                         self.metrics().add("clustered_range_mismatches", 1)
@@ -590,6 +598,7 @@ class HashAggregateExec(ExecutionPlan):
             # pay the ~75 ms fixed transfer latency once per scalar)
             fetch = (live, disorder,
                      mismatch if mismatch is not None else np.False_)
+            # ballista: allow=hot-path-purity — deliberate single batched scalar sync
             live_v, dis_v, mis_v = jax.device_get(fetch)
             if bool(mis_v):
                 # declared ranges are wrong (stale stats): the overlap
@@ -863,6 +872,7 @@ class HashAggregateExec(ExecutionPlan):
             for a in self.aggs:
                 f = self._schema.field(a.name)
                 if f.nullable:
+                    # ballista: allow=hot-path-purity — builds the 1-row empty-input agg result on host
                     data[a.name] = np.asarray([f.dtype.null_sentinel],
                                               dtype=f.dtype.np_dtype)
                 else:
@@ -1359,6 +1369,7 @@ class JoinExec(ExecutionPlan):
             dicts.update(build.dicts)
         # all window counts in ONE program + ONE host transfer (per-window
         # scalar syncs would cost ~75 ms each on remote-attached devices)
+        # ballista: allow=hot-path-purity — deliberate single batched transfer
         window_counts = np.asarray(wcfn(probe.columns, probe.mask, bh_sorted,
                                         laux, chunk_rows, chunks))
         grand_total = 0  # the cross-join guard must see the SUM of windows

@@ -82,5 +82,6 @@ class EventLoop:
         while time.monotonic() < deadline:
             if self._queue.empty():
                 return True
+            # ballista: allow=no-blocking-in-event-loop — drain() runs on the calling (test) thread, never the loop thread
             time.sleep(0.005)
         return False

@@ -400,11 +400,11 @@ class KvClusterState:
 
     # --- executors -------------------------------------------------------
     def register_executor(self, meta) -> None:
-        from .types import ExecutorHeartbeat
+        from ..serde import executor_metadata_to_obj
 
         self.store.txn([
             ("put", EXECUTORS, meta.executor_id,
-             json.dumps(vars(meta), separators=(",", ":"))),
+             json.dumps(executor_metadata_to_obj(meta), separators=(",", ":"))),
             ("put", SLOTS, meta.executor_id, str(meta.task_slots)),
             ("put", HEARTBEATS, meta.executor_id,
              json.dumps({"ts": time.time(), "status": "active"})),
@@ -431,9 +431,9 @@ class KvClusterState:
                        json.dumps({"ts": time.time(), "status": status}))
 
     def executors(self):
-        from .types import ExecutorMetadata
+        from ..serde import executor_metadata_from_obj
 
-        return [ExecutorMetadata(**json.loads(v))
+        return [executor_metadata_from_obj(json.loads(v))
                 for _, v in self.store.scan(EXECUTORS)]
 
     def total_slots(self) -> int:
@@ -442,10 +442,10 @@ class KvClusterState:
         return sum(m.task_slots for m in self.executors())
 
     def get_executor(self, executor_id: str):
-        from .types import ExecutorMetadata
+        from ..serde import executor_metadata_from_obj
 
         val = self.store.get(EXECUTORS, executor_id)
-        return ExecutorMetadata(**json.loads(val)) if val else None
+        return executor_metadata_from_obj(json.loads(val)) if val else None
 
     def alive_executors(self, timeout_s: float = 60.0) -> List[str]:
         now = time.time()

@@ -366,7 +366,8 @@ class SchedulerNetService:
 
             request = AdmissionRequest.from_config(session_config)
         self.server.submit_job(job_id, plan_fn, admission=request,
-                               trace=payload.get("trace"))
+                               trace=payload.get("trace"),
+                               config=session_config)
         return {"job_id": job_id}, b""
 
     def _get_job_status(self, payload: dict, _bin: bytes):
@@ -392,14 +393,15 @@ class SchedulerNetService:
 
     # --- executor control ------------------------------------------------
     def _register_executor(self, payload: dict, _bin: bytes):
-        self.server.register_executor(ExecutorMetadata(**payload["meta"]))
+        self.server.register_executor(
+            serde.executor_metadata_from_obj(payload["meta"]))
         return {}, b""
 
     def _heartbeat(self, payload: dict, _bin: bytes):
         meta = payload.get("meta")
         self.server.heartbeat(ExecutorHeartbeat(
             payload["executor_id"], status=payload.get("status", "active"),
-            metadata=ExecutorMetadata(**meta) if meta else None))
+            metadata=serde.executor_metadata_from_obj(meta) if meta else None))
         return {}, b""
 
     def _update_task_status(self, payload: dict, _bin: bytes):

@@ -25,6 +25,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..admission import AdmissionController, AdmissionRequest
+from ..analysis.plan_checks import validate_graph
+from ..utils.config import ANALYSIS_PLAN_CHECKS
 from .cluster import ClusterState, JobState
 from .event_loop import EventLoop
 from .execution_graph import ExecutionGraph
@@ -165,6 +167,9 @@ class SchedulerServer:
         self.job_backend = job_backend
         self.scheduler_id = scheduler_id or f"scheduler-{uuid.uuid4().hex[:8]}"
         self._queued_at_ms: Dict[str, int] = {}
+        # job_id -> submitting session's BallistaConfig (popped at planning
+        # or terminal shed/cancel; entries are only written before JobQueued)
+        self._job_configs: Dict[str, object] = {}
         self._event_loop = EventLoop("scheduler-events", self._on_event,
                                      self.config.event_buffer_size,
                                      on_error=self._on_event_error)
@@ -246,9 +251,16 @@ class SchedulerServer:
     def submit_job(self, job_id: str,
                    plan_fn: Callable[[], Tuple[object, Dict[str, object]]],
                    admission: Optional[AdmissionRequest] = None,
-                   trace: Optional[Dict[str, str]] = None) -> None:
+                   trace: Optional[Dict[str, str]] = None,
+                   config: Optional[object] = None) -> None:
+        """``config``: the submitting session's BallistaConfig — consulted
+        at planning time for ``ballista.analysis.plan_checks`` (None = all
+        defaults).  Stashed here because the admission queue only carries
+        (job_id, plan_fn)."""
         self.jobs.accept_job(job_id)
         self.obs.on_submitted(job_id, trace)
+        if config is not None:
+            self._job_configs[job_id] = config
         self._queued_at_ms[job_id] = int(time.time() * 1000)
         self.admission.submit(job_id, plan_fn, admission)
 
@@ -264,6 +276,7 @@ class SchedulerServer:
         client should back off and resubmit, not treat it as a query
         error."""
         self._queued_at_ms.pop(job_id, None)
+        self._job_configs.pop(job_id, None)
         self.jobs.set_status(JobStatus(job_id, "failed", error=message,
                                        retriable=True))
         self.metrics.record_failed(job_id)
@@ -353,8 +366,13 @@ class SchedulerServer:
         # (reference spawns planning too, query_stage_scheduler.rs:106-148)
         def plan():
             try:
+                cfg = self._job_configs.pop(ev.job_id, None)
                 plan, scalars = ev.plan_fn()
                 graph = ExecutionGraph.build(ev.job_id, plan)
+                if cfg is None or cfg.get(ANALYSIS_PLAN_CHECKS):
+                    # pre-launch sanity validation (analysis/plan_checks.py):
+                    # reject broken stage wiring before any task runs
+                    validate_graph(graph)
                 graph.scalars = scalars
                 graph.addr_resolver = self._resolve_addr
                 self._event_loop.post(JobPlanned(ev.job_id, graph))
@@ -433,6 +451,7 @@ class SchedulerServer:
             # out so it never plans, and free its tenant's queue slot
             if self.admission.take_queued(ev.job_id):
                 self._queued_at_ms.pop(ev.job_id, None)
+                self._job_configs.pop(ev.job_id, None)
                 self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
                 self.metrics.record_cancelled(ev.job_id)
             return

@@ -97,7 +97,7 @@ class SessionManager:
                                  **(settings or {})})
         session = Session(sid, config, OverlayCatalog(self.shared_catalog))
         with self._lock:
-            self._evict_expired()
+            self._evict_expired_locked()
             self._sessions[sid] = session
         return session
 
@@ -121,7 +121,8 @@ class SessionManager:
         with self._lock:
             self._sessions.pop(session_id, None)
 
-    def _evict_expired(self) -> None:
+    def _evict_expired_locked(self) -> None:
+        # caller holds self._lock (repo convention: *_locked suffix)
         now = time.time()
         for sid in [sid for sid, s in self._sessions.items()
                     if now - s.last_used > self.ttl_s]:

@@ -119,7 +119,8 @@ class StandaloneCluster:
 
         self.scheduler.submit_job(job_id, lambda: (planned.plan, scalars),
                                   admission=AdmissionRequest.from_config(self.config),
-                                  trace=new_trace_context())
+                                  trace=new_trace_context(),
+                                  config=self.config)
         # deadline is config-driven (round-2 failure mode: a slow first-compile
         # TPU run blew through a hard-coded 300 s wait and "failed" a job that
         # would have finished)

@@ -25,7 +25,16 @@ from .ops.mesh_exec import (
 from .ops import physical as P
 from .ops import shuffle as SH
 from .ops.shuffle import PartitionLocation, ShuffleWritePartition
-from .scheduler.types import FailedReason, TaskDescription, TaskId, TaskStatus
+from .scheduler.types import (
+    ExecutorHeartbeat,
+    ExecutorMetadata,
+    ExecutorReservation,
+    FailedReason,
+    JobStatus,
+    TaskDescription,
+    TaskId,
+    TaskStatus,
+)
 from .utils.errors import InternalError
 
 SERDE_VERSION = 1
@@ -516,3 +525,97 @@ def status_from_obj(o: dict) -> TaskStatus:
         o.get("launch_ms", 0), o.get("start_ms", 0), o.get("end_ms", 0),
         o.get("metrics", {}), o.get("process_id", ""),
         spans=[span_from_obj(s) for s in o.get("spans", [])])
+
+
+# --------------------------------------------------------------------------
+# wire-type registry
+# --------------------------------------------------------------------------
+
+def taskid_to_obj(t: TaskId) -> dict:
+    return vars(t)
+
+
+def taskid_from_obj(o: dict) -> TaskId:
+    return TaskId(**o)
+
+
+def failed_reason_to_obj(r: FailedReason) -> dict:
+    return vars(r)
+
+
+def failed_reason_from_obj(o: dict) -> FailedReason:
+    return FailedReason(**o)
+
+
+def shuffle_write_to_obj(w: ShuffleWritePartition) -> dict:
+    return vars(w)
+
+
+def shuffle_write_from_obj(o: dict) -> ShuffleWritePartition:
+    return ShuffleWritePartition(**o)
+
+
+def executor_metadata_to_obj(m: ExecutorMetadata) -> dict:
+    return vars(m)
+
+
+def executor_metadata_from_obj(o: dict) -> ExecutorMetadata:
+    return ExecutorMetadata(**o)
+
+
+def executor_heartbeat_to_obj(h: ExecutorHeartbeat) -> dict:
+    return {"executor_id": h.executor_id, "timestamp": h.timestamp,
+            "status": h.status,
+            "metadata": (executor_metadata_to_obj(h.metadata)
+                         if h.metadata is not None else None)}
+
+
+def executor_heartbeat_from_obj(o: dict) -> ExecutorHeartbeat:
+    meta = o.get("metadata")
+    return ExecutorHeartbeat(
+        o["executor_id"], o.get("timestamp", 0.0), o.get("status", "active"),
+        executor_metadata_from_obj(meta) if meta else None)
+
+
+def executor_reservation_to_obj(r: ExecutorReservation) -> dict:
+    return vars(r)
+
+
+def executor_reservation_from_obj(o: dict) -> ExecutorReservation:
+    return ExecutorReservation(**o)
+
+
+def job_status_to_obj(js: JobStatus) -> dict:
+    # JSON object keys are strings; partition ids re-int on decode
+    return {"job_id": js.job_id, "state": js.state, "error": js.error,
+            "locations": {str(p): [location_to_obj(l) for l in locs]
+                          for p, locs in js.locations.items()},
+            "retriable": js.retriable}
+
+
+def job_status_from_obj(o: dict) -> JobStatus:
+    return JobStatus(
+        o["job_id"], o["state"], o.get("error", ""),
+        {int(p): [location_from_obj(l) for l in locs]
+         for p, locs in o.get("locations", {}).items()},
+        o.get("retriable", False))
+
+
+# Every control-plane dataclass that crosses a process boundary, with its
+# to/from pair.  The serde-completeness lint checks membership statically;
+# tests/test_serde_wire.py round-trips every entry with representative
+# payloads.  Keys MUST be bare class names (a dict literal) so the lint can
+# read the registry without importing this module.
+WIRE_TYPES = {
+    TaskId: (taskid_to_obj, taskid_from_obj),
+    TaskDescription: (task_to_obj, task_from_obj),
+    TaskStatus: (status_to_obj, status_from_obj),
+    FailedReason: (failed_reason_to_obj, failed_reason_from_obj),
+    ShuffleWritePartition: (shuffle_write_to_obj, shuffle_write_from_obj),
+    PartitionLocation: (location_to_obj, location_from_obj),
+    ExecutorMetadata: (executor_metadata_to_obj, executor_metadata_from_obj),
+    ExecutorHeartbeat: (executor_heartbeat_to_obj, executor_heartbeat_from_obj),
+    ExecutorReservation: (executor_reservation_to_obj,
+                          executor_reservation_from_obj),
+    JobStatus: (job_status_to_obj, job_status_from_obj),
+}

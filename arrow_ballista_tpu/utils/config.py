@@ -49,6 +49,8 @@ OBS_TRACING = "ballista.observability.tracing"
 OBS_PROFILE_RETENTION = "ballista.observability.profile.retention"
 OBS_COLLECTOR = "ballista.observability.collector"
 OBS_OTLP_ENDPOINT = "ballista.observability.otlp.endpoint"
+# static analysis (arrow_ballista_tpu/analysis/)
+ANALYSIS_PLAN_CHECKS = "ballista.analysis.plan_checks"
 
 
 @dataclasses.dataclass
@@ -101,8 +103,14 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "derive from input row counts at plan time"),
         ConfigEntry(BATCH_SIZE, 1 << 17, int, "static row capacity of a device ColumnBatch"),
         ConfigEntry(JOB_NAME, "", str, "human-readable job name"),
-        ConfigEntry(REPARTITION_JOINS, True, _parse_bool, ""),
-        ConfigEntry(REPARTITION_AGGREGATIONS, True, _parse_bool, ""),
+        ConfigEntry(REPARTITION_JOINS, True, _parse_bool,
+                    "reference-parity placeholder (config.rs:34): the "
+                    "distributed planner always repartitions joins; "
+                    "accepted and propagated but not yet consulted"),
+        ConfigEntry(REPARTITION_AGGREGATIONS, True, _parse_bool,
+                    "reference-parity placeholder (config.rs:35): the "
+                    "distributed planner always repartitions aggregations; "
+                    "accepted and propagated but not yet consulted"),
         ConfigEntry(PARQUET_PRUNING, True, _parse_bool, "row-group pruning on parquet scans"),
         ConfigEntry(AGG_CAPACITY, 1 << 16, int, "static max distinct groups per aggregation"),
         ConfigEntry(JOIN_OUTPUT_FACTOR, 2, int,
@@ -110,7 +118,10 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "share (plain joins size outputs by a count pass)"),
         ConfigEntry(JOIN_MAX_CAPACITY, 1 << 26, int,
                     "hard ceiling for adaptive join-capacity growth (rows)"),
-        ConfigEntry(COLLECT_STATISTICS, True, _parse_bool, ""),
+        ConfigEntry(COLLECT_STATISTICS, True, _parse_bool,
+                    "reference-parity placeholder (config.rs:38): scans "
+                    "always collect the statistics pruning needs; accepted "
+                    "and propagated but not yet consulted"),
         ConfigEntry(MESH_SHUFFLE, False, _parse_bool, "use ICI mesh all-to-all shuffle"),
         ConfigEntry(MESH_HYBRID, False, _parse_bool,
                     "hybrid exchange: mesh-fused partials per host, file shuffle across hosts"),
@@ -189,6 +200,12 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(ADMISSION_RETRY_AFTER_S, 5, int,
                     "retry-after hint (seconds) embedded in retriable "
                     "admission failures (queue full / queue timeout)"),
+        ConfigEntry(ANALYSIS_PLAN_CHECKS, True, _parse_bool,
+                    "pre-launch plan sanity validation: reject an "
+                    "ExecutionGraph with shuffle partition/schema "
+                    "mismatches or orphan/cyclic stage dependencies before "
+                    "any task launches (see "
+                    "docs/developer-guide/static-analysis.md)"),
     ]
 }
 

@@ -33,6 +33,19 @@ class InternalError(BallistaError):
     pass
 
 
+class PlanValidationError(PlanningError):
+    """Pre-launch plan sanity validation rejected an ExecutionGraph.
+
+    Raised by ``analysis.plan_checks.validate_graph`` before any task of the
+    job launches; carries every violated invariant, not just the first."""
+
+    def __init__(self, job_id: str, errors):
+        self.job_id = job_id
+        self.errors = list(errors)
+        detail = "; ".join(self.errors)
+        super().__init__(f"plan validation failed for job {job_id}: {detail}")
+
+
 class ConfigurationError(BallistaError):
     pass
 

@@ -1,0 +1,165 @@
+"""Exhaustive wire-type serde round-trips.
+
+Reflects over ``serde.WIRE_TYPES`` so the NEXT control-plane dataclass that
+gets registered is automatically exercised — and an unregistered one fails
+the companion lint (serde-completeness) plus the sample-coverage assertion
+here.  The universal property is canonical round-trip stability,
+``to(from(to(x))) == to(x)``, which holds even for types whose fields
+(plan objects, span objects) lack structural ``__eq__``; every encoding
+must also survive ``json.dumps`` (the wire framing is JSON).
+"""
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import serde
+from arrow_ballista_tpu.models import expr as E
+from arrow_ballista_tpu.models.schema import INT64, Field, Schema
+from arrow_ballista_tpu.obs.tracing import Span
+from arrow_ballista_tpu.ops.physical import MemoryScanExec, Partitioning
+from arrow_ballista_tpu.ops.shuffle import (
+    PartitionLocation,
+    ShuffleWriterExec,
+    ShuffleWritePartition,
+)
+from arrow_ballista_tpu.scheduler.types import (
+    EXECUTION_ERROR,
+    FETCH_PARTITION_ERROR,
+    ExecutorHeartbeat,
+    ExecutorMetadata,
+    ExecutorReservation,
+    FailedReason,
+    JobStatus,
+    TaskDescription,
+    TaskId,
+    TaskStatus,
+)
+
+SCHEMA = Schema([Field("k", INT64), Field("v", INT64)])
+
+
+def _plan():
+    table = pa.table({"k": pa.array(np.arange(8, dtype=np.int64)),
+                      "v": pa.array(np.arange(8, dtype=np.int64))})
+    return ShuffleWriterExec(MemoryScanExec(SCHEMA, table, partitions=2),
+                             Partitioning.hash([E.Column("k")], 4),
+                             stage_id=3)
+
+
+LOCATION = PartitionLocation("exec-1", 2, 5, "/tmp/shuffle/data-5.arrow",
+                             num_rows=100, num_bytes=4096,
+                             host="10.0.0.2", port=50051)
+
+# representative payloads per registered wire type: defaults-only AND
+# fully-populated variants, plus the tricky shapes (nested Optional
+# metadata, int-keyed location maps, span-bearing statuses)
+SAMPLES = {
+    TaskId: [
+        TaskId("job-1", 2, 7),
+        TaskId("job-1", 2, 7, task_attempt=3, stage_attempt=1),
+    ],
+    TaskDescription: [
+        TaskDescription(TaskId("job-1", 3, 0), _plan()),
+        TaskDescription(TaskId("job-1", 3, 1), _plan(), task_internal_id=42,
+                        scalars={"sq0": 12.5},
+                        trace={"trace_id": "t" * 32, "span_id": "s" * 16}),
+    ],
+    TaskStatus: [
+        TaskStatus(TaskId("job-1", 1, 0), "exec-1", "success"),
+        TaskStatus(TaskId("job-1", 1, 1), "exec-2", "failed",
+                   shuffle_writes=[ShuffleWritePartition(0, "/tmp/d0", 5, 64)],
+                   failure=FailedReason(FETCH_PARTITION_ERROR, "gone",
+                                        map_stage_id=1, map_partition_id=4,
+                                        executor_id="exec-3"),
+                   launch_time_ms=1, start_time_ms=2, end_time_ms=3,
+                   metrics={"0:ScanExec": {"output_rows": 8}},
+                   process_id="pid-1",
+                   spans=[Span("task", trace_id="t" * 32, span_id="s" * 16,
+                               kind="executor", start_ms=1.0, end_ms=2.0)]),
+    ],
+    FailedReason: [
+        FailedReason(EXECUTION_ERROR, "boom"),
+        FailedReason(FETCH_PARTITION_ERROR, "lost", map_stage_id=2,
+                     map_partition_id=9, executor_id="exec-9"),
+    ],
+    ShuffleWritePartition: [
+        ShuffleWritePartition(3, "/tmp/shuffle/data-3.arrow", 128, 8192),
+    ],
+    PartitionLocation: [
+        PartitionLocation("exec-1", 0, 1, "/tmp/p"),
+        LOCATION,
+    ],
+    ExecutorMetadata: [
+        ExecutorMetadata("exec-1"),
+        ExecutorMetadata("exec-2", host="10.0.0.9", port=7000,
+                         grpc_port=7001, task_slots=8),
+    ],
+    ExecutorHeartbeat: [
+        ExecutorHeartbeat("exec-1", timestamp=123.5),
+        ExecutorHeartbeat("exec-2", timestamp=124.0, status="terminating",
+                          metadata=ExecutorMetadata("exec-2", port=7000)),
+    ],
+    ExecutorReservation: [
+        ExecutorReservation("exec-1"),
+        ExecutorReservation("exec-2", job_id="job-9"),
+    ],
+    JobStatus: [
+        JobStatus("job-1", "running"),
+        JobStatus("job-2", "failed", error="shed", retriable=True),
+        JobStatus("job-3", "successful",
+                  locations={0: [LOCATION], 3: [LOCATION, LOCATION]}),
+    ],
+}
+
+
+def test_every_wire_type_has_samples():
+    missing = [t.__name__ for t in serde.WIRE_TYPES if t not in SAMPLES]
+    assert not missing, (
+        f"wire types without representative payloads: {missing} — add "
+        f"SAMPLES entries so new registrations are actually exercised")
+    stale = [t.__name__ for t in SAMPLES if t not in serde.WIRE_TYPES]
+    assert not stale, f"SAMPLES covers unregistered types: {stale}"
+
+
+@pytest.mark.parametrize("wire_type", sorted(serde.WIRE_TYPES,
+                                             key=lambda t: t.__name__),
+                         ids=lambda t: t.__name__)
+def test_round_trip_stability_and_json_safety(wire_type):
+    to_obj, from_obj = serde.WIRE_TYPES[wire_type]
+    for sample in SAMPLES.get(wire_type, []):
+        encoded = to_obj(sample)
+        # the wire framing is JSON: every encoding must survive it verbatim
+        rehydrated = json.loads(json.dumps(encoded))
+        decoded = from_obj(rehydrated)
+        assert isinstance(decoded, wire_type)
+        assert to_obj(decoded) == encoded, (
+            f"{wire_type.__name__} round-trip is not stable")
+
+
+def test_decoded_fields_match_for_value_types():
+    """Types whose fields are all plain values must decode EQUAL, not just
+    stably — catches a to/from pair that consistently drops a field."""
+    for wire_type in (TaskId, FailedReason, ShuffleWritePartition,
+                      PartitionLocation, ExecutorMetadata,
+                      ExecutorReservation):
+        to_obj, from_obj = serde.WIRE_TYPES[wire_type]
+        for sample in SAMPLES[wire_type]:
+            assert from_obj(json.loads(json.dumps(to_obj(sample)))) == sample
+
+
+def test_job_status_locations_rekeyed_to_int():
+    to_obj, from_obj = serde.WIRE_TYPES[JobStatus]
+    decoded = from_obj(json.loads(json.dumps(to_obj(SAMPLES[JobStatus][2]))))
+    assert set(decoded.locations) == {0, 3}
+    assert all(isinstance(k, int) for k in decoded.locations)
+    assert decoded.locations[3][1] == LOCATION
+
+
+def test_heartbeat_nested_metadata_round_trips():
+    to_obj, from_obj = serde.WIRE_TYPES[ExecutorHeartbeat]
+    hb = SAMPLES[ExecutorHeartbeat][1]
+    decoded = from_obj(json.loads(json.dumps(to_obj(hb))))
+    assert decoded.metadata == hb.metadata
+    assert from_obj(to_obj(SAMPLES[ExecutorHeartbeat][0])).metadata is None

@@ -1,0 +1,490 @@
+"""Static-analysis suite tests: the repo-wide clean gate, seeded-violation
+fixtures proving every rule fires AND respects suppressions, and the
+pre-launch plan validator (good graph passes; partition/schema mismatches,
+cycles, orphans and join hash disagreements are rejected — including
+end-to-end through the scheduler).
+"""
+import os
+import textwrap
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu.analysis import check_graph, run_lints, validate_graph
+from arrow_ballista_tpu.analysis.framework import all_rules
+from arrow_ballista_tpu.models import expr as E
+from arrow_ballista_tpu.models.schema import INT64, Field, Schema
+from arrow_ballista_tpu.ops.operators import FilterExec, JoinExec
+from arrow_ballista_tpu.ops.physical import MemoryScanExec, Partitioning
+from arrow_ballista_tpu.ops.shuffle import ShuffleWriterExec, UnresolvedShuffleExec
+from arrow_ballista_tpu.scheduler.execution_graph import ExecutionGraph
+from arrow_ballista_tpu.scheduler.planner import QueryStage
+from arrow_ballista_tpu.utils.config import ANALYSIS_PLAN_CHECKS, BallistaConfig
+from arrow_ballista_tpu.utils.errors import PlanValidationError
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+
+# --------------------------------------------------------------------------
+# the standing gate: the repository itself is clean
+# --------------------------------------------------------------------------
+
+def test_repo_is_clean():
+    violations = run_lints(REPO_ROOT)
+    assert violations == [], "\n".join(v.format() for v in violations)
+
+
+def test_all_rules_registered():
+    names = set(all_rules())
+    assert {"hot-path-purity", "span-coverage", "serde-completeness",
+            "config-registry", "lock-discipline",
+            "no-blocking-in-event-loop", "metrics-docs"} <= names
+
+
+# --------------------------------------------------------------------------
+# seeded-violation fixtures: each rule fires, and suppressions are honored
+# --------------------------------------------------------------------------
+
+def write_fixture(root: Path, relpath: str, source: str) -> None:
+    p = root / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+
+
+def lint(root: Path, rule: str):
+    return run_lints(str(root), rule_names=[rule])
+
+
+def test_hot_path_purity_fires_and_respects_suppression(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/operators.py", """\
+        import numpy as np
+        import jax.numpy as jnp
+        import jax
+
+        def bad(v):
+            return np.asarray(v)
+
+        def fine_jnp(v):
+            return jnp.asarray(v)  # jax.numpy stays on device: not flagged
+
+        def bad_method(v):
+            return v.tolist()
+
+        def justified(v):
+            return np.asarray(v)  # ballista: allow=hot-path-purity — test
+        """)
+    found = lint(tmp_path, "hot-path-purity")
+    assert [(v.line, v.rule) for v in found] == [(6, "hot-path-purity"),
+                                                (12, "hot-path-purity")]
+
+
+def test_hot_path_purity_resolves_aliases(tmp_path):
+    # `import numpy as xx` must still be caught; `import other as np` must not
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/kernels.py", """\
+        import numpy as xx
+        import collections as np
+
+        def f(v):
+            return xx.asarray(v)
+
+        def g(v):
+            return np.asarray(v)  # not numpy: the alias points elsewhere
+        """)
+    found = lint(tmp_path, "hot-path-purity")
+    assert [v.line for v in found] == [5]
+
+
+def test_span_coverage_fires_and_accepts_compliant_shapes(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/myops.py", """\
+        class Unwrapped:
+            def execute(self, partition, ctx):
+                return []
+
+        class Wrapped:
+            def execute(self, partition, ctx):
+                with ctx.op_span(self):
+                    return []
+
+        class RaisesOnly:
+            def execute(self, partition, ctx):
+                raise RuntimeError("cannot execute")
+
+        class Delegates:
+            def execute(self, partition, ctx):
+                return self.execute_write(partition, ctx)
+
+            def execute_write(self, partition, ctx):
+                with ctx.op_span(self):
+                    return []
+
+        class Suppressed:
+            # ballista: allow=span-coverage — test fixture
+            def execute(self, partition, ctx):
+                return []
+
+        class NotAnOperator:
+            def execute(self):
+                return []
+        """)
+    found = lint(tmp_path, "span-coverage")
+    assert len(found) == 1
+    assert found[0].line == 2 and "Unwrapped.execute" in found[0].message
+
+
+def test_serde_completeness_fires_and_respects_suppression(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/types.py", """\
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Registered:
+            x: int
+
+        @dataclasses.dataclass
+        class Forgotten:
+            y: int
+
+        @dataclasses.dataclass
+        class Waived:  # ballista: allow=serde-completeness — test fixture
+            z: int
+        """)
+    write_fixture(tmp_path, "arrow_ballista_tpu/serde.py", """\
+        from .scheduler.types import Registered
+
+        def r_to(x):
+            return vars(x)
+
+        def r_from(o):
+            return Registered(**o)
+
+        WIRE_TYPES = {
+            Registered: (r_to, r_from),
+        }
+        """)
+    found = lint(tmp_path, "serde-completeness")
+    assert len(found) == 1
+    assert "Forgotten" in found[0].message
+
+
+def test_serde_completeness_flags_missing_registry(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/serde.py", "X = 1\n")
+    found = lint(tmp_path, "serde-completeness")
+    assert len(found) == 1
+    assert "WIRE_TYPES" in found[0].message
+
+
+def test_config_registry_fires(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/utils/config.py", """\
+        GOOD = "ballista.good"
+        UNREGISTERED = "ballista.unregistered"
+        EMPTY_DOC = "ballista.empty_doc"
+
+        class ConfigEntry:
+            def __init__(self, key, default, parse, doc=""):
+                pass
+
+        _ENTRIES = {
+            e.key: e
+            for e in [
+                ConfigEntry(GOOD, 1, int, "a documented key"),
+                ConfigEntry(EMPTY_DOC, 1, int, ""),
+                ConfigEntry("ballista.undocumented_in_md", 1, int, "doc"),
+            ]
+        }
+        """)
+    write_fixture(tmp_path, "arrow_ballista_tpu/client.py", """\
+        def f(cfg):
+            cfg.set("ballista.good", 2)
+            return cfg.get("ballista.never_registered")
+        """)
+    write_fixture(tmp_path, "docs/user-guide/configs.md",
+                  "| `ballista.good` | ... |\n| `ballista.empty_doc` | |\n")
+    found = lint(tmp_path, "config-registry")
+    messages = [v.message for v in found]
+    assert any("UNREGISTERED" in m for m in messages)
+    assert any("'ballista.empty_doc'" in m and "empty doc" in m
+               for m in messages)
+    assert any("ballista.undocumented_in_md" in m and "absent" in m
+               for m in messages)
+    assert any("ballista.never_registered" in m for m in messages)
+    assert not any("'ballista.good'" in m for m in messages)
+
+
+def test_lock_discipline_fires_and_respects_conventions(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/cluster.py", """\
+        import threading
+
+        class ClusterState:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._executors = {}
+
+            def bad(self, k, v):
+                self._executors[k] = v
+
+            def bad_method_call(self, k):
+                self._executors.pop(k, None)
+
+            def good(self, k, v):
+                with self._lock:
+                    self._executors[k] = v
+
+            def _helper_locked(self, k):
+                del self._executors[k]
+
+            def suppressed(self, k):
+                self._executors.clear()  # ballista: allow=lock-discipline — test
+        """)
+    found = lint(tmp_path, "lock-discipline")
+    assert [v.line for v in found] == [9, 12]
+    assert all("_executors" in v.message for v in found)
+
+
+def test_lock_discipline_treats_nested_defs_as_unlocked(tmp_path):
+    # a closure created under the lock may RUN later on another thread
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/cluster.py", """\
+        import threading
+
+        class ClusterState:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._available = {}
+
+            def schedule(self):
+                with self._lock:
+                    def later():
+                        self._available.clear()
+                    return later
+        """)
+    found = lint(tmp_path, "lock-discipline")
+    assert [v.line for v in found] == [11]
+
+
+def test_no_blocking_in_event_loop_fires(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/event_loop.py", """\
+        import time
+        import socket
+
+        def handler(ev):
+            time.sleep(1.0)
+            socket.create_connection(("h", 1))
+
+        def waived(ev):
+            time.sleep(0.01)  # ballista: allow=no-blocking-in-event-loop — test
+        """)
+    found = lint(tmp_path, "no-blocking-in-event-loop")
+    assert [v.line for v in found] == [5, 6]
+
+
+def test_metrics_docs_rule_fires_on_missing_name(tmp_path):
+    from arrow_ballista_tpu.analysis.rules import MetricsDocsRule
+
+    names = MetricsDocsRule().emitted_metric_names()
+    assert names, "collectors should emit at least one metric family"
+    documented, omitted = names[:-1], names[-1]
+    write_fixture(tmp_path, "docs/user-guide/metrics.md",
+                  "\n".join(f"- `{n}`" for n in documented) + "\n")
+    found = lint(tmp_path, "metrics-docs")
+    assert len(found) == 1 and omitted in found[0].message
+
+    write_fixture(tmp_path, "docs/user-guide/metrics.md",
+                  "\n".join(f"- `{n}`" for n in names) + "\n")
+    assert lint(tmp_path, "metrics-docs") == []
+
+
+def test_unknown_rule_name_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_lints(str(tmp_path), rule_names=["no-such-rule"])
+
+
+def test_syntax_error_reported_as_violation(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/broken.py", "def f(:\n")
+    found = run_lints(str(tmp_path), rule_names=["hot-path-purity"])
+    assert [v.rule for v in found] == ["syntax"]
+
+
+def test_cli_runner_clean_and_json():
+    from arrow_ballista_tpu.analysis.__main__ import main
+
+    assert main(["--root", REPO_ROOT]) == 0
+    assert main(["--root", REPO_ROOT, "--json"]) == 0
+    assert main(["--list-rules"]) == 0
+    assert main(["--root", REPO_ROOT, "--rules", "nope"]) == 2
+
+
+# --------------------------------------------------------------------------
+# plan validator
+# --------------------------------------------------------------------------
+
+SCHEMA = Schema([Field("k", INT64), Field("v", INT64)])
+
+
+def memscan(partitions=4, schema=SCHEMA):
+    cols = {f.name: pa.array(np.arange(16, dtype=np.int64))
+            for f in schema}
+    return MemoryScanExec(schema, pa.table(cols), partitions=partitions)
+
+
+def two_stage_graph(writer_count=4, reader_count=4, reader_schema=SCHEMA):
+    producer = ShuffleWriterExec(
+        memscan(), Partitioning.hash([E.Column("k")], writer_count),
+        stage_id=1)
+    consumer = ShuffleWriterExec(
+        UnresolvedShuffleExec(1, reader_schema, reader_count),
+        partitioning=None, stage_id=2)
+    return ExecutionGraph("job-pc", [QueryStage(1, producer),
+                                     QueryStage(2, consumer)])
+
+
+def test_validator_accepts_good_graph():
+    validate_graph(two_stage_graph())  # must not raise
+
+
+def test_validator_rejects_partition_mismatch():
+    graph = two_stage_graph(writer_count=4, reader_count=8)
+    with pytest.raises(PlanValidationError, match="partition mismatch"):
+        validate_graph(graph)
+    errors = check_graph(graph)
+    assert any("writer produces 4 partitions, reader expects 8" in e
+               for e in errors)
+
+
+def test_validator_rejects_schema_mismatch():
+    other = Schema([Field("k", INT64)])
+    graph = two_stage_graph(reader_schema=other)
+    with pytest.raises(PlanValidationError, match="schema mismatch"):
+        validate_graph(graph)
+
+
+def fake_graph(producers, final_stage_id):
+    """Duck-typed graph for DAG-shape checks: stage plans with no shuffle
+    leaves, arbitrary producer wiring."""
+    stages = {
+        sid: SimpleNamespace(stage_id=sid, producer_ids=pids,
+                             plan=memscan(partitions=1))
+        for sid, pids in producers.items()}
+    return SimpleNamespace(job_id="job-fake", stages=stages,
+                           final_stage_id=final_stage_id)
+
+
+def test_validator_rejects_cycle_and_orphan():
+    # 1 <- 2; 2 <- 3; 3 <- 2: stages 2/3 form a cycle (and any orphan set
+    # in a finite every-stage-has-a-consumer graph must contain one)
+    errors = check_graph(fake_graph({1: [2], 2: [3], 3: [2]}, 1))
+    assert any("cyclic stage dependency" in e for e in errors)
+
+    # 4/5 reference each other and never reach the final stage: orphans
+    errors = check_graph(fake_graph({1: [], 4: [5], 5: [4]}, 1))
+    assert any("orphan stage 4" in e for e in errors)
+    assert any("orphan stage 5" in e for e in errors)
+
+
+def test_validator_rejects_self_read_and_unknown_producer():
+    errors = check_graph(fake_graph({1: [1]}, 1))
+    assert any("reads its own output" in e for e in errors)
+    errors = check_graph(fake_graph({1: [9]}, 1))
+    assert any("unknown producer stage 9" in e for e in errors)
+
+
+def test_validator_rejects_join_hash_disagreement():
+    right_schema = Schema([Field("k2", INT64), Field("w", INT64)])
+    left = ShuffleWriterExec(
+        memscan(), Partitioning.hash([E.Column("k")], 4), stage_id=1)
+    right = ShuffleWriterExec(
+        memscan(schema=right_schema),
+        Partitioning.hash([E.Column("k2")], 8), stage_id=2)
+    join = JoinExec(UnresolvedShuffleExec(1, SCHEMA, 4),
+                    UnresolvedShuffleExec(2, right_schema, 8),
+                    on=[(E.Column("k"), E.Column("k2"))])
+    final = ShuffleWriterExec(join, partitioning=None, stage_id=3)
+    graph = ExecutionGraph("job-join", [QueryStage(1, left),
+                                        QueryStage(2, right),
+                                        QueryStage(3, final)])
+    errors = check_graph(graph)
+    assert any("different hash partition counts (4 vs 8)" in e
+               for e in errors)
+
+
+def test_validator_rejects_pass_through_schema_change():
+    filt = FilterExec(memscan(), E.Column("k"))
+    filt._schema = Schema([Field("k", INT64)])  # simulate a buggy rewrite
+    graph = ExecutionGraph("job-pt", [QueryStage(
+        1, ShuffleWriterExec(filt, partitioning=None, stage_id=1))])
+    errors = check_graph(graph)
+    assert any("pass-through" in e for e in errors)
+
+
+# --------------------------------------------------------------------------
+# scheduler wiring: validation runs before launch and fails the job
+# --------------------------------------------------------------------------
+
+def scheduler_with_blackhole():
+    from tests.test_scheduler import BlackholeTaskLauncher, scheduler_test
+
+    return scheduler_test(launcher=BlackholeTaskLauncher())
+
+
+def submit_broken(server, config=None, job_id="job-broken"):
+    broken = two_stage_graph(writer_count=4, reader_count=8)
+
+    def build(job_id_, plan):
+        return broken
+
+    import arrow_ballista_tpu.scheduler.scheduler as sched_mod
+    original = sched_mod.ExecutionGraph.build
+    sched_mod.ExecutionGraph.build = staticmethod(build)
+    try:
+        server.submit_job(job_id, lambda: (memscan(), {}), config=config)
+        return server.wait_for_job(job_id, timeout=20.0)
+    finally:
+        sched_mod.ExecutionGraph.build = original
+
+
+def test_scheduler_rejects_invalid_graph_before_launch():
+    server, launcher = scheduler_with_blackhole()
+    try:
+        status = submit_broken(server)
+        assert status.state == "failed"
+        assert "plan validation failed" in status.error
+        assert "partition mismatch" in status.error
+        assert launcher.count == 0, "no task may launch for a rejected plan"
+    finally:
+        server.shutdown()
+
+
+def wait_until_planned(server, job_id, timeout=10.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = server.get_job_status(job_id)
+        if status is not None and status.state != "queued":
+            return status
+        time.sleep(0.01)
+    raise AssertionError(f"{job_id} never left 'queued'")
+
+
+def test_plan_checks_config_gate():
+    server, launcher = scheduler_with_blackhole()
+    cfg = BallistaConfig({ANALYSIS_PLAN_CHECKS: "false"})
+    try:
+        calls = []
+        import arrow_ballista_tpu.scheduler.scheduler as sched_mod
+        original = sched_mod.validate_graph
+        sched_mod.validate_graph = lambda g: calls.append(g.job_id)
+        try:
+            # gate off: planning must skip the validator entirely
+            server.submit_job("job-gated", lambda: (memscan(), {}),
+                              config=cfg)
+            wait_until_planned(server, "job-gated")
+            assert calls == []
+            # gate on (no config = defaults): it runs
+            server.submit_job("job-open", lambda: (memscan(), {}))
+            wait_until_planned(server, "job-open")
+            assert calls == ["job-open"]
+        finally:
+            sched_mod.validate_graph = original
+    finally:
+        server.shutdown()

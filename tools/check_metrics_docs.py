@@ -1,42 +1,36 @@
 #!/usr/bin/env python
-"""Metrics-docs consistency check.
+"""Metrics-docs consistency check — thin shim.
 
-Instantiates the scheduler and executor metrics collectors, renders
-their prometheus exposition, and asserts every emitted metric family
-name (the ``# TYPE <name> <kind>`` lines) appears somewhere in
-docs/user-guide/metrics.md.  Run directly (exit 1 on drift) or through
-tests/test_observability.py so CI catches undocumented metrics.
+The check itself now lives in the static-analysis framework as the
+``metrics-docs`` rule (arrow_ballista_tpu/analysis/rules.py); run the full
+suite with ``python -m arrow_ballista_tpu.analysis``.  This script remains
+for existing invocations and runs just that rule.
 """
 import os
-import re
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DOC_PATH = os.path.join(REPO_ROOT, "docs", "user-guide", "metrics.md")
 
 
 def emitted_metric_names():
     sys.path.insert(0, REPO_ROOT)
-    from arrow_ballista_tpu.executor.metrics import ExecutorMetrics
-    from arrow_ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+    from arrow_ballista_tpu.analysis.rules import MetricsDocsRule
 
-    text = InMemoryMetricsCollector().gather() + ExecutorMetrics().gather()
-    return sorted(set(re.findall(r"^# TYPE (\S+) \S+$", text, re.M)))
+    return MetricsDocsRule().emitted_metric_names()
 
 
 def missing_from_docs():
-    with open(DOC_PATH) as f:
-        doc = f.read()
-    return [name for name in emitted_metric_names() if name not in doc]
+    sys.path.insert(0, REPO_ROOT)
+    from arrow_ballista_tpu.analysis import run_lints
+
+    return [v.message for v in run_lints(REPO_ROOT, rule_names=["metrics-docs"])]
 
 
 def main() -> int:
     missing = missing_from_docs()
     if missing:
-        print("metric names emitted by collectors but absent from "
-              f"{os.path.relpath(DOC_PATH, REPO_ROOT)}:")
-        for name in missing:
-            print(f"  {name}")
+        for msg in missing:
+            print(msg)
         return 1
     print(f"{len(emitted_metric_names())} metric names all documented")
     return 0

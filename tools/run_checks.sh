@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# CI entrypoint for the repository's consistency checks:
+#   1. the static-analysis lint suite (AST rules + metrics-docs),
+#   2. generated-docs freshness (docs/user-guide/configs.md),
+#   3. the static-analysis + wire-serde test files (rule fixtures,
+#      plan-validator cases, exhaustive wire round-trips).
+# tests/test_static_analysis.py also runs the lint suite inside tier-1, so
+# pytest alone still gates new violations; this script is the fast
+# standalone form for CI and pre-push hooks.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== static analysis (lint suite) =="
+python -m arrow_ballista_tpu.analysis
+
+echo "== generated docs up to date =="
+python docs/gen_configs.py --check
+
+echo "== analysis + serde test files =="
+python -m pytest tests/test_static_analysis.py tests/test_serde_wire.py \
+    -q -p no:cacheprovider
+
+echo "all checks passed"
