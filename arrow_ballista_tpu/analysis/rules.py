@@ -504,3 +504,66 @@ class MetricsDocsRule(Rule):
                     self.name, self.DOC_FILE, 0,
                     f"metric family {name!r} is emitted by a collector but "
                     f"absent from {self.DOC_FILE}")
+
+
+# --------------------------------------------------------------------------
+# recovery-path-logging
+# --------------------------------------------------------------------------
+
+@register
+class RecoveryPathLoggingRule(Rule):
+    """Broad exception handlers on recovery paths must log or re-raise.
+
+    The executor/scheduler retry loops lean on ``except Exception`` to
+    survive transient failures — correct, but a silent ``pass`` there
+    turns a dying scheduler into an executor that spins forever with no
+    trace (the failure mode the PR-4 chaos suite reproduces).  Any bare /
+    ``Exception`` / ``BaseException`` handler under ``executor/`` or
+    ``scheduler/`` must contain a ``raise`` or a logging call; deliberate
+    silences carry ``# ballista: allow=recovery-path-logging`` with a
+    justification (e.g. best-effort cleanup where the peer is already
+    gone and the outcome is reported elsewhere).
+    """
+
+    name = "recovery-path-logging"
+    description = ("broad except handlers in executor/ and scheduler/ "
+                   "log or re-raise")
+
+    DIRS = (f"{PKG}/executor/", f"{PKG}/scheduler/")
+    BROAD = {"Exception", "BaseException"}
+    LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                   "critical", "log"}
+
+    def check(self, project: Project) -> Iterable[Violation]:
+        for sf in project.source_files():
+            if sf.tree is None or not sf.path.startswith(self.DIRS):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not self._is_broad(node.type):
+                    continue
+                if not self._handles(node):
+                    yield Violation(
+                        self.name, sf.path, node.lineno,
+                        "broad except swallows the error silently — log it, "
+                        "re-raise, or justify with "
+                        "'# ballista: allow=recovery-path-logging'")
+
+    def _is_broad(self, t: Optional[ast.expr]) -> bool:
+        if t is None:  # bare except:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(self._is_broad(e) for e in t.elts)
+        return dotted_name(t) in self.BROAD
+
+    def _handles(self, handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                d = dotted_name(n.func)
+                if (d is not None and d.split(".")[-1] in self.LOG_METHODS
+                        and "log" in d.lower()):
+                    return True
+        return False

@@ -17,9 +17,11 @@ from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
+from .. import faults
 from ..ops.physical import TaskContext
 from ..utils.config import BallistaConfig
-from ..utils.errors import CancelledError, FetchFailedError, IOError_
+from ..utils.errors import (CancelledError, ExecutorKilled, FetchFailedError,
+                            IOError_)
 from ..scheduler.types import (
     EXECUTION_ERROR,
     FETCH_PARTITION_ERROR,
@@ -137,6 +139,11 @@ class Executor:
         try:
             if tid.job_id in self._cancelled_jobs:
                 return TaskStatus(tid, self.metadata.executor_id, "killed")
+            faults.inject("executor.task.before_run",
+                          executor_id=self.metadata.executor_id,
+                          job_id=tid.job_id, stage_id=tid.stage_id,
+                          partition=tid.partition,
+                          task_attempt=tid.task_attempt)
             stage_exec = self.engine.create_query_stage_exec(
                 tid.job_id, tid.stage_id, task.plan, self.work_dir)
             ctx = TaskContext(config=self.config, scalars=dict(task.scalars),
@@ -166,6 +173,11 @@ class Executor:
             # the operator noticed the job's cancel flag between batches
             # (reference abortable execution, executor.rs:114-144): the
             # slot frees without waiting out the plan
+            return TaskStatus(tid, self.metadata.executor_id, "killed")
+        except ExecutorKilled:
+            # faults kill action: this executor is simulating SIGKILL.  The
+            # task unwinds as 'killed' (the graph ignores it); the scheduler
+            # learns of the death via heartbeat timeout / launch failures.
             return TaskStatus(tid, self.metadata.executor_id, "killed")
         except FetchFailedError as e:
             return TaskStatus(tid, self.metadata.executor_id, "failed",

@@ -70,6 +70,8 @@ class ExecutorFlightServer:
             obj = json.loads(raw.decode("utf-8"))
             path = obj["path"]
             token = obj.get("token", "")
+        # not an error path: a non-JSON ticket IS the raw shuffle-file path
+        # ballista: allow=recovery-path-logging — expected legacy-ticket shape
         except Exception:  # noqa: BLE001 — raw path ticket
             path = raw.decode("utf-8")
         if self._token and token != self._token:

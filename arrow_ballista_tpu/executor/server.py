@@ -17,17 +17,24 @@ import threading
 import time
 from typing import List, Optional
 
-from .. import serde
-from ..net.rpc import RpcServer
+from .. import faults, serde
 from ..net import wire
+from ..net.rpc import RpcServer
+from ..net.retry import RetryPolicy, call_with_retry
 from ..scheduler.types import ExecutorHeartbeat, ExecutorMetadata, TaskStatus
 from ..utils.config import BallistaConfig
 from ..utils.errors import ExecutionError
+from ..utils.logsetup import ThrottledLogger
 from .executor import Executor
 
 log = logging.getLogger(__name__)
 
 HEARTBEAT_INTERVAL_S = 60.0
+# interval-class for throttled retry-loop logging: one record per loop kind
+# per this many seconds, suppressed occurrences counted (satellite: the
+# reporter used to warn once per second for as long as the scheduler was
+# down)
+RETRY_LOG_INTERVAL_S = 60.0
 
 
 class StagePlanCache:
@@ -80,40 +87,69 @@ class StagePlanCache:
 
 
 class SchedulerClient:
-    """Executor -> scheduler control-plane client."""
+    """Executor -> scheduler control-plane client.
 
-    def __init__(self, host: str, port: int):
+    Every call goes through ``net.retry.call_with_retry``: connect/read
+    deadlines plus capped jittered backoff bounded by the policy's give-up
+    deadline, after which :class:`net.retry.GiveUpError` (retryable at the
+    caller) surfaces instead of a hung socket."""
+
+    def __init__(self, host: str, port: int,
+                 policy: Optional[RetryPolicy] = None):
         self.host, self.port = host, port
+        self.policy = policy or RetryPolicy()
+
+    def _call(self, method: str, payload: dict) -> dict:
+        resp, _ = call_with_retry(self.host, self.port, method, payload,
+                                  policy=self.policy)
+        return resp
 
     def register_executor(self, meta: ExecutorMetadata) -> None:
-        wire.call(self.host, self.port, "register_executor",
-                  {"meta": serde.executor_metadata_to_obj(meta)})
+        self._call("register_executor",
+                   {"meta": serde.executor_metadata_to_obj(meta)})
 
     def heartbeat(self, executor_id: str, status: str = "active",
                   meta: Optional[ExecutorMetadata] = None) -> None:
+        if faults.dropped("executor.heartbeat.send", executor_id=executor_id,
+                          status=status):
+            raise ConnectionError(
+                "failpoint executor.heartbeat.send dropped the heartbeat")
         payload = {"executor_id": executor_id, "status": status}
         if meta is not None:
             payload["meta"] = serde.executor_metadata_to_obj(meta)
-        wire.call(self.host, self.port, "heartbeat", payload)
+        self._call("heartbeat", payload)
 
     def update_task_status(self, executor_id: str,
                            statuses: List[TaskStatus]) -> None:
-        wire.call(self.host, self.port, "update_task_status",
-                  {"executor_id": executor_id,
-                   "statuses": [serde.status_to_obj(s) for s in statuses]})
+        # the drop fires BEFORE the retrying transport so the report is
+        # lost outright and the reporter loop's own retry path must redeem
+        # it (the chaos suite's dropped-status-report scenario)
+        if faults.dropped("executor.status.report", executor_id=executor_id,
+                          count=len(statuses)):
+            raise ConnectionError(
+                "failpoint executor.status.report dropped the payload")
+        self._call("update_task_status",
+                   {"executor_id": executor_id,
+                    "statuses": [serde.status_to_obj(s) for s in statuses]})
 
     def poll_work(self, executor_id: str, num_free_slots: int,
                   statuses: List[TaskStatus], decode=serde.task_from_obj):
+        # single-shot ON PURPOSE: the server POPS tasks into the reply, so a
+        # transport-level retry after a lost response would leak the popped
+        # tasks.  The poll loop itself retries (re-queueing statuses); only
+        # the policy's deadlines apply here.
         payload, _ = wire.call(self.host, self.port, "poll_work", {
             "executor_id": executor_id, "num_free_slots": num_free_slots,
-            "statuses": [serde.status_to_obj(s) for s in statuses]})
+            "statuses": [serde.status_to_obj(s) for s in statuses]},
+            timeout=self.policy.read_timeout_s,
+            connect_timeout=self.policy.connect_timeout_s)
         from ..scheduler.netservice import ungroup_tasks
 
         return [decode(t) for t in ungroup_tasks(payload)]
 
     def executor_stopped(self, executor_id: str, reason: str = "") -> None:
-        wire.call(self.host, self.port, "executor_stopped",
-                  {"executor_id": executor_id, "reason": reason})
+        self._call("executor_stopped",
+                   {"executor_id": executor_id, "reason": reason})
 
 
 class ExecutorServer:
@@ -127,11 +163,13 @@ class ExecutorServer:
                  job_data_ttl_s: float = 3600.0,
                  janitor_interval_s: float = 300.0,
                  flight_port: int = -1,
-                 metrics_port: int = -1):
+                 metrics_port: int = -1,
+                 heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S):
         import socket as socketmod
         import tempfile
         import uuid
 
+        faults.configure(config)
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-exec-")
         executor_id = executor_id or f"exec-{uuid.uuid4().hex[:8]}"
         self.rpc = RpcServer(host, port)
@@ -167,11 +205,25 @@ class ExecutorServer:
             grpc_port=self.rpc.port, task_slots=concurrent_tasks)
         self.executor = Executor(self.metadata, self.work_dir, config,
                                  concurrent_tasks=concurrent_tasks)
-        self.scheduler = SchedulerClient(scheduler_host, scheduler_port)
+        self.retry_policy = RetryPolicy.from_config(config) \
+            if config is not None else RetryPolicy()
+        self.scheduler = SchedulerClient(scheduler_host, scheduler_port,
+                                         policy=self.retry_policy)
         assert policy in ("push", "pull")
         self.policy = policy
+        self.heartbeat_interval_s = heartbeat_interval_s
         self._stop = threading.Event()
         self._draining = False
+        self._killed = False
+        # satellite: bounded/throttled retry loops.  One transition log when
+        # the scheduler becomes unreachable (a call blew its give-up
+        # deadline); on the next successful call we re-register so a
+        # restarted scheduler relearns our metadata immediately.
+        self._sched_state_lock = threading.Lock()
+        self._scheduler_down = False
+        self._log_throttle = ThrottledLogger(log,
+                                             interval_s=RETRY_LOG_INTERVAL_S)
+        faults.register_kill_target(self.metadata.executor_id, self.kill)
         self._hb_thread: Optional[threading.Thread] = None
         self._poll_thread: Optional[threading.Thread] = None
         self._reporter_thread: Optional[threading.Thread] = None
@@ -293,12 +345,15 @@ class ExecutorServer:
                                                  max(0, free), statuses,
                                                  decode=self._plan_cache.decode)
             except Exception:  # noqa: BLE001 — scheduler briefly unreachable
-                log.warning("poll_work failed", exc_info=True)
+                self._mark_scheduler_down("poll_work")
+                self._log_throttle.warning("poll", "poll_work failed",
+                                           exc_info=True)
                 # re-queue unreported statuses for the next poll
                 for st in statuses:
                     self._status_queue.put(st)
                 self._stop.wait(1.0)
                 continue
+            self._mark_scheduler_up()
             for task in tasks:
                 self.executor.submit_task(task, self._status_queue.put)
             if not tasks and not statuses:
@@ -314,6 +369,8 @@ class ExecutorServer:
         try:
             self.scheduler.heartbeat(self.metadata.executor_id,
                                      status="terminating", meta=self.metadata)
+        # drain proceeds regardless; the scheduler may already be gone
+        # ballista: allow=recovery-path-logging — best-effort terminating ping
         except Exception:  # noqa: BLE001 — scheduler may already be gone
             pass
         deadline = time.monotonic() + grace_s
@@ -327,10 +384,18 @@ class ExecutorServer:
         self.stop(notify=True)
 
     def stop(self, notify: bool = True) -> None:
+        if self._killed:
+            # kill() already tore the sockets down abruptly; a later fixture
+            # teardown must not double-stop or notify
+            self._stop.set()
+            return
         self._stop.set()
+        faults.unregister_kill_target(self.metadata.executor_id)
         if notify:
             try:
                 self.scheduler.executor_stopped(self.metadata.executor_id, "shutdown")
+            # best-effort goodbye on shutdown; the scheduler may be gone
+            # ballista: allow=recovery-path-logging — outcome needs no trace
             except Exception:  # noqa: BLE001 — scheduler may be gone
                 pass
         self.executor.shutdown()
@@ -344,15 +409,74 @@ class ExecutorServer:
             self._native_dp.dp_stop()
             self._native_dp = None
 
+    def kill(self) -> None:
+        """Abrupt death for chaos tests (the ``faults`` kill action):
+        simulate SIGKILL as closely as one process allows — drop off the
+        network NOW.  No Terminating heartbeat, no executor_stopped notify,
+        no final status flush; in-flight tasks unwind as ``killed`` and are
+        never reported.  The scheduler must discover the death the hard
+        way: launch failures, fetch failures, heartbeat timeout."""
+        if self._killed:
+            return
+        self._killed = True
+        self._stop.set()
+        faults.unregister_kill_target(self.metadata.executor_id)
+        log.warning("executor %s killed by fault injection",
+                    self.metadata.executor_id)
+        self.rpc.stop()
+        if self.flight is not None:
+            self.flight.stop()
+        if self.obs_http is not None:
+            self.obs_http.stop()
+            self.obs_http = None
+        if self._native_dp is not None:
+            self._native_dp.dp_stop()
+            self._native_dp = None
+        # wait=False: this may run on a pool thread (the task that tripped
+        # the failpoint); a joining shutdown would deadlock on itself
+        self.executor.pool.shutdown(wait=False)
+
+    def _mark_scheduler_down(self, what: str) -> None:
+        with self._sched_state_lock:
+            if self._scheduler_down:
+                return
+            self._scheduler_down = True
+        log.warning(
+            "scheduler unreachable (%s failed past the %.1fs give-up "
+            "deadline); will re-register on reconnect", what,
+            self.retry_policy.give_up_after_s)
+
+    def _mark_scheduler_up(self) -> None:
+        """First successful call after an outage: re-register, because the
+        scheduler may have restarted (or expired us) while unreachable."""
+        with self._sched_state_lock:
+            if not self._scheduler_down:
+                return
+            self._scheduler_down = False
+        log.info("scheduler reachable again; re-registering executor %s",
+                 self.metadata.executor_id)
+        try:
+            self.scheduler.register_executor(self.metadata)
+        except Exception:  # noqa: BLE001 — the next loop pass re-detects
+            self._log_throttle.warning(
+                "re-register", "re-register after reconnect failed",
+                exc_info=True)
+            with self._sched_state_lock:
+                self._scheduler_down = True
+
     def _heartbeat_loop(self) -> None:
-        while not self._stop.wait(HEARTBEAT_INTERVAL_S):
+        while not self._stop.wait(self.heartbeat_interval_s):
             try:
                 # metadata rides along so a restarted scheduler re-registers
                 # us (reference heart_beat_from_executor, grpc.rs:174-241)
                 self.scheduler.heartbeat(self.metadata.executor_id,
                                          meta=self.metadata)
+                self._mark_scheduler_up()
             except Exception:  # noqa: BLE001 — retried next interval
-                log.warning("heartbeat to scheduler failed", exc_info=True)
+                self._mark_scheduler_down("heartbeat")
+                self._log_throttle.warning(
+                    "heartbeat", "heartbeat to scheduler failed",
+                    exc_info=True)
 
     # --- RPC handlers ----------------------------------------------------
     def _launch_multi_task(self, payload: dict, _bin: bytes):
@@ -393,15 +517,22 @@ class ExecutorServer:
                 self.scheduler.update_task_status(self.metadata.executor_id,
                                                   list(pending))
                 pending.clear()
+                self._mark_scheduler_up()
             except Exception:  # noqa: BLE001 — keep and retry next round
-                log.warning("status report failed (%d pending, will retry)",
-                            len(pending), exc_info=True)
+                self._mark_scheduler_down("status report")
+                self._log_throttle.warning(
+                    "status-report",
+                    "status report failed (%d pending, will retry)",
+                    len(pending), exc_info=True)
                 self._stop.wait(1.0)
-        # final best-effort flush on shutdown
-        if pending:
+        # final best-effort flush on shutdown — but NOT after kill():
+        # a SIGKILLed executor reports nothing
+        if pending and not self._killed:
             try:
                 self.scheduler.update_task_status(self.metadata.executor_id,
                                                   list(pending))
+            # last-gasp flush on shutdown; nothing listens to a failure here
+            # ballista: allow=recovery-path-logging — shutdown best effort
             except Exception:  # noqa: BLE001
                 pass
 

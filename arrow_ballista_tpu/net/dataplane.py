@@ -2,17 +2,23 @@
 
 One implementation for both consumers (reference parity: BallistaClient::
 fetch_partition, core/src/client.rs:112-187, used by shuffle reads and
-result collection alike) — 3 retries with linear backoff (client.rs:57-58).
+result collection alike) — bounded retries with capped jittered
+exponential backoff (``net.retry.RetryPolicy``; client.rs:57-58 used a
+fixed linear backoff).  Carries the ``shuffle.fetch.recv`` failpoint:
+per-attempt raise/delay/drop plus deterministic payload corruption, so
+chaos tests can force the lineage-rollback path.
 """
 from __future__ import annotations
 
 import io
 import time
-from typing import List
+from typing import List, Optional
 
+from .. import faults
 from ..models.batch import ColumnBatch
 from ..models.schema import Schema
 from . import wire
+from .retry import RetryPolicy
 
 FETCH_RETRIES = 3
 RETRY_BACKOFF_S = 3.0
@@ -21,15 +27,28 @@ RETRY_BACKOFF_S = 3.0
 def fetch_partition_batches(host: str, port: int, path: str, schema: Schema,
                             capacity: int,
                             retries: int = FETCH_RETRIES,
-                            backoff_s: float = RETRY_BACKOFF_S) -> List[ColumnBatch]:
+                            backoff_s: float = RETRY_BACKOFF_S,
+                            policy: Optional[RetryPolicy] = None,
+                            fault_ctx: Optional[dict] = None) -> List[ColumnBatch]:
     """Fetch one shuffle/result file from an executor data plane and decode
-    it into device batches.  Raises the last error after ``retries``."""
+    it into device batches.  Raises the last error after ``retries``.
+
+    ``policy`` supplies connect/read deadlines and the backoff curve; when
+    absent, legacy defaults (linear-ish ``backoff_s`` base, 3s cap) apply.
+    ``fault_ctx`` adds caller-known match keys (producer stage/partition/
+    executor) to the ``shuffle.fetch.recv`` failpoint context, so a chaos
+    plan can pin a rule to ONE logical fetch rather than racing the hit
+    counter across concurrent fetches.
+    """
     import pyarrow.ipc as ipc
 
     from ..models.ipc import physical_table_to_batches
 
     import os
 
+    policy = policy or RetryPolicy(base_backoff_s=backoff_s,
+                                   max_backoff_s=backoff_s * retries,
+                                   read_timeout_s=60.0)
     req = {"path": path}
     token = os.environ.get("BALLISTA_DATA_PLANE_TOKEN", "")
     if token:
@@ -37,11 +56,21 @@ def fetch_partition_batches(host: str, port: int, path: str, schema: Schema,
     err: Exception = RuntimeError("unreachable")
     for attempt in range(retries):
         try:
-            _, data = wire.call(host, port, "fetch_partition", req)
+            rule = faults.inject("shuffle.fetch.recv", host=host, port=port,
+                                 path=path, attempt=attempt,
+                                 **(fault_ctx or {}))
+            if rule is not None and rule.action == "drop":
+                raise ConnectionError(
+                    "failpoint shuffle.fetch.recv dropped the payload")
+            _, data = wire.call(host, port, "fetch_partition", req,
+                                timeout=policy.read_timeout_s,
+                                connect_timeout=policy.connect_timeout_s)
+            if rule is not None and rule.action == "corrupt":
+                data = faults.corrupt_bytes(data)
             table = ipc.open_file(io.BytesIO(data)).read_all()
             return physical_table_to_batches(table, schema, capacity=capacity)
         except Exception as e:  # noqa: BLE001 — caller maps to its taxonomy
             err = e
             if attempt + 1 < retries:
-                time.sleep(backoff_s * (attempt + 1))
+                time.sleep(policy.backoff_s(attempt))
     raise err

@@ -22,6 +22,8 @@ import socket
 import struct
 from typing import Optional, Tuple
 
+from .. import faults
+
 _HDR = struct.Struct("!IQ")
 MAX_FRAME = 1 << 30  # 1 GiB guard for the JSON part
 MAX_BIN = 1 << 40  # 1 TiB guard for the binary part
@@ -61,14 +63,28 @@ def connect(host: str, port: int, timeout: float = 20.0) -> socket.socket:
 
 
 def call(host: str, port: int, method: str, payload: Optional[dict] = None,
-         binary: bytes = b"", timeout: float = 60.0) -> Tuple[dict, bytes]:
-    """One-shot RPC: connect, send request, read response, close."""
-    sock = connect(host, port, timeout)
+         binary: bytes = b"", timeout: float = 60.0,
+         connect_timeout: Optional[float] = None) -> Tuple[dict, bytes]:
+    """One-shot RPC: connect, send request, read response, close.
+
+    ``connect_timeout`` bounds TCP establishment separately from the read
+    deadline (``timeout``); it defaults to the read deadline for backwards
+    compatibility — ``net.retry.RetryPolicy`` callers pass both.
+    """
+    rule = faults.inject("rpc.client.send", method=method, host=host,
+                         port=port)
+    if rule is not None and rule.action == "drop":
+        raise ConnectionError(
+            f"failpoint rpc.client.send dropped {method} request")
+    sock = connect(host, port,
+                   connect_timeout if connect_timeout is not None else timeout)
     try:
         sock.settimeout(timeout)
         req = {"method": method, "payload": payload or {}}
         send_frame(sock, req, binary)
         resp, rbin = recv_frame(sock)
+        if rule is not None and rule.action == "corrupt":
+            rbin = faults.corrupt_bytes(rbin)
         if not resp.get("ok"):
             raise RemoteError(resp.get("error", "unknown remote error"),
                               resp.get("error_kind", ""))

@@ -281,10 +281,16 @@ class ShuffleReaderExec(ExecutionPlan):
 
     def _fetch_remote(self, loc: PartitionLocation, ctx: TaskContext) -> List[ColumnBatch]:
         from ..net.dataplane import fetch_partition_batches
+        from ..net.retry import RetryPolicy
 
         try:
-            batches = fetch_partition_batches(loc.host, loc.port, loc.path,
-                                              self._schema, ctx.config.batch_size)
+            batches = fetch_partition_batches(
+                loc.host, loc.port, loc.path,
+                self._schema, ctx.config.batch_size,
+                policy=RetryPolicy.from_config(ctx.config),
+                fault_ctx={"stage_id": self.stage_id,
+                           "map_partition": loc.map_partition,
+                           "executor_id": loc.executor_id})
             self.metrics().add("remote_fetches", 1)
             return batches
         except Exception as err:  # noqa: BLE001 — retries exhausted

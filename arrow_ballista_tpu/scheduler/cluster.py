@@ -25,6 +25,20 @@ from .types import (
 )
 
 DEFAULT_EXECUTOR_TIMEOUT_S = 180.0
+# one documented drain-grace delta between "stop offering work" and
+# "declare lost": offers stop at (timeout - grace) so a slow-heartbeat
+# executor drains its in-flight tasks instead of receiving doomed ones,
+# and the reaper expires it at the full timeout — no window where an
+# executor is permanently unschedulable yet never declared lost (the old
+# split 60s alive / 180s expired defaults had a 120s such window).  The
+# grace is capped at half the timeout so short test timeouts keep a
+# usable alive window.  Config key: ballista.cluster.executor_timeout_s.
+OFFER_DRAIN_GRACE_S = 60.0
+
+
+def alive_cutoff_s(timeout_s: float) -> float:
+    """Heartbeat age beyond which an executor stops receiving offers."""
+    return timeout_s - min(OFFER_DRAIN_GRACE_S, timeout_s / 2.0)
 
 
 class ClusterState:
@@ -80,11 +94,16 @@ class ClusterState:
         with self._lock:
             return self._executors.get(executor_id)
 
-    def alive_executors(self, timeout_s: float = 60.0) -> List[str]:
+    def alive_executors(self, timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S
+                        ) -> List[str]:
+        """Executors eligible for NEW work: active status and a heartbeat
+        younger than ``alive_cutoff_s(timeout_s)`` — the same timeout the
+        reaper uses, minus the drain grace (see OFFER_DRAIN_GRACE_S)."""
+        cutoff = alive_cutoff_s(timeout_s)
         now = time.time()
         with self._lock:
             return [eid for eid, hb in self._heartbeats.items()
-                    if hb.status == "active" and now - hb.timestamp <= timeout_s
+                    if hb.status == "active" and now - hb.timestamp <= cutoff
                     and eid in self._executors]
 
     def expired_executors(self, timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S

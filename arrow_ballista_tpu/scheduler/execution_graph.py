@@ -421,15 +421,18 @@ class ExecutionGraph:
             return
         stage.rollback()
         if stage.failures >= STAGE_MAX_FAILURES:
+            # keep the ORIGINAL transport cause in the job error: "budget
+            # exhausted" alone is undebuggable once the executor is gone
             self._fail_job(
                 f"stage {stage.stage_id} exceeded {STAGE_MAX_FAILURES} "
-                f"attempts after fetch failures", events)
+                f"attempts after fetch failures (last: {reason.message})",
+                events)
             return
         producer.reopen_partitions([reason.map_partition_id])
         if producer.failures >= STAGE_MAX_FAILURES:
             self._fail_job(
                 f"stage {producer.stage_id} exceeded {STAGE_MAX_FAILURES} "
-                f"re-runs", events)
+                f"re-runs (last fetch failure: {reason.message})", events)
             return
         self.revive()
 

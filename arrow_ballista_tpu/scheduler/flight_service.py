@@ -325,6 +325,7 @@ class BallistaFlightServer:
         ('sql', text) for query commands."""
         try:
             name, value = any_unwrap(cmd)
+        # ballista: allow=recovery-path-logging — expected dual-format parse
         except Exception:  # noqa: BLE001 — not protobuf: plain SQL bytes
             return "sql", cmd.decode("utf-8")
         if name in self._META_COMMANDS:
@@ -354,6 +355,7 @@ class BallistaFlightServer:
     def _sql_of_ticket(self, raw: bytes) -> str:
         try:
             name, value = any_unwrap(raw)
+        # ballista: allow=recovery-path-logging — expected dual-format parse
         except Exception:  # noqa: BLE001 — plain SQL ticket
             return raw.decode("utf-8")
         if name == "TicketStatementQuery":
@@ -468,6 +470,7 @@ class BallistaFlightServer:
         fl = self._fl
         try:
             name, value = any_unwrap(raw_ticket)
+        # ballista: allow=recovery-path-logging — expected dual-format parse
         except Exception:  # noqa: BLE001
             name = value = None
         if name in self._META_COMMANDS:
@@ -523,6 +526,7 @@ class BallistaFlightServer:
         if action_type == "CreatePreparedStatement":
             try:
                 _name, value = any_unwrap(body)
+            # ballista: allow=recovery-path-logging — expected dual-format parse
             except Exception:  # noqa: BLE001 — raw request body
                 value = body
             sql = pb_decode(value)[1][0].decode("utf-8")
@@ -538,6 +542,7 @@ class BallistaFlightServer:
         if action_type == "ClosePreparedStatement":
             try:
                 _name, value = any_unwrap(body)
+            # ballista: allow=recovery-path-logging — expected dual-format parse
             except Exception:  # noqa: BLE001
                 value = body
             handle = pb_decode(value)[1][0]

@@ -187,6 +187,8 @@ class _PollingWatch(_QueueWatch):
             while not self._stop.wait(interval_s):
                 try:
                     now = dict(store.scan(space))
+                # routine on shutdown: the store closes under the watcher
+                # ballista: allow=recovery-path-logging — watcher exits here
                 except Exception:  # noqa: BLE001 — store closing
                     break
                 for k, v in now.items():

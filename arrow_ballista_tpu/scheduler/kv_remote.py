@@ -145,6 +145,8 @@ class RemoteKv(KeyValueStore):
                 return resp.get("payload", {})
             except wire.RemoteError:
                 raise
+            # the error is NOT swallowed: it re-raises as last_err below
+            # ballista: allow=recovery-path-logging — bounded reconnect retry
             except Exception as e:  # noqa: BLE001 — socket died; reconnect
                 last_err = e
                 try:
@@ -197,6 +199,8 @@ class _RemoteWatch(_QueueWatch):
                     out = kv._call("kv_poll", {"space": space, "since": since,
                                                "timeout": 5.0})
                 except Exception:  # noqa: BLE001 — server away; retry
+                    log.debug("kv_poll on %s failed; retrying", space,
+                              exc_info=True)
                     if self._stop.wait(1.0):
                         break
                     continue

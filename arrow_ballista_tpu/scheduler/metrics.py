@@ -46,6 +46,9 @@ class SchedulerMetricsCollector:
     def record_admitted(self, job_id: str, queue_wait_s: float) -> None: ...
     def record_shed(self, job_id: str) -> None: ...
     def set_admission_queue_depth(self, value: int) -> None: ...
+    # executor quarantine (scheduler/quarantine.py)
+    def record_quarantined(self, executor_id: str) -> None: ...
+    def set_quarantined_executors(self, value: int) -> None: ...
     def gather(self) -> str:
         return ""
 
@@ -72,6 +75,8 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.admission_queue_depth_max = 0
         self.admission_wait = Histogram([0.001, 0.01, 0.1, 0.5, 1.0, 5.0,
                                          30.0, 120.0])
+        self.quarantined_total = 0
+        self.quarantined_executors = 0
 
     def record_submitted(self, job_id, queued_at_ms, submitted_at_ms):
         with self._lock:
@@ -110,6 +115,14 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             self.admission_queue_depth_max = max(
                 self.admission_queue_depth_max, value)
 
+    def record_quarantined(self, executor_id):
+        with self._lock:
+            self.quarantined_total += 1
+
+    def set_quarantined_executors(self, value):
+        with self._lock:
+            self.quarantined_executors = value
+
     def gather(self) -> str:
         with self._lock:
             lines = []
@@ -127,6 +140,14 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                     "jobs admitted by admission control")
             counter("job_shed_total", self.shed,
                     "jobs shed by admission control (queue full / timeout)")
+            counter("executor_quarantined_total", self.quarantined_total,
+                    "executors quarantined after consecutive retryable "
+                    "task failures")
+            lines.append("# HELP quarantined_executors executors currently "
+                         "quarantined (no new offers)")
+            lines.append("# TYPE quarantined_executors gauge")
+            lines.append(
+                f"quarantined_executors {self.quarantined_executors}")
             lines.append("# HELP pending_task_queue_size pending tasks")
             lines.append("# TYPE pending_task_queue_size gauge")
             lines.append(f"pending_task_queue_size {self.pending_tasks}")

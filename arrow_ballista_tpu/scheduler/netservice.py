@@ -16,11 +16,12 @@ import logging
 import threading
 from typing import Dict, List, Optional
 
-from .. import serde
+from .. import faults, serde
 from ..catalog import CsvTable, MemoryTable, ParquetTable, SchemaCatalog
 from ..models.schema import Field, Schema
 from ..net.rpc import RpcServer
 from ..net import wire
+from ..net.retry import RetryPolicy, call_with_retry
 from ..utils.config import BallistaConfig
 from ..utils.errors import PlanningError
 from .scheduler import SchedulerConfig, SchedulerServer, TaskLauncher, random_job_id
@@ -106,8 +107,14 @@ class NetTaskLauncher(TaskLauncher):
     DefaultTaskLauncher -> ExecutorGrpc.LaunchMultiTask,
     state/task_manager.rs:69-119)."""
 
-    def __init__(self):
+    def __init__(self, policy: Optional[RetryPolicy] = None):
         self.scheduler: Optional[SchedulerServer] = None
+        # deadline + bounded-backoff policy for every scheduler->executor
+        # call; a launch that exhausts the give-up deadline raises a
+        # ConnectionError subclass, which _launch turns into ExecutorLost —
+        # the retryable path that re-runs the tasks elsewhere without
+        # charging task retry budgets
+        self.policy = policy or RetryPolicy()
 
     def _addr(self, executor_id: str):
         meta = self.scheduler.cluster.get_executor(executor_id)
@@ -125,26 +132,30 @@ class NetTaskLauncher(TaskLauncher):
         # per task
         host, port = self._addr(executor_id)
         try:
-            wire.call(host, port, "launch_multi_task",
-                      {"stages": group_tasks_by_plan(objs)})
+            call_with_retry(host, port, "launch_multi_task",
+                            {"stages": group_tasks_by_plan(objs)},
+                            policy=self.policy)
         except wire.RemoteError as e:
             if "'tasks'" not in str(e):
                 raise
             # mixed-version rollout: an executor predating the grouped
             # shape KeyErrors on payload['tasks'] — resend flat once
             log.info("executor %s speaks the legacy launch shape", executor_id)
-            wire.call(host, port, "launch_multi_task", {"tasks": objs})
+            call_with_retry(host, port, "launch_multi_task", {"tasks": objs},
+                            policy=self.policy)
 
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
         try:
             host, port = self._addr(executor_id)
-            wire.call(host, port, "cancel_tasks", {"job_id": job_id})
+            call_with_retry(host, port, "cancel_tasks", {"job_id": job_id},
+                            policy=self.policy)
         except Exception:  # noqa: BLE001 — best effort
             log.warning("cancel_tasks on %s failed", executor_id, exc_info=True)
 
     def clean_job_data(self, executor_id: str, job_id: str) -> None:
         host, port = self._addr(executor_id)
-        wire.call(host, port, "remove_job_data", {"job_id": job_id})
+        call_with_retry(host, port, "remove_job_data", {"job_id": job_id},
+                        policy=self.policy)
 
 
 class SchedulerNetService:
@@ -156,8 +167,29 @@ class SchedulerNetService:
                  cluster_url: Optional[str] = None,
                  flight_port: Optional[int] = None):
         self.config = config or BallistaConfig()
+        # arm the failpoint plan (no-op unless ballista.faults.plan or
+        # BALLISTA_FAULTS_PLAN is set) before any instrumented site runs
+        faults.configure(self.config)
+        if scheduler_config is None:
+            # honour the session config's cluster keys when the caller did
+            # not hand us an explicit SchedulerConfig — one timeout key
+            # (ballista.cluster.executor_timeout_s) governs offers, the
+            # reaper, and the REST summary alike
+            from ..utils.config import (
+                CLUSTER_EXECUTOR_TIMEOUT_S,
+                QUARANTINE_FAILURES,
+                QUARANTINE_PROBATION_S,
+            )
+
+            scheduler_config = SchedulerConfig(
+                executor_timeout_s=float(
+                    self.config.get(CLUSTER_EXECUTOR_TIMEOUT_S)),
+                quarantine_failures=int(
+                    self.config.get(QUARANTINE_FAILURES)),
+                quarantine_probation_s=float(
+                    self.config.get(QUARANTINE_PROBATION_S)))
         self.catalog = SchemaCatalog()
-        launcher = NetTaskLauncher()
+        launcher = NetTaskLauncher(RetryPolicy.from_config(self.config))
         job_backend = None
         cluster_state = None
         if cluster_url:
@@ -398,6 +430,12 @@ class SchedulerNetService:
         return {}, b""
 
     def _heartbeat(self, payload: dict, _bin: bytes):
+        # failpoint: the heartbeat reached the scheduler but is discarded
+        # before it touches cluster state — the executor ages toward the
+        # offer cutoff / reaper timeout exactly as if the packet was lost
+        if faults.dropped("scheduler.heartbeat.receive",
+                          executor_id=payload.get("executor_id")):
+            return {}, b""
         meta = payload.get("meta")
         self.server.heartbeat(ExecutorHeartbeat(
             payload["executor_id"], status=payload.get("status", "active"),
@@ -405,7 +443,18 @@ class SchedulerNetService:
         return {}, b""
 
     def _update_task_status(self, payload: dict, _bin: bytes):
+        if faults.dropped("scheduler.status.receive",
+                          executor_id=payload.get("executor_id"),
+                          count=len(payload.get("statuses", []))):
+            # swallow the report: the executor's reporter loop keeps the
+            # statuses pending and must redeem them on a later attempt
+            raise ConnectionError(
+                "failpoint scheduler.status.receive dropped the report")
         statuses = [serde.status_from_obj(s) for s in payload["statuses"]]
+        # a status report is proof of life: refresh the heartbeat timestamp
+        # (without clobbering status) so a busy executor whose heartbeat
+        # thread is starved is not reaped while actively reporting work
+        self.server.cluster.touch_heartbeat(payload["executor_id"])
         self.server.update_task_status(payload["executor_id"], statuses)
         return {}, b""
 

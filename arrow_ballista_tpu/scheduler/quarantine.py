@@ -1,0 +1,111 @@
+"""Executor quarantine: stop offering work to repeatedly-failing executors.
+
+A bad host (full disk, broken accelerator, flaky NIC) fails every task it
+touches; with round-robin offers it keeps draining retry budgets until a
+job dies.  The scheduler counts *consecutive retryable* task failures per
+executor (``FailedReason.retryable`` — IOError/ExecutorLost/ResultLost;
+fetch failures blame the producer and fatal ExecutionErrors fail the job
+outright, so neither counts here).  At ``threshold`` consecutive failures
+the executor is quarantined: it stays registered and heartbeating but
+``_offer``/poll stop handing it tasks.  After ``probation_s`` it is
+re-admitted *on probation* — a single failure re-quarantines immediately,
+a success clears its record.
+
+Observable via ``executor_quarantined_total`` / ``quarantined_executors``
+metrics and REST ``/api/quarantine``.  ``threshold <= 0`` disables.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Set
+
+
+class ExecutorQuarantine:
+    def __init__(self, threshold: int = 5, probation_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = int(threshold)
+        self.probation_s = float(probation_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive: Dict[str, int] = {}
+        self._quarantined_at: Dict[str, float] = {}
+        self._on_probation: Set[str] = set()
+        self.total_quarantined = 0
+
+    # --- recording --------------------------------------------------------
+    def record_success(self, executor_id: str) -> None:
+        with self._lock:
+            self._consecutive.pop(executor_id, None)
+            self._quarantined_at.pop(executor_id, None)
+            self._on_probation.discard(executor_id)
+
+    def record_failure(self, executor_id: str) -> bool:
+        """Count one retryable failure; True when this failure *newly*
+        quarantines the executor (first crossing, or a probation strike)."""
+        if self.threshold <= 0:
+            return False
+        with self._lock:
+            if executor_id in self._on_probation:
+                self._on_probation.discard(executor_id)
+                self._consecutive[executor_id] = self.threshold
+                self._quarantined_at[executor_id] = self._clock()
+                self.total_quarantined += 1
+                return True
+            n = self._consecutive.get(executor_id, 0) + 1
+            self._consecutive[executor_id] = n
+            if n >= self.threshold and executor_id not in self._quarantined_at:
+                self._quarantined_at[executor_id] = self._clock()
+                self.total_quarantined += 1
+                return True
+            return False
+
+    def remove(self, executor_id: str) -> None:
+        """Executor deregistered/lost: forget its record entirely."""
+        with self._lock:
+            self._consecutive.pop(executor_id, None)
+            self._quarantined_at.pop(executor_id, None)
+            self._on_probation.discard(executor_id)
+
+    # --- queries ----------------------------------------------------------
+    def is_quarantined(self, executor_id: str) -> bool:
+        """Also performs the lazy probation transition: a quarantine older
+        than ``probation_s`` flips to probation and the executor becomes
+        schedulable again (with zero failure allowance)."""
+        if self.threshold <= 0:
+            return False
+        with self._lock:
+            at = self._quarantined_at.get(executor_id)
+            if at is None:
+                return False
+            if self._clock() - at >= self.probation_s:
+                del self._quarantined_at[executor_id]
+                self._consecutive.pop(executor_id, None)
+                self._on_probation.add(executor_id)
+                return False
+            return True
+
+    def filter(self, executor_ids: Iterable[str]) -> List[str]:
+        return [e for e in executor_ids if not self.is_quarantined(e)]
+
+    def count(self) -> int:
+        with self._lock:
+            now = self._clock()
+            return sum(1 for at in self._quarantined_at.values()
+                       if now - at < self.probation_s)
+
+    def snapshot(self) -> dict:
+        """REST/debug view: who is out, for how much longer, who is on
+        probation, and the lifetime counter."""
+        with self._lock:
+            now = self._clock()
+            return {
+                "threshold": self.threshold,
+                "probation_s": self.probation_s,
+                "quarantined": {
+                    e: round(max(0.0, self.probation_s - (now - at)), 1)
+                    for e, at in self._quarantined_at.items()
+                    if now - at < self.probation_s},
+                "probation": sorted(self._on_probation),
+                "total_quarantined": self.total_quarantined,
+            }

@@ -14,6 +14,7 @@ api/mod.rs:85-137 + handlers.rs):
 Beyond the reference surface:
 
     GET  /api/admission        admission-control queue state per tenant
+    GET  /api/quarantine       quarantined/probation executors + counters
     GET  /api/job/<id>/profile per-stage -> per-task -> per-operator profile
     GET  /api/job/<id>/trace   Chrome trace-event JSON (Perfetto-loadable)
 """
@@ -49,6 +50,9 @@ class RestApi:
             def do_GET(self):
                 try:
                     outer._route_get(self)
+                # the error is returned to the HTTP client as the 500 body;
+                # logging every probe of a bad route lets clients spam the log
+                # ballista: allow=recovery-path-logging — surfaced in the 500
                 except Exception as e:  # noqa: BLE001
                     self._send(500, json.dumps({"error": str(e)}))
 
@@ -120,6 +124,8 @@ class RestApi:
             h._send(200, self.server.metrics.gather(), ctype="text/plain")
         elif rest == ["admission"]:
             h._send(200, json.dumps(self.server.admission.snapshot()))
+        elif rest == ["quarantine"]:
+            h._send(200, json.dumps(self.server.quarantine.snapshot()))
         elif rest == ["scaler"]:
             # KEDA-scaler-shaped endpoint (reference external_scaler.rs:14-60
             # reports inflight_tasks = pending task count); consumed by a
@@ -134,7 +140,9 @@ class RestApi:
         cluster = self.server.cluster
         return {
             "executors": len(cluster.executors()),
-            "alive_executors": len(cluster.alive_executors()),
+            "alive_executors": len(cluster.alive_executors(
+                self.server.config.executor_timeout_s)),
+            "quarantined_executors": self.server.quarantine.count(),
             "available_task_slots": cluster.total_available(),
             "pending_tasks": self.server.pending_task_count(),
             "started_at": getattr(self.server, "_started_at", 0),
@@ -151,6 +159,8 @@ class RestApi:
                 "task_slots": meta.task_slots,
                 "last_seen_s_ago": round(time.time() - hb.timestamp, 1) if hb else None,
                 "status": hb.status if hb else "unknown",
+                "quarantined": self.server.quarantine.is_quarantined(
+                    meta.executor_id),
             })
         return out
 

@@ -30,6 +30,7 @@ from ..utils.config import ANALYSIS_PLAN_CHECKS
 from .cluster import ClusterState, JobState
 from .event_loop import EventLoop
 from .execution_graph import ExecutionGraph
+from .quarantine import ExecutorQuarantine
 from .types import (
     ExecutorHeartbeat,
     ExecutorMetadata,
@@ -118,14 +119,33 @@ class PollWork:
 
 class SchedulerConfig:
     def __init__(self, task_distribution: str = "bias",
-                 executor_timeout_s: float = 180.0,
+                 executor_timeout_s: Optional[float] = None,
                  reaper_interval_s: float = 15.0,
                  event_buffer_size: int = 10000,
                  policy: str = "push",
-                 job_data_cleanup_delay_s: float = 30.0):
+                 job_data_cleanup_delay_s: float = 30.0,
+                 quarantine_failures: Optional[int] = None,
+                 quarantine_probation_s: Optional[float] = None):
+        from ..utils.config import (BallistaConfig,
+                                    CLUSTER_EXECUTOR_TIMEOUT_S,
+                                    QUARANTINE_FAILURES,
+                                    QUARANTINE_PROBATION_S)
+
         assert policy in ("push", "pull")  # reference TaskSchedulingPolicy
+        defaults = BallistaConfig()
         self.task_distribution = task_distribution
-        self.executor_timeout_s = executor_timeout_s
+        # one key drives both "stop offering" (minus the drain grace, see
+        # cluster.alive_cutoff_s) and "declare lost" (the reaper):
+        # ballista.cluster.executor_timeout_s
+        self.executor_timeout_s = float(
+            executor_timeout_s if executor_timeout_s is not None
+            else defaults.get(CLUSTER_EXECUTOR_TIMEOUT_S))
+        self.quarantine_failures = int(
+            quarantine_failures if quarantine_failures is not None
+            else defaults.get(QUARANTINE_FAILURES))
+        self.quarantine_probation_s = float(
+            quarantine_probation_s if quarantine_probation_s is not None
+            else defaults.get(QUARANTINE_PROBATION_S))
         self.reaper_interval_s = reaper_interval_s
         self.event_buffer_size = event_buffer_size
         self.policy = policy
@@ -179,6 +199,11 @@ class SchedulerServer:
         self._stopped = threading.Event()
         self._cleanup_timers: Dict[str, threading.Timer] = {}
         self._cleanup_lock = threading.Lock()
+        # quarantine: executors racking up consecutive retryable failures
+        # stop receiving offers until probation re-admits them
+        self.quarantine = ExecutorQuarantine(
+            threshold=self.config.quarantine_failures,
+            probation_s=self.config.quarantine_probation_s)
         # admission gate between submit_job and JobQueued planning; with no
         # ballista.admission.* limits configured this is pass-through
         self.admission = AdmissionController(
@@ -440,6 +465,7 @@ class SchedulerServer:
     def _on_executor_lost(self, ev: ExecutorLost) -> None:
         log.info("executor %s lost: %s", ev.executor_id, ev.reason)
         self.cluster.remove_executor(ev.executor_id)
+        self.quarantine.remove(ev.executor_id)
         for graph in self.jobs.active_graphs():
             graph.executor_lost(ev.executor_id)
         self._offer()
@@ -526,6 +552,8 @@ class SchedulerServer:
             self.cluster.touch_heartbeat(ev.executor_id)
             if ev.statuses:
                 self._absorb_statuses(ev.executor_id, ev.statuses)
+            if self.quarantine.is_quarantined(ev.executor_id):
+                return  # reply with no tasks (finally still runs)
             graphs = self.jobs.active_graphs()
             gate = self.admission.slot_gate(
                 lambda: {g.job_id: len(g.running_tasks()) for g in graphs})
@@ -549,6 +577,7 @@ class SchedulerServer:
                          statuses: List[TaskStatus]) -> None:
         """Shared status intake (used by push TaskUpdating and pull
         PollWork)."""
+        self._record_quarantine_signals(executor_id, statuses)
         by_job: Dict[str, List[TaskStatus]] = {}
         for st in statuses:
             by_job.setdefault(st.task.job_id, []).append(st)
@@ -580,6 +609,28 @@ class SchedulerServer:
                     error=f"status absorption crashed: "
                           f"{type(e).__name__}: {e}"))
                 self.metrics.record_failed(job_id)
+
+    def _record_quarantine_signals(self, executor_id: str,
+                                   statuses: List[TaskStatus]) -> None:
+        """Feed the quarantine counter: a success clears the reporting
+        executor's streak; a *retryable* failure (IOError/ExecutorLost/
+        ResultLost) extends it.  Fetch failures blame the producer's data
+        and fatal ExecutionErrors fail the job outright — neither says this
+        executor is sick, so neither counts."""
+        for st in statuses:
+            eid = st.executor_id or executor_id
+            if st.state == "success":
+                self.quarantine.record_success(eid)
+            elif (st.state == "failed" and st.failure is not None
+                  and st.failure.retryable):
+                if self.quarantine.record_failure(eid):
+                    log.warning(
+                        "executor %s quarantined after %d consecutive "
+                        "retryable task failures (probation in %.0fs)", eid,
+                        self.quarantine.threshold,
+                        self.quarantine.probation_s)
+                    self.metrics.record_quarantined(eid)
+        self.metrics.set_quarantined_executors(self.quarantine.count())
 
     def _absorb_job_statuses(self, job_id: str, graph,
                              sts: List[TaskStatus]) -> None:
@@ -625,7 +676,8 @@ class SchedulerServer:
         self.admission.pump()
         if self.config.policy != "push":
             return  # pull mode: executors come to us via poll_work
-        alive = set(self.cluster.alive_executors(self.config.executor_timeout_s))
+        alive = set(self.quarantine.filter(
+            self.cluster.alive_executors(self.config.executor_timeout_s)))
         if pending == 0 or not alive:
             return
         reservations = self.cluster.reserve_slots(pending, sorted(alive))

@@ -65,6 +65,11 @@ class StandaloneCluster:
                  work_dir: Optional[str] = None,
                  scheduler_config: Optional[SchedulerConfig] = None):
         self.config = config or BallistaConfig()
+        # arm failpoints (no-op unless a plan is configured) — standalone
+        # runs the same instrumented task/shuffle paths as remote mode
+        from .. import faults
+
+        faults.configure(self.config)
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-")
         self._owns_work_dir = work_dir is None
         from ..obs import JobObservability

@@ -51,6 +51,19 @@ OBS_COLLECTOR = "ballista.observability.collector"
 OBS_OTLP_ENDPOINT = "ballista.observability.otlp.endpoint"
 # static analysis (arrow_ballista_tpu/analysis/)
 ANALYSIS_PLAN_CHECKS = "ballista.analysis.plan_checks"
+# RPC hardening (net/retry.py): client-side deadlines + bounded backoff
+RPC_CONNECT_TIMEOUT_S = "ballista.rpc.connect.timeout.seconds"
+RPC_READ_TIMEOUT_S = "ballista.rpc.read.timeout.seconds"
+RPC_RETRY_BASE_S = "ballista.rpc.retry.base.seconds"
+RPC_RETRY_CAP_S = "ballista.rpc.retry.cap.seconds"
+RPC_RETRY_DEADLINE_S = "ballista.rpc.retry.deadline.seconds"
+# cluster membership (scheduler/cluster.py): one timeout, documented grace
+CLUSTER_EXECUTOR_TIMEOUT_S = "ballista.cluster.executor_timeout_s"
+# executor quarantine (scheduler/quarantine.py)
+QUARANTINE_FAILURES = "ballista.scheduler.quarantine.failures"
+QUARANTINE_PROBATION_S = "ballista.scheduler.quarantine.probation.seconds"
+# deterministic fault injection (arrow_ballista_tpu/faults/)
+FAULTS_PLAN = "ballista.faults.plan"
 
 
 @dataclasses.dataclass
@@ -206,6 +219,41 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "mismatches or orphan/cyclic stage dependencies before "
                     "any task launches (see "
                     "docs/developer-guide/static-analysis.md)"),
+        ConfigEntry(RPC_CONNECT_TIMEOUT_S, 5.0, float,
+                    "TCP connect deadline for client-side control-plane "
+                    "RPCs (net/retry.py)"),
+        ConfigEntry(RPC_READ_TIMEOUT_S, 60.0, float,
+                    "read deadline for client-side control-plane RPCs "
+                    "(net/retry.py)"),
+        ConfigEntry(RPC_RETRY_BASE_S, 0.2, float,
+                    "base backoff between RPC retries; doubles per attempt "
+                    "(jittered, capped at ballista.rpc.retry.cap.seconds)"),
+        ConfigEntry(RPC_RETRY_CAP_S, 5.0, float,
+                    "upper bound on a single RPC retry backoff"),
+        ConfigEntry(RPC_RETRY_DEADLINE_S, 30.0, float,
+                    "give-up deadline across all retries of one RPC; on "
+                    "expiry a retryable failure surfaces (executor marks "
+                    "the scheduler unreachable; a failed launch becomes "
+                    "ExecutorLost)"),
+        ConfigEntry(CLUSTER_EXECUTOR_TIMEOUT_S, 180.0, float,
+                    "seconds without a heartbeat before an executor is "
+                    "declared lost (reaper -> ExecutorLost).  Work offers "
+                    "stop earlier, at timeout minus a drain grace of "
+                    "min(60s, timeout/2), so a slow-heartbeat executor "
+                    "drains instead of receiving doomed tasks"),
+        ConfigEntry(QUARANTINE_FAILURES, 5, int,
+                    "consecutive retryable task failures on one executor "
+                    "before it is quarantined (no new offers); 0 disables "
+                    "quarantine"),
+        ConfigEntry(QUARANTINE_PROBATION_S, 60.0, float,
+                    "seconds a quarantined executor sits out before "
+                    "probation re-admits it; one failure on probation "
+                    "re-quarantines, one success clears it"),
+        ConfigEntry(FAULTS_PLAN, "", str,
+                    "deterministic fault-injection plan: inline JSON or "
+                    "'@/path/to/plan.json' (see arrow_ballista_tpu/faults/ "
+                    "and docs/user-guide/fault-tolerance.md); empty = "
+                    "disabled, all failpoint sites are no-ops"),
     ]
 }
 

@@ -96,6 +96,15 @@ class FetchFailedError(BallistaError):
         )
 
 
+class ExecutorKilled(BallistaError):
+    """The ``faults`` kill action is abruptly stopping this executor.
+
+    Raised in the task thread so the in-flight task unwinds as ``killed``
+    (never reported as a job failure — the executor is simulating SIGKILL;
+    the scheduler learns of the death via heartbeat timeout / launch
+    failure, exactly as it would for a real crash)."""
+
+
 class CapacityError(ExecutionError):
     """Static output capacity exceeded (join fan-out / agg groups).
 

@@ -17,7 +17,9 @@ from __future__ import annotations
 import logging
 import logging.handlers
 import os
-from typing import Optional
+import threading
+import time
+from typing import Callable, Dict, Optional
 
 ROTATION_POLICIES = ("minutely", "hourly", "daily", "never")
 
@@ -57,3 +59,44 @@ def init_logging(level: str = "INFO", log_dir: Optional[str] = None,
     console.setLevel(logging.WARNING)
     console.setFormatter(fmt)
     root.addHandler(console)
+
+
+class ThrottledLogger:
+    """At most one record per ``interval_s`` per *key* (interval-class).
+
+    Retry loops that log every iteration flood the log exactly when the
+    operator needs it readable (scheduler down => one status-report warning
+    per second per executor).  Suppressed occurrences are counted and the
+    count is appended to the next record that does get through.
+    """
+
+    def __init__(self, logger: logging.Logger, interval_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._logger = logger
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_emit: Dict[str, float] = {}
+        self._suppressed: Dict[str, int] = {}
+
+    def log(self, level: int, key: str, msg: str, *args,
+            exc_info=False) -> bool:
+        now = self._clock()
+        with self._lock:
+            last = self._last_emit.get(key)
+            if last is not None and now - last < self.interval_s:
+                self._suppressed[key] = self._suppressed.get(key, 0) + 1
+                return False
+            n = self._suppressed.pop(key, 0)
+            self._last_emit[key] = now
+        if n:
+            msg = f"{msg} ({n} similar suppressed in the last " \
+                  f"{self.interval_s:.0f}s)"
+        self._logger.log(level, msg, *args, exc_info=exc_info)
+        return True
+
+    def warning(self, key: str, msg: str, *args, **kw) -> bool:
+        return self.log(logging.WARNING, key, msg, *args, **kw)
+
+    def error(self, key: str, msg: str, *args, **kw) -> bool:
+        return self.log(logging.ERROR, key, msg, *args, **kw)

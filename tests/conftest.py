@@ -38,6 +38,16 @@ import pytest  # noqa: E402
 TEST_TIMEOUT_S = int(os.environ.get("BALLISTA_TEST_TIMEOUT", "600"))
 
 
+def pytest_configure(config):
+    # no pytest.ini in this repo: markers are registered here so
+    # --strict-markers stays usable and `-m chaos` selects the fault
+    # -injection recovery suite (tests/test_chaos.py)
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection recovery tests")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 runs")
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_protocol(item, nextitem):
     if TEST_TIMEOUT_S > 0:

@@ -1,0 +1,643 @@
+"""Chaos recovery suite: deterministic fault injection end to end.
+
+Every scenario drives REAL recovery machinery — no mocked failures.  A
+seeded :class:`faults.FaultPlan` maps named failpoint sites (compiled into
+the executor/scheduler/net code paths) to raise/delay/drop/corrupt/kill
+actions; the plan's event log makes the injection schedule itself an
+assertable artifact, so the same seed + same plan must reproduce the same
+faults (the reproducibility test below).
+
+The four ISSUE scenarios:
+
+1. executor killed mid-stage -> job completes, results identical,
+2. shuffle fetch failure -> lineage rollback re-runs the producer,
+3. status reports dropped -> reporter loop redeems them,
+4. scheduler restarts mid-job -> recovers the job from persistence.
+
+Plus: executor quarantine after consecutive failures (observable via
+metrics + REST), RPC deadline/backoff hardening, and unit coverage of the
+failpoint framework itself.  Select with ``-m chaos``.
+"""
+import json
+import logging
+import socket
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import faults
+from arrow_ballista_tpu.net.retry import GiveUpError, RetryPolicy, call_with_retry
+from arrow_ballista_tpu.utils.config import BallistaConfig
+from arrow_ballista_tpu.utils.errors import IOError_
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    """A leaked plan would silently poison every later test in the run."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# --------------------------------------------------------------------------
+# failpoint framework units
+# --------------------------------------------------------------------------
+
+def test_disabled_failpoints_are_noops():
+    assert faults.active() is None
+    assert faults.inject("rpc.client.send", method="ping") is None
+    assert faults.dropped("executor.status.report", executor_id="e") is False
+
+
+def test_unknown_site_action_and_field_rejected():
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        faults.FaultRule("no.such.site", "raise")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.FaultRule("rpc.client.send", "explode")
+    with pytest.raises(ValueError, match="unknown fault rule field"):
+        faults.FaultRule.from_obj({"site": "rpc.client.send",
+                                   "action": "raise", "bogus": 1})
+    with pytest.raises(ValueError, match="unknown fault error kind"):
+        with faults.use_plan(faults.FaultPlan([faults.FaultRule(
+                "rpc.client.send", "raise", error="bogus")])):
+            faults.inject("rpc.client.send")
+
+
+def test_on_hit_and_times_budget():
+    rule = faults.FaultRule("rpc.client.send", "raise", error="io",
+                            message="boom", on_hit=2, times=1)
+    with faults.use_plan(faults.FaultPlan([rule])) as plan:
+        assert faults.inject("rpc.client.send") is None   # hit 1: before on_hit
+        with pytest.raises(IOError_, match="boom"):
+            faults.inject("rpc.client.send")              # hit 2: fires
+        assert faults.inject("rpc.client.send") is None   # hit 3: budget spent
+    assert rule.hits == 3 and rule.fired == 1
+    assert plan.schedule() == (("rpc.client.send", 0, 2, "raise"),)
+
+
+def test_match_filters_string_compare():
+    rule = faults.FaultRule("executor.task.before_run", "delay",
+                            delay_ms=0, times=-1, match={"stage_id": 2})
+    with faults.use_plan(faults.FaultPlan([rule])):
+        assert faults.inject("executor.task.before_run", stage_id=1) is None
+        # int ctx vs int match, and str ctx vs int match both fire
+        assert faults.inject("executor.task.before_run", stage_id=2) is rule
+        assert faults.inject("executor.task.before_run", stage_id="2") is rule
+    assert rule.fired == 2
+
+
+def test_seeded_plan_reproducible():
+    spec = {"seed": 42, "rules": [{"site": "rpc.client.send",
+                                   "action": "delay", "delay_ms": 0,
+                                   "times": -1, "p": 0.5}]}
+
+    def drive(plan):
+        with faults.use_plan(plan):
+            for _ in range(40):
+                faults.inject("rpc.client.send", method="hb")
+        return plan.schedule()
+
+    a = drive(faults.FaultPlan.from_json(json.dumps(spec)))
+    b = drive(faults.FaultPlan.from_json(json.dumps(spec)))
+    assert a == b and 0 < len(a) < 40, "same seed => identical schedule"
+    spec["seed"] = 43
+    c = drive(faults.FaultPlan.from_json(json.dumps(spec)))
+    assert c != a, "different seed => different schedule"
+
+
+def test_corrupt_bytes_deterministic():
+    data = bytes(range(256)) * 2
+    out = faults.corrupt_bytes(data)
+    assert len(out) == len(data) and out != data
+    assert out[0] == data[0] ^ 0xFF, "byte 0 flips so magic headers break"
+    assert out[97] == data[97] ^ 0xFF
+    assert out[1:97] == data[1:97]
+    assert faults.corrupt_bytes(data) == out
+
+
+def test_configure_from_env_config_and_file(tmp_path, monkeypatch):
+    spec = json.dumps({"seed": 9, "rules": [
+        {"site": "scheduler.status.receive", "action": "drop", "times": 2}]})
+    # env var
+    monkeypatch.setenv(faults.ENV_PLAN, spec)
+    plan = faults.configure()
+    assert plan is faults.active() and len(plan.rules) == 1
+    assert plan.seed == 9
+    assert faults.configure() is plan, "configure is idempotent"
+    faults.clear()
+    # config key wins over (absent) env
+    monkeypatch.delenv(faults.ENV_PLAN)
+    plan2 = faults.configure(BallistaConfig({"ballista.faults.plan": spec}))
+    assert plan2 is not None and plan2.rules[0].action == "drop"
+    faults.clear()
+    # @file indirection
+    p = tmp_path / "plan.json"
+    p.write_text(spec)
+    monkeypatch.setenv(faults.ENV_PLAN, f"@{p}")
+    plan3 = faults.configure()
+    assert plan3 is not None and plan3.seed == 9
+    # nothing set -> no plan
+    monkeypatch.delenv(faults.ENV_PLAN)
+    faults.clear()
+    assert faults.configure(BallistaConfig()) is None
+
+
+# --------------------------------------------------------------------------
+# RPC hardening units
+# --------------------------------------------------------------------------
+
+def test_backoff_exponential_capped():
+    p = RetryPolicy(base_backoff_s=0.1, max_backoff_s=0.5, jitter=0.0)
+    assert p.backoff_s(0) == pytest.approx(0.1)
+    assert p.backoff_s(1) == pytest.approx(0.2)
+    assert p.backoff_s(10) == pytest.approx(0.5), "capped"
+    jittered = RetryPolicy(base_backoff_s=0.1, max_backoff_s=0.5, jitter=0.5)
+    for attempt in range(5):
+        b = jittered.backoff_s(attempt)
+        full = min(0.5, 0.1 * 2 ** attempt)
+        assert full / 2 <= b <= full
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_call_with_retry_hits_give_up_deadline():
+    policy = RetryPolicy(connect_timeout_s=0.2, base_backoff_s=0.02,
+                         max_backoff_s=0.05, give_up_after_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(GiveUpError) as ei:
+        call_with_retry("127.0.0.1", _dead_port(), "ping", policy=policy)
+    assert time.monotonic() - t0 < 5.0, "give-up deadline must bound the wait"
+    assert isinstance(ei.value, ConnectionError), "callers treat it as transport"
+    assert isinstance(ei.value.last, OSError)
+
+
+def test_remote_error_not_retried():
+    from arrow_ballista_tpu.net import wire
+    from arrow_ballista_tpu.net.rpc import RpcServer
+
+    calls = []
+
+    def handler(payload, _bin):
+        calls.append(1)
+        raise ValueError("handler exploded")
+
+    server = RpcServer("127.0.0.1", 0)
+    server.register("boom", handler)
+    server.start()
+    try:
+        with pytest.raises(wire.RemoteError):
+            call_with_retry("127.0.0.1", server.port, "boom",
+                            policy=RetryPolicy(give_up_after_s=5.0))
+        assert len(calls) == 1, \
+            "the server answered: retrying would re-run a non-idempotent handler"
+    finally:
+        server.stop()
+
+
+def test_rpc_client_send_drop_failpoint():
+    from arrow_ballista_tpu.net import wire
+
+    rule = faults.FaultRule("rpc.client.send", "drop", times=1)
+    with faults.use_plan(faults.FaultPlan([rule])):
+        with pytest.raises(ConnectionError, match="failpoint"):
+            wire.call("127.0.0.1", 1, "ping")  # dropped before connecting
+    assert rule.fired == 1
+
+
+def test_throttled_logger_suppresses_and_counts(caplog):
+    from arrow_ballista_tpu.utils.logsetup import ThrottledLogger
+
+    now = [0.0]
+    tl = ThrottledLogger(logging.getLogger("chaos.throttle"), interval_s=60.0,
+                         clock=lambda: now[0])
+    with caplog.at_level(logging.WARNING, logger="chaos.throttle"):
+        assert tl.warning("hb", "heartbeat failed")
+        for _ in range(5):
+            assert not tl.warning("hb", "heartbeat failed")
+        assert tl.warning("poll", "poll failed"), "independent interval-class"
+        now[0] = 61.0
+        assert tl.warning("hb", "heartbeat failed")
+    assert "5 similar suppressed" in caplog.text
+
+
+# --------------------------------------------------------------------------
+# quarantine + liveness-window units
+# --------------------------------------------------------------------------
+
+def test_quarantine_threshold_probation_and_strike():
+    from arrow_ballista_tpu.scheduler.quarantine import ExecutorQuarantine
+
+    now = [0.0]
+    q = ExecutorQuarantine(threshold=2, probation_s=10.0, clock=lambda: now[0])
+    assert not q.record_failure("e1")
+    assert q.record_failure("e1"), "second consecutive failure quarantines"
+    assert q.is_quarantined("e1") and q.count() == 1
+    snap = q.snapshot()
+    assert snap["quarantined"]["e1"] == pytest.approx(10.0)
+    assert snap["total_quarantined"] == 1
+    # probation window elapses -> schedulable again, on probation
+    now[0] = 10.0
+    assert not q.is_quarantined("e1")
+    assert q.snapshot()["probation"] == ["e1"]
+    # one probation strike re-quarantines immediately
+    assert q.record_failure("e1")
+    assert q.is_quarantined("e1")
+    # a success clears everything
+    now[0] = 20.0
+    assert not q.is_quarantined("e1")  # probation again
+    q.record_success("e1")
+    assert not q.record_failure("e1"), "history cleared: back to counting"
+    # threshold <= 0 disables
+    off = ExecutorQuarantine(threshold=0)
+    assert not off.record_failure("x") and not off.is_quarantined("x")
+
+
+def test_alive_window_has_no_unschedulable_gap():
+    from arrow_ballista_tpu.scheduler.cluster import (
+        ClusterState,
+        alive_cutoff_s,
+    )
+    from arrow_ballista_tpu.scheduler.types import ExecutorMetadata
+
+    assert alive_cutoff_s(180.0) == pytest.approx(120.0)
+    assert alive_cutoff_s(3.0) == pytest.approx(1.5), "grace capped at half"
+
+    cs = ClusterState()
+    cs.register_executor(ExecutorMetadata("e1", task_slots=2))
+    hb = cs._heartbeats["e1"]
+    # inside the alive window
+    hb.timestamp = time.time() - 100.0
+    assert cs.alive_executors(180.0) == ["e1"]
+    assert cs.expired_executors(180.0) == []
+    # draining: no offers, but not yet expired — and by construction every
+    # age > cutoff eventually crosses the expiry line (single timeout key)
+    hb.timestamp = time.time() - 130.0
+    assert cs.alive_executors(180.0) == []
+    assert cs.expired_executors(180.0) == []
+    # past the full timeout the reaper declares it lost
+    hb.timestamp = time.time() - 200.0
+    assert cs.expired_executors(180.0) == ["e1"]
+
+
+def test_executor_marks_scheduler_down_and_reregisters():
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.types import ExecutorMetadata
+    from arrow_ballista_tpu.utils.logsetup import ThrottledLogger
+
+    class FakeClient:
+        def __init__(self):
+            self.registered = []
+            self.fail = False
+
+        def register_executor(self, meta):
+            if self.fail:
+                raise ConnectionError("still down")
+            self.registered.append(meta.executor_id)
+
+    es = ExecutorServer.__new__(ExecutorServer)  # state machine only, no sockets
+    es._sched_state_lock = threading.Lock()
+    es._scheduler_down = False
+    es.retry_policy = RetryPolicy()
+    es._log_throttle = ThrottledLogger(logging.getLogger("chaos.exec"),
+                                       interval_s=60.0)
+    es.metadata = ExecutorMetadata("unit-exec", task_slots=1)
+    es.scheduler = FakeClient()
+
+    es._mark_scheduler_up()
+    assert es.scheduler.registered == [], "no outage, no re-register"
+    es._mark_scheduler_down("heartbeat")
+    es._mark_scheduler_down("status report")  # idempotent transition
+    assert es._scheduler_down
+    es._mark_scheduler_up()
+    assert es.scheduler.registered == ["unit-exec"], \
+        "first success after outage re-registers (scheduler may have restarted)"
+    assert not es._scheduler_down
+    # re-register failing flips back to down so the next success retries it
+    es._mark_scheduler_down("heartbeat")
+    es.scheduler.fail = True
+    es._mark_scheduler_up()
+    assert es._scheduler_down
+    es.scheduler.fail = False
+    es._mark_scheduler_up()
+    assert es.scheduler.registered == ["unit-exec", "unit-exec"]
+
+
+# --------------------------------------------------------------------------
+# e2e helpers: real network cluster (scheduler RPC + executors + client)
+# --------------------------------------------------------------------------
+
+CHAOS_CONF = {
+    "ballista.shuffle.partitions": "4",
+    # fast-failure RPC policy so every scenario stays seconds-long
+    "ballista.rpc.connect.timeout.seconds": "1.0",
+    "ballista.rpc.read.timeout.seconds": "10.0",
+    "ballista.rpc.retry.base.seconds": "0.05",
+    "ballista.rpc.retry.cap.seconds": "0.2",
+    "ballista.rpc.retry.deadline.seconds": "1.5",
+}
+
+SQL = "select g, sum(v) as s, count(*) as n from t group by g order by g"
+
+
+def _make_cluster(tmp_path, n_executors=2, concurrent_tasks=4):
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+    from arrow_ballista_tpu.scheduler.scheduler import SchedulerConfig
+
+    sched = SchedulerNetService(
+        "127.0.0.1", 0, config=BallistaConfig(CHAOS_CONF),
+        scheduler_config=SchedulerConfig(task_distribution="round-robin",
+                                         executor_timeout_s=3.0,
+                                         reaper_interval_s=0.3))
+    sched.start()
+    executors = []
+    for i in range(n_executors):
+        work = tmp_path / f"exec{i}"
+        work.mkdir()
+        ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                            work_dir=str(work),
+                            concurrent_tasks=concurrent_tasks,
+                            executor_id=f"chaos-exec-{i}",
+                            config=BallistaConfig(CHAOS_CONF),
+                            heartbeat_interval_s=0.4)
+        ex.start()
+        executors.append(ex)
+    return sched, executors
+
+
+def _teardown(sched, executors):
+    for ex in executors:
+        ex.stop(notify=False)
+    sched.stop()
+
+
+def _client(port, n=4000, groups=7, seed=11):
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    c = BallistaContext.remote(
+        "127.0.0.1", port, BallistaConfig({"ballista.shuffle.partitions": "4"}))
+    rng = np.random.default_rng(seed)
+    c.register_table("t", pa.table({
+        "g": pa.array(rng.integers(0, groups, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+    }))
+    return c
+
+
+def _frames_equal(got, expected):
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  expected.reset_index(drop=True),
+                                  check_dtype=False)
+
+
+# --------------------------------------------------------------------------
+# scenario 1: executor killed mid-stage -> job completes, result unchanged
+# --------------------------------------------------------------------------
+
+def test_executor_killed_mid_stage_job_completes(tmp_path):
+    sched, executors = _make_cluster(tmp_path)
+    try:
+        c = _client(sched.port)
+        baseline = c.sql(SQL).to_pandas()
+
+        victim = executors[1]
+        plan = faults.FaultPlan.from_obj({"seed": 7, "rules": [{
+            "site": "executor.task.before_run", "action": "kill",
+            "match": {"executor_id": victim.metadata.executor_id},
+            "on_hit": 1, "times": 1}]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        assert plan.schedule() == (("executor.task.before_run", 0, 1, "kill"),)
+        assert victim._killed, "the kill action must reach the registered target"
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 2: fetch failure -> lineage rollback re-runs the producer
+# --------------------------------------------------------------------------
+
+def test_fetch_failure_rolls_back_and_reruns_producer(tmp_path):
+    # concurrent_tasks=1 serializes each executor's reduce tasks, so the
+    # first remote fetch of (stage 1, map partition 0) burns ALL the rule's
+    # fire budget across its in-call retry attempts: a deterministic
+    # FetchFailedError -> consumer rollback -> producer re-run, after which
+    # the spent budget lets the re-fetch succeed.  High group cardinality
+    # keeps stage-2 inputs above the adaptive-coalescing floor so reducers
+    # land on both executors and a remote fetch is guaranteed.
+    from arrow_ballista_tpu.net.dataplane import FETCH_RETRIES
+
+    sched, executors = _make_cluster(tmp_path, concurrent_tasks=1)
+    try:
+        c = _client(sched.port, n=20_000, groups=50_000, seed=13)
+        baseline = c.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 3, "rules": [{
+            "site": "shuffle.fetch.recv", "action": "raise",
+            "error": "connection", "message": "injected dead peer",
+            "times": FETCH_RETRIES,
+            "match": {"stage_id": 1, "map_partition": 0}}]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        assert plan.schedule() == tuple(
+            ("shuffle.fetch.recv", 0, k, "raise")
+            for k in range(1, FETCH_RETRIES + 1)), \
+            "one logical fetch must absorb the whole budget"
+        # the consumer rolled back (charged) and the producer re-ran
+        graphs = list(sched.server.jobs._graphs.values())
+        assert any(s.failures >= 1 for g in graphs
+                   for s in g.stages.values()), "no consumer rollback recorded"
+        assert any(s.stage_attempt >= 1 for g in graphs
+                   for s in g.stages.values()), "no producer re-run recorded"
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 3: status reports dropped -> reporter retries until delivered
+# --------------------------------------------------------------------------
+
+def test_dropped_status_reports_are_redeemed(tmp_path):
+    sched, executors = _make_cluster(tmp_path)
+    try:
+        c = _client(sched.port)
+        baseline = c.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 5, "rules": [{
+            "site": "executor.status.report", "action": "drop", "times": 2}]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        drops = [e for e in plan.events if e["action"] == "drop"]
+        assert len(drops) == 2, "both drop budget units must be consumed"
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 4: scheduler restarts mid-job -> recovers from persistence
+# --------------------------------------------------------------------------
+
+def test_scheduler_restart_recovers_job(tmp_path):
+    from arrow_ballista_tpu.executor.executor import Executor
+    from arrow_ballista_tpu.models.ipc import read_ipc_files
+    from arrow_ballista_tpu.scheduler.execution_graph import SUCCESSFUL
+    from arrow_ballista_tpu.scheduler.persistence import FileJobStateBackend
+    from arrow_ballista_tpu.scheduler.scheduler import (
+        SchedulerConfig,
+        SchedulerServer,
+    )
+    from arrow_ballista_tpu.scheduler.standalone import InProcessTaskLauncher
+    from arrow_ballista_tpu.scheduler.types import ExecutorMetadata
+
+    from .test_scheduler import physical_plan
+
+    class KeepExecutorsLauncher(InProcessTaskLauncher):
+        # SchedulerServer.shutdown() stops the launcher; the executors must
+        # SURVIVE the restart (only the scheduler "crashes")
+        def stop(self):
+            pass
+
+    backend = FileJobStateBackend(str(tmp_path / "state"))
+    work = str(tmp_path / "work")
+    launcher = KeepExecutorsLauncher()
+    config = BallistaConfig({"ballista.shuffle.partitions": "4"})
+    executors = []
+    for i in range(2):
+        meta = ExecutorMetadata(executor_id=f"chaos-inproc-{i}", task_slots=2)
+        executors.append(Executor(meta, work, config, concurrent_tasks=2))
+        launcher.executors[meta.executor_id] = executors[-1]
+
+    def new_server():
+        server = SchedulerServer(launcher, SchedulerConfig(),
+                                 job_backend=backend,
+                                 scheduler_id="chaos-sched")
+        launcher.scheduler = server
+        server.init(start_reaper=False)
+        for ex in executors:
+            server.register_executor(ex.metadata)
+        return server
+
+    # stage-2 tasks crawl so the shutdown lands mid-stage, after stage 1
+    # checkpointed but before the job finishes
+    plan = faults.FaultPlan.from_obj({"seed": 1, "rules": [{
+        "site": "executor.task.before_run", "action": "delay",
+        "delay_ms": 400, "times": -1, "match": {"stage_id": 2}}]})
+    qplan = physical_plan()
+    server1 = new_server()
+    with faults.use_plan(plan):
+        server1.submit_job("chaosjob", lambda: (qplan, {}))
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            graph = server1.jobs.get_graph("chaosjob")
+            if graph is not None and graph.stages[1].state == SUCCESSFUL:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("stage 1 never completed")
+        server1.shutdown()  # "crash": in-flight stage-2 work is abandoned
+
+        server2 = new_server()
+        assert server2.jobs.get_graph("chaosjob") is None, "fresh scheduler"
+        adopted = server2.recover_jobs()
+        assert adopted == ["chaosjob"], "job must be re-acquired from the backend"
+        status = server2.wait_for_job("chaosjob", 60.0)
+    assert status.state == "successful"
+    graph2 = server2.jobs.get_graph("chaosjob")
+    assert plan.events, "the delay failpoint must actually have fired"
+
+    # results identical to the fault-free answer (same seeded data as
+    # test_scheduler.physical_plan: k in [0,5), v in [0,100))
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({"k": rng.integers(0, 5, 1000).astype(np.int64),
+                       "v": rng.integers(0, 100, 1000).astype(np.int64)})
+    expected = (df.groupby("k", as_index=False).agg(s=("v", "sum"))
+                .sort_values("k").reset_index(drop=True))
+    paths = [loc.path for part in sorted(status.locations)
+             for loc in status.locations[part] if loc.num_rows]
+    batches = read_ipc_files(paths, qplan.schema, capacity=1024)
+    got = pd.concat([b.to_pandas() for b in batches], ignore_index=True)
+    _frames_equal(got, expected)
+    assert graph2.status == "successful"
+    server2.shutdown()
+    for ex in executors:
+        ex.shutdown()
+
+
+# --------------------------------------------------------------------------
+# scenario 5: repeated failures quarantine an executor (metrics + REST)
+# --------------------------------------------------------------------------
+
+def test_quarantine_bad_executor_job_still_completes():
+    import urllib.request
+
+    from arrow_ballista_tpu.scheduler.rest import RestApi
+    from arrow_ballista_tpu.scheduler.scheduler import (
+        SchedulerConfig,
+        SchedulerServer,
+    )
+    from arrow_ballista_tpu.scheduler.types import (
+        IO_ERROR,
+        ExecutorMetadata,
+        FailedReason,
+        TaskStatus,
+    )
+
+    from .test_scheduler import VirtualTaskLauncher, physical_plan, run_job
+
+    def outcome(task, executor_id):
+        if executor_id == "exec-1":  # a broken host: every task fails
+            return TaskStatus(task.task, executor_id, "failed",
+                              failure=FailedReason(IO_ERROR, "disk on fire"))
+        return None
+
+    launcher = VirtualTaskLauncher(outcome)
+    server = SchedulerServer(launcher, SchedulerConfig(
+        task_distribution="round-robin",
+        quarantine_failures=3, quarantine_probation_s=300.0))
+    launcher.scheduler = server
+    server.init(start_reaper=False)
+    for i in range(2):
+        server.register_executor(ExecutorMetadata(f"exec-{i}", task_slots=2))
+    api = RestApi(server)
+    api.start()
+    try:
+        status = run_job(server, physical_plan())
+        # quarantine (threshold 3) must isolate exec-1 BEFORE any single
+        # task burns its TASK_MAX_FAILURES=4 budget -> the job completes
+        assert status.state == "successful"
+        assert server.quarantine.is_quarantined("exec-1")
+        assert not server.quarantine.is_quarantined("exec-0")
+        # observable via prometheus metrics ...
+        text = server.metrics.gather()
+        assert "executor_quarantined_total 1" in text
+        assert "quarantined_executors 1" in text
+        # ... and over the REST API
+        with urllib.request.urlopen(
+                f"http://{api.host}:{api.port}/api/quarantine", timeout=10) as r:
+            snap = json.loads(r.read())
+        assert "exec-1" in snap["quarantined"]
+        assert snap["threshold"] == 3 and snap["total_quarantined"] == 1
+    finally:
+        api.stop()
+        server.shutdown()

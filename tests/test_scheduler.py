@@ -445,6 +445,60 @@ def test_graph_fetch_failure_attempt_budget():
     assert any(k == "job_failed" for k, _ in events)
 
 
+def test_graph_fetch_budget_exhaustion_preserves_cause():
+    """When the fetch-failure budget runs out, the job error must carry the
+    ORIGINAL fetch failure message — not just the budget arithmetic — or the
+    operator debugging a dead job loses the root cause."""
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    while graph.stages[1].pending_partitions():
+        t = graph.pop_next_task("e")
+        graph.update_task_status([fake_success(t, "e")])
+    for _ in range(20):
+        t = graph.pop_next_task("e")
+        if t is None:
+            break
+        if t.task.stage_id != 2:
+            graph.update_task_status([fake_success(t, "e")])
+            continue
+        graph.update_task_status([TaskStatus(
+            t.task, "e", "failed",
+            failure=FailedReason(FETCH_PARTITION_ERROR, "dead peer at 10.0.0.9",
+                                 map_stage_id=1, map_partition_id=0,
+                                 executor_id="e"))])
+    assert graph.status == "failed"
+    assert "dead peer at 10.0.0.9" in graph.error, \
+        f"budget message must keep the root cause, got: {graph.error}"
+
+
+def test_graph_executor_lost_charges_no_budgets():
+    """Executor loss is not the query's fault: the rollback/reopen it forces
+    must not consume stage or task retry budgets, and the poisoned consumer's
+    in-flight tasks must be fully reset (regression guard for the chaos
+    executor-kill scenario)."""
+    graph = ExecutionGraph.build("j", physical_plan(partitions=4))
+    while graph.stages[1].pending_partitions():
+        t = graph.pop_next_task("exec-A")
+        graph.update_task_status([fake_success(t, "exec-A")])
+    assert graph.stages[2].state == RUNNING
+    t2 = graph.pop_next_task("exec-B")
+    assert t2 is not None and t2.task.stage_id == 2
+
+    graph.executor_lost("exec-A")
+    # stage budgets untouched (rollback/reopen with count_failure=False)
+    assert all(s.failures == 0 for s in graph.stages.values())
+    # per-task budgets untouched
+    assert all(f == 0 for s in graph.stages.values() for f in s.task_failures)
+    # the poisoned consumer is fully reset: no stale in-flight slots
+    assert graph.stages[2].state == UNRESOLVED
+    assert all(i is None for i in graph.stages[2].task_infos)
+    # but epochs advanced, so late statuses from the dead attempt are stale
+    assert graph.stages[1].stage_attempt >= 1
+    # and the graph still drains to success on the survivor, with full
+    # budgets available for real failures later
+    drain(graph, "exec-B")
+    assert graph.status == "successful"
+
+
 def test_graph_duplicate_success_ignored():
     graph = ExecutionGraph.build("j", physical_plan(partitions=2))
     t = graph.pop_next_task("e")

@@ -41,7 +41,8 @@ def test_all_rules_registered():
     names = set(all_rules())
     assert {"hot-path-purity", "span-coverage", "serde-completeness",
             "config-registry", "lock-discipline",
-            "no-blocking-in-event-loop", "metrics-docs"} <= names
+            "no-blocking-in-event-loop", "metrics-docs",
+            "recovery-path-logging"} <= names
 
 
 # --------------------------------------------------------------------------
@@ -276,6 +277,62 @@ def test_no_blocking_in_event_loop_fires(tmp_path):
         """)
     found = lint(tmp_path, "no-blocking-in-event-loop")
     assert [v.line for v in found] == [5, 6]
+
+
+def test_recovery_path_logging_fires_and_respects_handling(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/executor/loops.py", """\
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def silent_swallow():
+            try:
+                risky()
+            except Exception:
+                pass
+
+        def bare_silent():
+            try:
+                risky()
+            except:
+                pass
+
+        def logged():
+            try:
+                risky()
+            except Exception:
+                log.warning("risky failed", exc_info=True)
+
+        def reraised():
+            try:
+                risky()
+            except Exception:
+                raise
+
+        def narrow_is_fine():
+            try:
+                risky()
+            except KeyError:
+                pass
+
+        def waived():
+            try:
+                risky()
+            # ballista: allow=recovery-path-logging — test fixture
+            except Exception:
+                pass
+        """)
+    # broad handlers OUTSIDE executor/ and scheduler/ are out of scope
+    write_fixture(tmp_path, "arrow_ballista_tpu/client/other.py", """\
+        def elsewhere():
+            try:
+                risky()
+            except Exception:
+                pass
+        """)
+    found = lint(tmp_path, "recovery-path-logging")
+    assert [v.line for v in found] == [8, 14]
+    assert all("recovery-path-logging" == v.rule for v in found)
 
 
 def test_metrics_docs_rule_fires_on_missing_name(tmp_path):
