@@ -77,6 +77,10 @@ class Executor:
         # completion and the *result* is dropped as 'killed').  Bounded so a
         # long-lived executor doesn't accumulate ids forever.
         self._cancelled_jobs: "OrderedDict[str, None]" = OrderedDict()
+        # single-attempt cancel flags, keyed (job, stage, partition, attempt):
+        # the scheduler reaps the losing duplicate of a speculative race
+        # without touching the job's other tasks
+        self._cancelled_tasks: "OrderedDict[tuple, None]" = OrderedDict()
         self._max_cancelled = 1024
         self._lock = threading.Lock()
         self._active = 0
@@ -137,7 +141,7 @@ class Executor:
         with self._lock:
             self._active += 1
         try:
-            if tid.job_id in self._cancelled_jobs:
+            if self._is_cancelled(tid):
                 return TaskStatus(tid, self.metadata.executor_id, "killed")
             faults.inject("executor.task.before_run",
                           executor_id=self.metadata.executor_id,
@@ -150,12 +154,20 @@ class Executor:
                               work_dir=self.work_dir, job_id=tid.job_id,
                               stage_id=tid.stage_id,
                               executor_id=self.metadata.executor_id,
-                              cancelled=lambda: tid.job_id in self._cancelled_jobs,
+                              cancelled=lambda: self._is_cancelled(tid),
                               span_recorder=recorder)
             start_ms = int(time.time() * 1000)
+            # deterministic straggler: a 'delay' rule here stalls the task
+            # mid-run, which is what the speculation monitor watches for
+            faults.inject("executor.task.slow",
+                          executor_id=self.metadata.executor_id,
+                          job_id=tid.job_id, stage_id=tid.stage_id,
+                          partition=tid.partition,
+                          task_attempt=tid.task_attempt,
+                          speculative=tid.speculative)
             writes = stage_exec.execute_query_stage(tid.partition, ctx)
             end_ms = int(time.time() * 1000)
-            if tid.job_id in self._cancelled_jobs:
+            if self._is_cancelled(tid):
                 return TaskStatus(tid, self.metadata.executor_id, "killed")
             return TaskStatus(tid, self.metadata.executor_id, "success",
                               shuffle_writes=writes,
@@ -206,10 +218,25 @@ class Executor:
         self.pool.submit(run)
 
     # --- cancellation ----------------------------------------------------
+    def _is_cancelled(self, tid) -> bool:
+        return (tid.job_id in self._cancelled_jobs
+                or (tid.job_id, tid.stage_id, tid.partition,
+                    tid.task_attempt) in self._cancelled_tasks)
+
     def cancel_job_tasks(self, job_id: str) -> None:
         self._cancelled_jobs[job_id] = None
         while len(self._cancelled_jobs) > self._max_cancelled:
             self._cancelled_jobs.popitem(last=False)
+
+    def cancel_task(self, task_id) -> None:
+        """Cancel ONE attempt (a speculative race's loser): the flag is
+        checked between batches and before the result is reported, so the
+        attempt unwinds as 'killed' and its outputs are discarded."""
+        key = (task_id.job_id, task_id.stage_id, task_id.partition,
+               task_id.task_attempt)
+        self._cancelled_tasks[key] = None
+        while len(self._cancelled_tasks) > self._max_cancelled:
+            self._cancelled_tasks.popitem(last=False)
 
     def active_tasks(self) -> int:
         with self._lock:

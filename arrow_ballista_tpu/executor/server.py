@@ -270,6 +270,7 @@ class ExecutorServer:
 
         self.rpc.register("launch_multi_task", self._launch_multi_task)
         self.rpc.register("cancel_tasks", self._cancel_tasks)
+        self.rpc.register("cancel_task", self._cancel_task)
         self.rpc.register("fetch_partition", self._fetch_partition)
         self.rpc.register("remove_job_data", self._remove_job_data)
         self.rpc.register("stop_executor", self._stop_executor)
@@ -538,6 +539,11 @@ class ExecutorServer:
 
     def _cancel_tasks(self, payload: dict, _bin: bytes):
         self.executor.cancel_job_tasks(payload["job_id"])
+        return {}, b""
+
+    def _cancel_task(self, payload: dict, _bin: bytes):
+        # single-attempt cancel: the losing duplicate of a speculative race
+        self.executor.cancel_task(serde.taskid_from_obj(payload["task"]))
         return {}, b""
 
     def _is_under_work_dir(self, path: str) -> bool:

@@ -45,6 +45,8 @@ ENV_PLAN = "BALLISTA_FAULTS_PLAN"
 #: every failpoint compiled into the codebase (site -> where it lives)
 KNOWN_SITES = frozenset({
     "executor.task.before_run",     # executor/executor.py, per task start
+    "executor.task.slow",           # executor/executor.py, inside task run
+                                    # (delay => deterministic straggler)
     "executor.status.report",       # executor/server.py reporter -> scheduler
     "executor.heartbeat.send",      # executor/server.py heartbeat -> scheduler
     "rpc.client.send",              # net/wire.py, every client-side RPC

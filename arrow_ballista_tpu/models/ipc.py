@@ -127,6 +127,22 @@ def _write_table_ipc(table, path: str) -> tuple:
     return table.num_rows, os.path.getsize(path)
 
 
+def crc32_file(path: str) -> int:
+    """CRC-32 of a file's bytes (the shuffle-partition integrity checksum
+    recorded by writers and verified by the remote fetch path).  Reads the
+    just-written file back — it is still page-cache hot — so the checksum
+    covers exactly the bytes a fetcher will see on disk."""
+    import zlib
+
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
 def write_ipc_file(batch: ColumnBatch, path: str) -> tuple:
     """Returns (num_rows, num_bytes)."""
     return _write_table_ipc(batch_to_physical_table(batch), path)

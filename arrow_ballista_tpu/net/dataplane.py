@@ -29,12 +29,20 @@ def fetch_partition_batches(host: str, port: int, path: str, schema: Schema,
                             retries: int = FETCH_RETRIES,
                             backoff_s: float = RETRY_BACKOFF_S,
                             policy: Optional[RetryPolicy] = None,
+                            expected_checksum: int = -1,
                             fault_ctx: Optional[dict] = None) -> List[ColumnBatch]:
     """Fetch one shuffle/result file from an executor data plane and decode
     it into device batches.  Raises the last error after ``retries``.
 
     ``policy`` supplies connect/read deadlines and the backoff curve; when
     absent, legacy defaults (linear-ish ``backoff_s`` base, 3s cap) apply.
+    ``expected_checksum`` >= 0 is the producer-recorded CRC-32 of the file:
+    the payload is verified BEFORE Arrow deserialization and a mismatch
+    raises ``IntegrityError`` — retried in-loop (a re-fetch heals transient
+    wire corruption); after ``retries`` the caller escalates to
+    ``FetchFailedError`` and lineage recovery re-runs the producer.  An
+    undecodable payload surfaces the same way rather than as an opaque
+    Arrow traceback.
     ``fault_ctx`` adds caller-known match keys (producer stage/partition/
     executor) to the ``shuffle.fetch.recv`` failpoint context, so a chaos
     plan can pin a rule to ONE logical fetch rather than racing the hit
@@ -43,8 +51,10 @@ def fetch_partition_batches(host: str, port: int, path: str, schema: Schema,
     import pyarrow.ipc as ipc
 
     from ..models.ipc import physical_table_to_batches
+    from ..utils.errors import IntegrityError
 
     import os
+    import zlib
 
     policy = policy or RetryPolicy(base_backoff_s=backoff_s,
                                    max_backoff_s=backoff_s * retries,
@@ -67,7 +77,28 @@ def fetch_partition_batches(host: str, port: int, path: str, schema: Schema,
                                 connect_timeout=policy.connect_timeout_s)
             if rule is not None and rule.action == "corrupt":
                 data = faults.corrupt_bytes(data)
-            table = ipc.open_file(io.BytesIO(data)).read_all()
+            if expected_checksum >= 0:
+                got = zlib.crc32(data)
+                if got != expected_checksum:
+                    raise IntegrityError(
+                        "shuffle.fetch.recv",
+                        f"checksum mismatch: expected crc32 "
+                        f"{expected_checksum:#010x}, got {got:#010x} "
+                        f"({len(data)} bytes)",
+                        host=host, port=port, path=path,
+                        **(fault_ctx or {}))
+            try:
+                table = ipc.open_file(io.BytesIO(data)).read_all()
+            except Exception as decode_err:
+                # undecodable frame == corruption the checksum did not (or
+                # could not) catch; surface it as the same diagnosable,
+                # retryable integrity failure instead of an Arrow traceback
+                raise IntegrityError(
+                    "shuffle.fetch.recv",
+                    f"undecodable partition payload ({len(data)} bytes): "
+                    f"{decode_err}",
+                    host=host, port=port, path=path,
+                    **(fault_ctx or {})) from decode_err
             return physical_table_to_batches(table, schema, capacity=capacity)
         except Exception as e:  # noqa: BLE001 — caller maps to its taxonomy
             err = e

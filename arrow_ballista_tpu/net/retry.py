@@ -21,9 +21,13 @@ from dataclasses import dataclass
 from typing import Optional, Tuple
 
 from . import wire
+from ..utils.errors import IntegrityError
 
-#: errors worth retrying: the request may never have reached the peer.
-TRANSIENT_ERRORS = (ConnectionError, TimeoutError, OSError)
+#: errors worth retrying: the request may never have reached the peer, or
+#: (IntegrityError) the response frame arrived corrupted — transport-level
+#: damage a re-send usually heals, unlike a RemoteError, where the server
+#: answered intelligibly.
+TRANSIENT_ERRORS = (ConnectionError, TimeoutError, OSError, IntegrityError)
 
 
 class GiveUpError(ConnectionError):

@@ -46,14 +46,22 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+def recv_frame_raw(sock: socket.socket) -> Tuple[bytes, bytes]:
+    """Receive one frame without parsing the JSON part (the client path
+    parses separately so injected corruption surfaces as a diagnosable
+    ``IntegrityError`` instead of a bare ``json.JSONDecodeError``)."""
     hdr = _recv_exact(sock, _HDR.size)
     jlen, blen = _HDR.unpack(hdr)
     if jlen > MAX_FRAME or blen > MAX_BIN:
         raise ConnectionError(f"oversized frame ({jlen}/{blen})")
-    obj = json.loads(_recv_exact(sock, jlen)) if jlen else {}
+    jbytes = _recv_exact(sock, jlen) if jlen else b""
     binary = _recv_exact(sock, blen) if blen else b""
-    return obj, binary
+    return jbytes, binary
+
+
+def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    jbytes, binary = recv_frame_raw(sock)
+    return (json.loads(jbytes) if jbytes else {}), binary
 
 
 def connect(host: str, port: int, timeout: float = 20.0) -> socket.socket:
@@ -82,9 +90,21 @@ def call(host: str, port: int, method: str, payload: Optional[dict] = None,
         sock.settimeout(timeout)
         req = {"method": method, "payload": payload or {}}
         send_frame(sock, req, binary)
-        resp, rbin = recv_frame(sock)
+        jbytes, rbin = recv_frame_raw(sock)
         if rule is not None and rule.action == "corrupt":
+            # deterministic wire-frame corruption: both response parts, as a
+            # flaky NIC would deliver
+            jbytes = faults.corrupt_bytes(jbytes)
             rbin = faults.corrupt_bytes(rbin)
+        try:
+            resp = json.loads(jbytes) if jbytes else {}
+        except Exception as e:
+            from ..utils.errors import IntegrityError
+
+            raise IntegrityError(
+                "rpc.client.send",
+                f"undecodable response frame ({len(jbytes)} bytes): {e}",
+                method=method, host=host, port=port) from e
         if not resp.get("ok"):
             raise RemoteError(resp.get("error", "unknown remote error"),
                               resp.get("error_kind", ""))

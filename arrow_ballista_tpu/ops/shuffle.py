@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from ..models import expr as E
 from ..models.batch import ColumnBatch, concat_batches
 from ..models.batch import round_capacity as _round_capacity
-from ..models.ipc import read_ipc_files, write_ipc_file, write_ipc_rows
+from ..models.ipc import crc32_file, read_ipc_files, write_ipc_file, write_ipc_rows
 from ..models.schema import Schema
 from ..utils.errors import FetchFailedError, InternalError
 from .expressions import ExprCompiler
@@ -51,6 +51,9 @@ class ShuffleWritePartition:
     path: str
     num_rows: int
     num_bytes: int
+    # CRC-32 of the file bytes, verified by remote fetchers before
+    # deserialization; -1 = not recorded (pre-upgrade checkpoints)
+    checksum: int = -1
 
 
 @dataclasses.dataclass
@@ -67,6 +70,7 @@ class PartitionLocation:
     num_bytes: int = 0
     host: str = ""
     port: int = 0
+    checksum: int = -1  # producer-recorded CRC-32; -1 = unknown, skip verify
 
 
 class ShuffleWriterExec(ExecutionPlan):
@@ -113,7 +117,8 @@ class ShuffleWriterExec(ExecutionPlan):
                 rows, nbytes = write_ipc_file(big, path)
             self.metrics().add("input_rows", big.num_rows)
             self.metrics().add("output_rows", rows)
-            return [ShuffleWritePartition(partition, path, rows, nbytes)]
+            return [ShuffleWritePartition(partition, path, rows, nbytes,
+                                          checksum=crc32_file(path))]
 
         num_out = self.partitioning.count
         if self.partitioning.kind == "hash" and num_out > 1:
@@ -168,7 +173,8 @@ class ShuffleWriterExec(ExecutionPlan):
                     data = {k: v[lo:hi] for k, v in host_cols.items()}
                     path = os.path.join(base, f"data-{q}.arrow")
                     rows, nbytes = write_ipc_rows(big.schema, data, big.dicts, path)
-                    out.append(ShuffleWritePartition(q, path, rows, nbytes))
+                    out.append(ShuffleWritePartition(q, path, rows, nbytes,
+                                                     checksum=crc32_file(path)))
             self.metrics().add("input_rows", n)
             self.metrics().add("output_rows", sum(p.num_rows for p in out))
             return out
@@ -180,7 +186,8 @@ class ShuffleWriterExec(ExecutionPlan):
                 pb = ColumnBatch(big.schema, big.columns, part_mask, big.dicts)
                 path = os.path.join(base, f"data-{q}.arrow")
                 rows, nbytes = write_ipc_file(pb, path)
-                out.append(ShuffleWritePartition(q, path, rows, nbytes))
+                out.append(ShuffleWritePartition(q, path, rows, nbytes,
+                                                 checksum=crc32_file(path)))
         self.metrics().add("input_rows", big.num_rows)
         self.metrics().add(
             "output_rows", sum(p.num_rows for p in out)
@@ -284,10 +291,14 @@ class ShuffleReaderExec(ExecutionPlan):
         from ..net.retry import RetryPolicy
 
         try:
+            from ..utils.config import SHUFFLE_INTEGRITY
+
             batches = fetch_partition_batches(
                 loc.host, loc.port, loc.path,
                 self._schema, ctx.config.batch_size,
                 policy=RetryPolicy.from_config(ctx.config),
+                expected_checksum=(loc.checksum
+                                   if ctx.config.get(SHUFFLE_INTEGRITY) else -1),
                 fault_ctx={"stage_id": self.stage_id,
                            "map_partition": loc.map_partition,
                            "executor_id": loc.executor_id})

@@ -68,6 +68,12 @@ class TaskInfo:
     # timestamps survive absorption (reference keeps the full status stream
     # in ExecutionGraph for the UI's stage metrics)
     status: object = None
+    # attempt id this info belongs to (matches TaskId.task_attempt), so a
+    # status from a cancelled duplicate can be told apart from the winner's
+    attempt: int = 0
+    speculative: bool = False
+    # monotonic launch time; age drives the speculation policy
+    started_at: float = 0.0
 
 
 class ExecutionStage:
@@ -89,8 +95,55 @@ class ExecutionStage:
         self.resolved_plan: Optional[ShuffleWriterExec] = None
         self.task_infos: List[Optional[TaskInfo]] = [None] * self.partitions
         self.task_failures: List[int] = [0] * self.partitions
+        # next attempt id per partition: every launch — retry duplicate or
+        # speculative duplicate — draws a fresh id (keeps planned length,
+        # like task_failures, across adaptive coalescing)
+        self.task_attempts: List[int] = [0] * self.partitions
+        # partition -> in-flight speculative duplicate of a straggling task
+        self.speculative_tasks: Dict[int, TaskInfo] = {}
+        # completed-attempt durations (s), the speculation-policy baseline
+        self.durations: List[float] = []
+        # append-only per-attempt history for /api/job/<id> (survives
+        # rollbacks: entries carry their stage_attempt epoch)
+        self.attempt_log: List[dict] = []
+        self._attempt_index: Dict[Tuple[int, int, int], dict] = {}
         # map partition -> (executor_id, [ShuffleWritePartition])
         self.outputs: Dict[int, Tuple[str, List[ShuffleWritePartition]]] = {}
+
+    # --- attempt bookkeeping ---------------------------------------------
+    def new_attempt(self, partition: int, executor_id: str,
+                    speculative: bool = False) -> TaskInfo:
+        """Mint the next attempt id for ``partition`` and log it."""
+        import time as _time
+
+        attempt = self.task_attempts[partition]
+        self.task_attempts[partition] = attempt + 1
+        info = TaskInfo(partition, executor_id, "running", attempt=attempt,
+                        speculative=speculative,
+                        started_at=_time.monotonic())
+        entry = {"partition": partition, "attempt": attempt,
+                 "stage_attempt": self.stage_attempt,
+                 "executor_id": executor_id, "speculative": speculative,
+                 "state": "running", "duration_s": None}
+        self.attempt_log.append(entry)
+        self._attempt_index[(partition, attempt, self.stage_attempt)] = entry
+        return info
+
+    def close_attempt(self, st: TaskStatus, state: str) -> None:
+        """Record an attempt's terminal state + duration in the log."""
+        import time as _time
+
+        entry = self._attempt_index.get(
+            (st.task.partition, st.task.task_attempt, st.task.stage_attempt))
+        if entry is None or entry["state"] != "running":
+            return
+        entry["state"] = state
+        for info in (self.task_infos[st.task.partition],
+                     self.speculative_tasks.get(st.task.partition)):
+            if info is not None and info.attempt == st.task.task_attempt \
+                    and info.started_at:
+                entry["duration_s"] = round(_time.monotonic() - info.started_at, 3)
+                break
 
     def operator_metrics(self) -> Dict[str, Dict[str, float]]:
         """Fold completed tasks' per-operator metrics into a
@@ -164,7 +217,7 @@ class ExecutionStage:
                 locs.setdefault(w.output_partition, []).append(
                     PartitionLocation(executor_id, map_part, w.output_partition,
                                       w.path, w.num_rows, w.num_bytes,
-                                      host, port))
+                                      host, port, checksum=w.checksum))
         return locs
 
     # --- adaptive exchange coalescing ------------------------------------
@@ -209,9 +262,9 @@ class ExecutionStage:
         self._orig_partitions = self.partitions
         self.partitions = 1
         self.task_infos = [None]
-        # task_failures keeps its planned length: only index 0 is touched
-        # while coalesced, and rollback restores the full partition count
-        # with per-partition budgets intact
+        # task_failures/task_attempts keep their planned length: only
+        # index 0 is touched while coalesced, and rollback restores the
+        # full partition count with per-partition budgets intact
 
     # --- transitions -----------------------------------------------------
     def rollback(self, count_failure: bool = True) -> None:
@@ -233,6 +286,7 @@ class ExecutionStage:
             self.partitions = self._orig_partitions
             self._orig_partitions = None
         self.task_infos = [None] * self.partitions
+        self.speculative_tasks.clear()
         self.outputs.clear()
         self.stage_attempt += 1
         if count_failure:
@@ -250,6 +304,7 @@ class ExecutionStage:
                 continue  # already re-opened; a re-run may be in flight
             self.outputs.pop(p, None)
             self.task_infos[p] = None
+            self.speculative_tasks.pop(p, None)
             reopened = True
         if reopened and self.state == SUCCESSFUL:
             self.state = RUNNING
@@ -331,15 +386,42 @@ class ExecutionGraph:
             if not pending:
                 continue
             p = pending[0]
-            stage.task_infos[p] = TaskInfo(p, executor_id, "running")
-            tid = TaskId(self.job_id, stage.stage_id, p,
-                         task_attempt=stage.task_failures[p],
-                         stage_attempt=stage.stage_attempt)
-            return TaskDescription(tid, stage.resolved_plan,
-                                   task_internal_id=next(self._task_id_gen),
-                                   scalars=self.scalars,
-                                   trace=dict(self.trace))
+            info = stage.new_attempt(p, executor_id)
+            stage.task_infos[p] = info
+            return self._describe(stage, info)
         return None
+
+    def _describe(self, stage: ExecutionStage, info: TaskInfo) -> TaskDescription:
+        tid = TaskId(self.job_id, stage.stage_id, info.partition,
+                     task_attempt=info.attempt,
+                     stage_attempt=stage.stage_attempt,
+                     speculative=info.speculative)
+        return TaskDescription(tid, stage.resolved_plan,
+                               task_internal_id=next(self._task_id_gen),
+                               scalars=self.scalars,
+                               trace=dict(self.trace))
+
+    def launch_speculative(self, stage_id: int, partition: int,
+                           executor_id: str) -> Optional[TaskDescription]:
+        """Mint a speculative duplicate attempt for a straggling running
+        task, to be placed on ``executor_id`` (the caller guarantees it is
+        a *different* executor than the original's).  Returns None when the
+        partition is no longer a candidate (finished, rolled back, or
+        already speculated) — the monitor races task completion by design."""
+        if self.status != "running":
+            return None
+        stage = self.stages.get(stage_id)
+        if stage is None or stage.state != RUNNING:
+            return None
+        if partition in stage.speculative_tasks:
+            return None
+        primary = stage.task_infos[partition]
+        if primary is None or primary.state != "running" \
+                or primary.executor_id == executor_id:
+            return None
+        info = stage.new_attempt(partition, executor_id, speculative=True)
+        stage.speculative_tasks[partition] = info
+        return self._describe(stage, info)
 
     # --- status intake ---------------------------------------------------
     def update_task_status(self, statuses: List[TaskStatus]) -> List[Tuple[str, object]]:
@@ -348,6 +430,15 @@ class ExecutionGraph:
         Parity: reference execution_graph.rs:270-657."""
         events: List[Tuple[str, object]] = []
         if self.status != "running":
+            # a terminal job still absorbs attempt BOOKKEEPING: a cancelled
+            # speculative loser often reports "killed" after the job has
+            # already succeeded, and without this its audit-log entry would
+            # read "running" forever
+            for st in statuses:
+                stage = self.stages.get(st.task.stage_id)
+                if stage is not None \
+                        and st.task.stage_attempt == stage.stage_attempt:
+                    stage.close_attempt(st, st.state)
             return events
         for st in statuses:
             stage = self.stages.get(st.task.stage_id)
@@ -361,18 +452,58 @@ class ExecutionGraph:
                 self._on_task_success(stage, st, events)
             elif st.state == "failed":
                 self._on_task_failed(stage, st, events)
-            # 'killed' -> nothing: job-level cancel already recorded
+            elif st.state == "killed":
+                # job-level cancel, or a cancelled speculative loser: free
+                # the duplicate's slot bookkeeping, nothing else to do
+                stage.close_attempt(st, "killed")
+                spec = stage.speculative_tasks.get(st.task.partition)
+                if spec is not None and spec.attempt == st.task.task_attempt:
+                    stage.speculative_tasks.pop(st.task.partition, None)
             if self.status != "running":
                 break
         return events
 
     def _on_task_success(self, stage: ExecutionStage, st: TaskStatus,
                          events: List[Tuple[str, object]]) -> None:
+        import time as _time
+
         p = st.task.partition
         info = stage.task_infos[p]
+        spec = stage.speculative_tasks.get(p)
+        att = st.task.task_attempt
+        stage.close_attempt(st, "success")
         if info is not None and info.state == "success":
-            return  # duplicate
-        stage.task_infos[p] = TaskInfo(p, st.executor_id, "success", st)
+            # first-result-wins dedup: the loser of a speculative race (or
+            # any duplicate report) finished after the winner — its outputs
+            # are ignored, the recorded ones stay authoritative
+            if spec is not None and spec.attempt == att:
+                stage.speculative_tasks.pop(p, None)
+            return
+        # which in-flight attempt does this status belong to?
+        winner: Optional[TaskInfo] = None
+        if info is not None and info.state == "running" and info.attempt == att:
+            winner = info
+        elif spec is not None and spec.attempt == att:
+            winner = spec
+            events.append(("speculative_win", (stage.stage_id, p)))
+        # cancel the losing duplicate (first success wins either way)
+        loser = spec if winner is info else info
+        if spec is not None and loser is not None and loser is not winner \
+                and loser.state == "running":
+            events.append(("cancel_task",
+                           (loser.executor_id,
+                            TaskId(self.job_id, stage.stage_id, p,
+                                   task_attempt=loser.attempt,
+                                   stage_attempt=stage.stage_attempt,
+                                   speculative=loser.speculative))))
+        stage.speculative_tasks.pop(p, None)
+        started = winner.started_at if winner is not None else 0.0
+        if started:
+            stage.durations.append(_time.monotonic() - started)
+        stage.task_infos[p] = TaskInfo(p, st.executor_id, "success", st,
+                                       attempt=att,
+                                       speculative=st.task.speculative,
+                                       started_at=started)
         stage.outputs[p] = (st.executor_id, list(st.shuffle_writes))
         if stage.all_successful() and stage.state == RUNNING:
             stage.state = SUCCESSFUL
@@ -386,7 +517,19 @@ class ExecutionGraph:
     def _on_task_failed(self, stage: ExecutionStage, st: TaskStatus,
                         events: List[Tuple[str, object]]) -> None:
         p = st.task.partition
+        info = stage.task_infos[p]
+        spec = stage.speculative_tasks.get(p)
+        att = st.task.task_attempt
         reason = st.failure or FailedReason(EXECUTION_ERROR, "unknown failure")
+        stage.close_attempt(st, "killed" if reason.kind == TASK_KILLED
+                            else "failed")
+
+        # a cancelled/crashed loser must never disturb a completed
+        # partition: the winner's outputs are already recorded
+        if info is not None and info.state == "success":
+            if spec is not None and spec.attempt == att:
+                stage.speculative_tasks.pop(p, None)
+            return
 
         if reason.kind == EXECUTION_ERROR:
             self._fail_job(f"task {st.task.job_id}/{stage.stage_id}/{p}: "
@@ -394,6 +537,8 @@ class ExecutionGraph:
             return
 
         if reason.kind == TASK_KILLED:
+            if spec is not None and spec.attempt == att:
+                stage.speculative_tasks.pop(p, None)
             return
 
         if reason.kind == FETCH_PARTITION_ERROR:
@@ -401,6 +546,11 @@ class ExecutionGraph:
             return
 
         # retryable (IOError / ExecutorLost / ResultLost)
+        if spec is not None and spec.attempt == att:
+            # the speculative duplicate died while the original is still
+            # running: just drop the duplicate — no budget charge, no reset
+            stage.speculative_tasks.pop(p, None)
+            return
         if reason.count_to_failures:
             stage.task_failures[p] += 1
         if stage.task_failures[p] >= TASK_MAX_FAILURES:
@@ -408,7 +558,12 @@ class ExecutionGraph:
                 f"task {st.task.job_id}/{stage.stage_id}/{p} failed "
                 f"{TASK_MAX_FAILURES} times: {reason.message}", events)
             return
-        stage.task_infos[p] = None  # back to pending
+        if spec is not None:
+            # the original died but a speculative duplicate is in flight:
+            # promote it to primary instead of launching a third attempt
+            stage.task_infos[p] = stage.speculative_tasks.pop(p)
+        else:
+            stage.task_infos[p] = None  # back to pending
 
     def _on_fetch_failure(self, stage: ExecutionStage, reason: FailedReason,
                           events: List[Tuple[str, object]]) -> None:
@@ -444,14 +599,19 @@ class ExecutionGraph:
         fault."""
         if self.status != "running":
             return
-        # 1. forget running tasks on the executor
+        # 1. forget running tasks on the executor (a surviving speculative
+        #    duplicate is promoted to primary rather than relaunching)
         for stage in self.stages.values():
             if stage.state != RUNNING:
                 continue
+            for p, spec in list(stage.speculative_tasks.items()):
+                if spec.executor_id == executor_id:
+                    stage.speculative_tasks.pop(p, None)
             for p, info in enumerate(stage.task_infos):
                 if info is not None and info.state == "running" \
                         and info.executor_id == executor_id:
-                    stage.task_infos[p] = None
+                    spec = stage.speculative_tasks.pop(p, None)
+                    stage.task_infos[p] = spec
         # 2. re-open map partitions whose outputs are gone
         poisoned: List[int] = []
         for stage in self.stages.values():
@@ -482,7 +642,8 @@ class ExecutionGraph:
         self.status = "cancelled"
 
     def running_tasks(self) -> List[Tuple[int, int, str]]:
-        """(stage_id, partition, executor_id) of in-flight tasks."""
+        """(stage_id, partition, executor_id) of in-flight tasks,
+        speculative duplicates included."""
         out = []
         for stage in self.stages.values():
             if stage.state != RUNNING:
@@ -490,6 +651,8 @@ class ExecutionGraph:
             for info in stage.task_infos:
                 if info is not None and info.state == "running":
                     out.append((stage.stage_id, info.partition, info.executor_id))
+            for info in stage.speculative_tasks.values():
+                out.append((stage.stage_id, info.partition, info.executor_id))
         return out
 
     def __repr__(self):

@@ -41,10 +41,17 @@ def graph_to_dot(graph: ExecutionGraph) -> str:
     for sid in sorted(graph.stages):
         stage = graph.stages[sid]
         done = sum(1 for t in stage.task_infos if t and t.state == "success")
+        # attempt history summary: total launches and how many were
+        # speculative duplicates (straggler mitigation audit trail)
+        launches = len(stage.attempt_log)
+        spec = sum(1 for e in stage.attempt_log if e["speculative"])
+        extra = f" {launches} launches" if launches > stage.partitions else ""
+        if spec:
+            extra += f" ({spec} speculative)"
         lines.append(f"  subgraph cluster_{sid} {{")
         lines.append(f'    label="stage {sid} [{stage.state}] '
                      f'{done}/{stage.partitions} tasks '
-                     f'attempt {stage.stage_attempt}";')
+                     f'attempt {stage.stage_attempt}{extra}";')
         plan = stage.resolved_plan or stage.plan
         counter = [0]
         # per-operator metrics keyed by the executor-side walk's path key

@@ -49,6 +49,11 @@ class SchedulerMetricsCollector:
     # executor quarantine (scheduler/quarantine.py)
     def record_quarantined(self, executor_id: str) -> None: ...
     def set_quarantined_executors(self, value: int) -> None: ...
+    # speculative execution + shuffle integrity (scheduler/speculation.py,
+    # net/dataplane.py checksum verification)
+    def record_speculative_launched(self, job_id: str) -> None: ...
+    def record_speculative_win(self, job_id: str) -> None: ...
+    def record_integrity_failure(self, executor_id: str) -> None: ...
     def gather(self) -> str:
         return ""
 
@@ -77,6 +82,9 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                                          30.0, 120.0])
         self.quarantined_total = 0
         self.quarantined_executors = 0
+        self.speculative_launched = 0
+        self.speculative_wins = 0
+        self.integrity_failures = 0
 
     def record_submitted(self, job_id, queued_at_ms, submitted_at_ms):
         with self._lock:
@@ -123,6 +131,18 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.quarantined_executors = value
 
+    def record_speculative_launched(self, job_id):
+        with self._lock:
+            self.speculative_launched += 1
+
+    def record_speculative_win(self, job_id):
+        with self._lock:
+            self.speculative_wins += 1
+
+    def record_integrity_failure(self, executor_id):
+        with self._lock:
+            self.integrity_failures += 1
+
     def gather(self) -> str:
         with self._lock:
             lines = []
@@ -143,6 +163,17 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             counter("executor_quarantined_total", self.quarantined_total,
                     "executors quarantined after consecutive retryable "
                     "task failures")
+            counter("speculative_tasks_launched_total",
+                    self.speculative_launched,
+                    "speculative duplicate attempts launched against "
+                    "straggling tasks")
+            counter("speculative_wins_total", self.speculative_wins,
+                    "partitions whose speculative attempt finished before "
+                    "the original")
+            counter("shuffle_integrity_failures_total",
+                    self.integrity_failures,
+                    "shuffle partitions that failed checksum/decode "
+                    "verification after fetch retries")
             lines.append("# HELP quarantined_executors executors currently "
                          "quarantined (no new offers)")
             lines.append("# TYPE quarantined_executors gauge")

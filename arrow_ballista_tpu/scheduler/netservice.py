@@ -152,6 +152,16 @@ class NetTaskLauncher(TaskLauncher):
         except Exception:  # noqa: BLE001 — best effort
             log.warning("cancel_tasks on %s failed", executor_id, exc_info=True)
 
+    def cancel_task(self, executor_id: str, task) -> None:
+        try:
+            host, port = self._addr(executor_id)
+            call_with_retry(host, port, "cancel_task",
+                            {"task": serde.taskid_to_obj(task)},
+                            policy=self.policy)
+        except Exception:  # noqa: BLE001 — best effort (the loser's late
+            # result is discarded by the graph's attempt bookkeeping anyway)
+            log.warning("cancel_task on %s failed", executor_id, exc_info=True)
+
     def clean_job_data(self, executor_id: str, job_id: str) -> None:
         host, port = self._addr(executor_id)
         call_with_retry(host, port, "remove_job_data", {"job_id": job_id},
@@ -179,6 +189,12 @@ class SchedulerNetService:
                 CLUSTER_EXECUTOR_TIMEOUT_S,
                 QUARANTINE_FAILURES,
                 QUARANTINE_PROBATION_S,
+                SPECULATION_ENABLED,
+                SPECULATION_INTERVAL_S,
+                SPECULATION_MAX_CONCURRENT,
+                SPECULATION_MIN_RUNTIME_S,
+                SPECULATION_MULTIPLIER,
+                SPECULATION_QUANTILE,
             )
 
             scheduler_config = SchedulerConfig(
@@ -187,7 +203,19 @@ class SchedulerNetService:
                 quarantine_failures=int(
                     self.config.get(QUARANTINE_FAILURES)),
                 quarantine_probation_s=float(
-                    self.config.get(QUARANTINE_PROBATION_S)))
+                    self.config.get(QUARANTINE_PROBATION_S)),
+                speculation_enabled=bool(
+                    self.config.get(SPECULATION_ENABLED)),
+                speculation_quantile=float(
+                    self.config.get(SPECULATION_QUANTILE)),
+                speculation_multiplier=float(
+                    self.config.get(SPECULATION_MULTIPLIER)),
+                speculation_min_runtime_s=float(
+                    self.config.get(SPECULATION_MIN_RUNTIME_S)),
+                speculation_max_concurrent=int(
+                    self.config.get(SPECULATION_MAX_CONCURRENT)),
+                speculation_interval_s=float(
+                    self.config.get(SPECULATION_INTERVAL_S)))
         self.catalog = SchemaCatalog()
         launcher = NetTaskLauncher(RetryPolicy.from_config(self.config))
         job_backend = None

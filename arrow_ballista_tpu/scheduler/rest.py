@@ -6,6 +6,7 @@ api/mod.rs:85-137 + handlers.rs):
     GET  /api/state            cluster summary
     GET  /api/executors        executor metadata + heartbeats
     GET  /api/jobs             job list with status + progress
+    GET  /api/job/<id>         job detail incl. per-task attempt history
     GET  /api/job/<id>/stages  per-stage task progress
     GET  /api/job/<id>/dot     graphviz of the execution graph
     PATCH /api/job/<id>        cancel (body ignored)
@@ -97,6 +98,12 @@ class RestApi:
             h._send(200, json.dumps(self._executors()))
         elif rest == ["jobs"]:
             h._send(200, json.dumps(self._jobs()))
+        elif len(rest) == 2 and rest[0] == "job":
+            job = self._job_detail(rest[1])
+            if job is None:
+                h._send(404, json.dumps({"error": "no such job"}))
+            else:
+                h._send(200, json.dumps(job))
         elif len(rest) == 3 and rest[0] == "job" and rest[2] == "stages":
             h._send(200, json.dumps(self._stages(rest[1])))
         elif len(rest) == 3 and rest[0] == "job" and rest[2] == "profile":
@@ -180,6 +187,36 @@ class RestApi:
                 entry["tasks_completed"] = done
                 entry["tasks_total"] = total
             out.append(entry)
+        return out
+
+    def _job_detail(self, job_id: str) -> Optional[dict]:
+        """Job status + the full per-task attempt history: every launch
+        (original, retry, or speculative duplicate) with its executor,
+        terminal state and duration — the audit trail for straggler
+        mitigation ("did speculation fire, and who won?")."""
+        st = self.server.jobs.get_status(job_id)
+        if st is None:
+            return None
+        out = {"job_id": job_id, "state": st.state, "error": st.error}
+        graph = self.server.jobs.get_graph(job_id)
+        if graph is None:
+            return out
+        stages = {}
+        for sid in sorted(graph.stages):
+            s = graph.stages[sid]
+            stages[str(sid)] = {
+                "state": s.state,
+                "stage_attempt": s.stage_attempt,
+                "attempts": [
+                    {"partition": e["partition"], "attempt": e["attempt"],
+                     "stage_attempt": e["stage_attempt"],
+                     "executor_id": e["executor_id"],
+                     "speculative": e["speculative"], "state": e["state"],
+                     "duration_s": (round(e["duration_s"], 3)
+                                    if e["duration_s"] is not None else None)}
+                    for e in s.attempt_log],
+            }
+        out["stages"] = stages
         return out
 
     def _stages(self, job_id: str) -> list:

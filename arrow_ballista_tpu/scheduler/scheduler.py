@@ -31,12 +31,15 @@ from .cluster import ClusterState, JobState
 from .event_loop import EventLoop
 from .execution_graph import ExecutionGraph
 from .quarantine import ExecutorQuarantine
+from .speculation import SpeculationPolicy, find_candidates
 from .types import (
+    FETCH_PARTITION_ERROR,
     ExecutorHeartbeat,
     ExecutorMetadata,
     ExecutorReservation,
     JobStatus,
     TaskDescription,
+    TaskId,
     TaskStatus,
 )
 
@@ -57,6 +60,10 @@ class TaskLauncher:
 
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
         """Best-effort cancellation of a job's running tasks."""
+
+    def cancel_task(self, executor_id: str, task: TaskId) -> None:
+        """Best-effort cancellation of ONE running attempt — used to reap
+        the losing duplicate once a speculative race has a winner."""
 
     def clean_job_data(self, executor_id: str, job_id: str) -> None:
         """Best-effort removal of a finished job's shuffle data on one
@@ -106,6 +113,13 @@ class Offer:
 
 
 @dataclasses.dataclass
+class SpeculationTick:
+    """Periodic straggler scan: posted by the speculation monitor thread so
+    all graph reads/mutations stay on the event loop (the thread itself
+    never touches a graph)."""
+
+
+@dataclasses.dataclass
 class PollWork:
     """Pull-mode work request (reference SchedulerGrpc.poll_work,
     grpc.rs:57-136): absorb statuses, then fill the executor's free slots.
@@ -125,11 +139,23 @@ class SchedulerConfig:
                  policy: str = "push",
                  job_data_cleanup_delay_s: float = 30.0,
                  quarantine_failures: Optional[int] = None,
-                 quarantine_probation_s: Optional[float] = None):
+                 quarantine_probation_s: Optional[float] = None,
+                 speculation_enabled: Optional[bool] = None,
+                 speculation_quantile: Optional[float] = None,
+                 speculation_multiplier: Optional[float] = None,
+                 speculation_min_runtime_s: Optional[float] = None,
+                 speculation_max_concurrent: Optional[int] = None,
+                 speculation_interval_s: Optional[float] = None):
         from ..utils.config import (BallistaConfig,
                                     CLUSTER_EXECUTOR_TIMEOUT_S,
                                     QUARANTINE_FAILURES,
-                                    QUARANTINE_PROBATION_S)
+                                    QUARANTINE_PROBATION_S,
+                                    SPECULATION_ENABLED,
+                                    SPECULATION_INTERVAL_S,
+                                    SPECULATION_MAX_CONCURRENT,
+                                    SPECULATION_MIN_RUNTIME_S,
+                                    SPECULATION_MULTIPLIER,
+                                    SPECULATION_QUANTILE)
 
         assert policy in ("push", "pull")  # reference TaskSchedulingPolicy
         defaults = BallistaConfig()
@@ -146,6 +172,27 @@ class SchedulerConfig:
         self.quarantine_probation_s = float(
             quarantine_probation_s if quarantine_probation_s is not None
             else defaults.get(QUARANTINE_PROBATION_S))
+        # straggler mitigation (scheduler/speculation.py): knobs default
+        # from the ballista.speculation.* config-registry entries
+        self.speculation = SpeculationPolicy(
+            enabled=bool(speculation_enabled
+                         if speculation_enabled is not None
+                         else defaults.get(SPECULATION_ENABLED)),
+            quantile=float(speculation_quantile
+                           if speculation_quantile is not None
+                           else defaults.get(SPECULATION_QUANTILE)),
+            multiplier=float(speculation_multiplier
+                             if speculation_multiplier is not None
+                             else defaults.get(SPECULATION_MULTIPLIER)),
+            min_runtime_s=float(speculation_min_runtime_s
+                                if speculation_min_runtime_s is not None
+                                else defaults.get(SPECULATION_MIN_RUNTIME_S)),
+            max_concurrent=int(speculation_max_concurrent
+                               if speculation_max_concurrent is not None
+                               else defaults.get(SPECULATION_MAX_CONCURRENT)),
+            interval_s=float(speculation_interval_s
+                             if speculation_interval_s is not None
+                             else defaults.get(SPECULATION_INTERVAL_S)))
         self.reaper_interval_s = reaper_interval_s
         self.event_buffer_size = event_buffer_size
         self.policy = policy
@@ -196,6 +243,7 @@ class SchedulerServer:
         self._launch_pool = ThreadPoolExecutor(max_workers=8,
                                                thread_name_prefix="launch")
         self._reaper: Optional[threading.Thread] = None
+        self._spec_monitor: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self._cleanup_timers: Dict[str, threading.Timer] = {}
         self._cleanup_lock = threading.Lock()
@@ -223,6 +271,11 @@ class SchedulerServer:
             self._reaper = threading.Thread(target=self._reap_loop,
                                             name="executor-reaper", daemon=True)
             self._reaper.start()
+        if self.config.speculation.enabled:
+            self._spec_monitor = threading.Thread(
+                target=self._speculation_loop, name="speculation-monitor",
+                daemon=True)
+            self._spec_monitor.start()
 
     def shutdown(self) -> None:
         # order matters: stop the event loop BEFORE closing the launch pool,
@@ -380,6 +433,8 @@ class SchedulerServer:
             self._on_job_cancel(event)
         elif isinstance(event, Offer):
             self._offer()
+        elif isinstance(event, SpeculationTick):
+            self._on_speculation_tick()
         elif isinstance(event, PollWork):
             self._on_poll_work(event)
         else:
@@ -524,6 +579,13 @@ class SchedulerServer:
             old.cancel()
         timer.start()
 
+    def _cancel_one(self, executor_id: str, task_id: TaskId) -> None:
+        try:
+            self.launcher.cancel_task(executor_id, task_id)
+        except Exception:  # noqa: BLE001 — best effort
+            log.warning("cancel_task on %s failed for %s", executor_id,
+                        task_id, exc_info=True)
+
     def _cancel_running(self, graph: ExecutionGraph) -> None:
         executors = {eid for _, _, eid in graph.running_tasks()}
         for eid in executors:
@@ -622,6 +684,21 @@ class SchedulerServer:
             if st.state == "success":
                 self.quarantine.record_success(eid)
             elif (st.state == "failed" and st.failure is not None
+                  and st.failure.kind == FETCH_PARTITION_ERROR
+                  and "integrity check failed" in st.failure.message):
+                # a checksum/decode failure that survived the fetcher's
+                # in-loop retries: the PRODUCER's data is damaged — count
+                # the producing executor, not the reporting fetcher, so a
+                # host serving corrupt partitions gets quarantined
+                self.metrics.record_integrity_failure(st.failure.executor_id)
+                if st.failure.executor_id and self.quarantine.record_failure(
+                        st.failure.executor_id):
+                    log.warning(
+                        "executor %s quarantined: served corrupt shuffle "
+                        "data (%s)", st.failure.executor_id,
+                        st.failure.message)
+                    self.metrics.record_quarantined(st.failure.executor_id)
+            elif (st.state == "failed" and st.failure is not None
                   and st.failure.retryable):
                 if self.quarantine.record_failure(eid):
                     log.warning(
@@ -636,7 +713,18 @@ class SchedulerServer:
                              sts: List[TaskStatus]) -> None:
         checkpointed = False
         for kind, payload in graph.update_task_status(sts):
-            if kind == "job_successful":
+            if kind == "speculative_win":
+                stage_id, partition = payload
+                log.info("speculative attempt won: job %s stage %d "
+                         "partition %d", job_id, stage_id, partition)
+                self.metrics.record_speculative_win(job_id)
+            elif kind == "cancel_task":
+                # first result won the race: reap the losing duplicate so
+                # it stops burning a slot (its late status is discarded by
+                # the graph's attempt bookkeeping either way)
+                executor_id, task_id = payload
+                self._submit_work(self._cancel_one, executor_id, task_id)
+            elif kind == "job_successful":
                 # terminal state must be durable BEFORE waiters wake:
                 # set_status releases wait_for_job, and a restarted
                 # scheduler must never see a completed job as running
@@ -714,6 +802,43 @@ class SchedulerServer:
             log.exception("launch on %s failed", executor_id)
             self.cluster.free_slots(executor_id, len(tasks))
             self._event_loop.post(ExecutorLost(executor_id, f"launch failed: {e}"))
+
+    # --- speculative execution (straggler mitigation) --------------------
+    def _speculation_loop(self) -> None:
+        """Monitor thread: periodically posts a tick; the straggler scan
+        itself runs on the event loop (single-threaded graph access)."""
+        while not self._stopped.wait(self.config.speculation.interval_s):
+            self._event_loop.post(SpeculationTick())
+
+    def _on_speculation_tick(self) -> None:
+        policy = self.config.speculation
+        alive = set(self.quarantine.filter(
+            self.cluster.alive_executors(self.config.executor_timeout_s)))
+        if len(alive) < 2:
+            return  # a duplicate must land on a DIFFERENT executor
+        now = time.monotonic()
+        for graph in self.jobs.active_graphs():
+            for stage_id, partition, running_on in find_candidates(
+                    graph, now, policy):
+                pool = sorted(alive - {running_on})
+                if not pool:
+                    continue
+                reservations = self.cluster.reserve_slots(1, pool)
+                if not reservations:
+                    continue
+                executor_id = reservations[0].executor_id
+                task = graph.launch_speculative(stage_id, partition,
+                                                executor_id)
+                if task is None:
+                    self.cluster.cancel_reservations(reservations)
+                    continue
+                log.info(
+                    "speculative attempt %d: job %s stage %d partition %d "
+                    "on %s (original still running on %s)",
+                    task.task.task_attempt, graph.job_id, stage_id,
+                    partition, executor_id, running_on)
+                self.metrics.record_speculative_launched(graph.job_id)
+                self._submit_work(self._launch, executor_id, [task])
 
     # --- failure detection ----------------------------------------------
     def _reap_loop(self) -> None:

@@ -1,0 +1,86 @@
+"""Speculative-execution policy: when is a running task a straggler?
+
+Spark-heritage engines treat speculative re-execution as table stakes: on
+a TPU pod one slow host (thermal throttle, noisy neighbor, dying NIC)
+stalls a whole stage, and heartbeats cannot tell "slow" from "healthy".
+The policy here mirrors Spark's `spark.speculation.*` family: compare
+every running task's age against a quantile of the *same stage's
+completed* attempt durations scaled by a multiplier, floor the cutoff at
+a minimum runtime, and bound concurrent duplicates per stage.
+
+Pure functions over graph state — the scheduler's monitor thread posts a
+tick into the event loop and the handler calls :func:`find_candidates`
+there, so all graph reads happen single-threaded (no locks, no sleeps).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class SpeculationPolicy:
+    """Knobs from the ``ballista.speculation.*`` config keys."""
+
+    enabled: bool = False
+    quantile: float = 0.75
+    multiplier: float = 1.5
+    min_runtime_s: float = 5.0
+    max_concurrent: int = 2
+    interval_s: float = 1.0
+
+
+def speculation_cutoff_s(durations: Sequence[float], quantile: float,
+                         multiplier: float,
+                         min_runtime_s: float) -> Optional[float]:
+    """Age (seconds) beyond which a running task counts as a straggler,
+    or None when the stage has no completed attempts to compare against
+    (speculating with no baseline would duplicate every first wave).
+
+    The quantile is taken over completed-attempt durations with the
+    nearest-rank method (q=0.75 over 4 samples -> 3rd smallest); the
+    cutoff is ``max(quantile_duration * multiplier, min_runtime_s)``.
+    """
+    if not durations:
+        return None
+    xs = sorted(durations)
+    q = min(max(float(quantile), 0.0), 1.0)
+    rank = max(1, int(math.ceil(q * len(xs))))
+    return max(xs[rank - 1] * float(multiplier), float(min_runtime_s))
+
+
+def find_candidates(graph, now: float,
+                    policy: SpeculationPolicy) -> List[Tuple[int, int, str]]:
+    """(stage_id, partition, running_executor_id) of tasks whose age
+    exceeds their stage's cutoff and that have no duplicate in flight.
+    ``now`` is a ``time.monotonic()`` reading (TaskInfo.started_at base).
+    """
+    out: List[Tuple[int, int, str]] = []
+    if graph.status != "running":
+        return out
+    for stage in graph.stages.values():
+        if stage.state != "running":
+            continue
+        budget = policy.max_concurrent - len(stage.speculative_tasks)
+        if budget <= 0:
+            continue
+        cutoff = speculation_cutoff_s(stage.durations, policy.quantile,
+                                      policy.multiplier, policy.min_runtime_s)
+        if cutoff is None:
+            continue
+        # oldest stragglers first, so a tight max_concurrent budget goes to
+        # the tasks most likely to be genuinely stuck
+        stragglers = []
+        for p, info in enumerate(stage.task_infos):
+            if info is None or info.state != "running" or not info.started_at:
+                continue
+            if p in stage.speculative_tasks:
+                continue
+            age = now - info.started_at
+            if age > cutoff:
+                stragglers.append((age, p, info.executor_id))
+        stragglers.sort(reverse=True)
+        for _, p, executor_id in stragglers[:budget]:
+            out.append((stage.stage_id, p, executor_id))
+    return out

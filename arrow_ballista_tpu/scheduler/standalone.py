@@ -47,6 +47,11 @@ class InProcessTaskLauncher(TaskLauncher):
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
         self.executors[executor_id].cancel_job_tasks(job_id)
 
+    def cancel_task(self, executor_id: str, task) -> None:
+        ex = self.executors.get(executor_id)
+        if ex is not None:
+            ex.cancel_task(task)
+
     def clean_job_data(self, executor_id: str, job_id: str) -> None:
         from ..executor.executor import remove_job_data
 
@@ -75,6 +80,26 @@ class StandaloneCluster:
         from ..obs import JobObservability
 
         self.launcher = InProcessTaskLauncher()
+        if scheduler_config is None:
+            # honour the session's ballista.speculation.* keys (remote
+            # deployments do the same via SchedulerNetService)
+            from ..utils.config import (SPECULATION_ENABLED,
+                                        SPECULATION_INTERVAL_S,
+                                        SPECULATION_MAX_CONCURRENT,
+                                        SPECULATION_MIN_RUNTIME_S,
+                                        SPECULATION_MULTIPLIER,
+                                        SPECULATION_QUANTILE)
+
+            scheduler_config = SchedulerConfig(
+                speculation_enabled=bool(self.config.get(SPECULATION_ENABLED)),
+                speculation_quantile=float(self.config.get(SPECULATION_QUANTILE)),
+                speculation_multiplier=float(self.config.get(SPECULATION_MULTIPLIER)),
+                speculation_min_runtime_s=float(
+                    self.config.get(SPECULATION_MIN_RUNTIME_S)),
+                speculation_max_concurrent=int(
+                    self.config.get(SPECULATION_MAX_CONCURRENT)),
+                speculation_interval_s=float(
+                    self.config.get(SPECULATION_INTERVAL_S)))
         self.scheduler = SchedulerServer(
             self.launcher, scheduler_config,
             observability=JobObservability.from_config(self.config))

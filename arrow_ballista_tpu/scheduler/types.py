@@ -29,8 +29,15 @@ class TaskId:
     job_id: str
     stage_id: int
     partition: int
+    # monotonically increasing per (stage_attempt, partition): every launch
+    # — retry or speculative duplicate — gets a fresh attempt id, so the
+    # scheduler can tell a winner's status from a loser's (reference
+    # execution_graph.rs task-attempt bookkeeping)
     task_attempt: int = 0
     stage_attempt: int = 0
+    # True for a speculative duplicate launched against a straggling
+    # original attempt; first success wins either way
+    speculative: bool = False
 
 
 @dataclasses.dataclass

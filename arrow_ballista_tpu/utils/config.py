@@ -64,6 +64,15 @@ QUARANTINE_FAILURES = "ballista.scheduler.quarantine.failures"
 QUARANTINE_PROBATION_S = "ballista.scheduler.quarantine.probation.seconds"
 # deterministic fault injection (arrow_ballista_tpu/faults/)
 FAULTS_PLAN = "ballista.faults.plan"
+# speculative execution (scheduler/speculation.py + execution_graph.py)
+SPECULATION_ENABLED = "ballista.speculation.enabled"
+SPECULATION_QUANTILE = "ballista.speculation.quantile"
+SPECULATION_MULTIPLIER = "ballista.speculation.multiplier"
+SPECULATION_MIN_RUNTIME_S = "ballista.speculation.min_runtime.seconds"
+SPECULATION_MAX_CONCURRENT = "ballista.speculation.max_concurrent"
+SPECULATION_INTERVAL_S = "ballista.speculation.interval.seconds"
+# shuffle partition integrity (ops/shuffle.py + net/dataplane.py)
+SHUFFLE_INTEGRITY = "ballista.shuffle.integrity.verify"
 
 
 @dataclasses.dataclass
@@ -254,6 +263,34 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "'@/path/to/plan.json' (see arrow_ballista_tpu/faults/ "
                     "and docs/user-guide/fault-tolerance.md); empty = "
                     "disabled, all failpoint sites are no-ops"),
+        ConfigEntry(SPECULATION_ENABLED, False, _parse_bool,
+                    "speculative execution: launch a duplicate attempt of a "
+                    "straggling task on a different executor; first "
+                    "successful attempt wins, the loser is cancelled and "
+                    "its outputs ignored (results are identical either "
+                    "way).  False = one attempt at a time, today's "
+                    "behavior"),
+        ConfigEntry(SPECULATION_QUANTILE, 0.75, float,
+                    "duration quantile (0..1] of a stage's *completed* "
+                    "attempts used as the straggler baseline"),
+        ConfigEntry(SPECULATION_MULTIPLIER, 1.5, float,
+                    "a running task is speculatable once its age exceeds "
+                    "multiplier x the baseline quantile duration"),
+        ConfigEntry(SPECULATION_MIN_RUNTIME_S, 5.0, float,
+                    "never speculate a task younger than this, regardless "
+                    "of the quantile math (protects short stages from "
+                    "duplicate launches)"),
+        ConfigEntry(SPECULATION_MAX_CONCURRENT, 2, int,
+                    "max concurrent speculative attempts per stage"),
+        ConfigEntry(SPECULATION_INTERVAL_S, 1.0, float,
+                    "seconds between speculation-monitor scans of running "
+                    "tasks"),
+        ConfigEntry(SHUFFLE_INTEGRITY, True, _parse_bool,
+                    "verify the producer-recorded CRC-32 checksum of every "
+                    "remotely fetched shuffle partition before "
+                    "deserialization; a mismatch raises a retryable "
+                    "IntegrityError (re-fetch, then lineage rollback) "
+                    "instead of decoding corrupt bytes"),
     ]
 }
 

@@ -96,6 +96,31 @@ class FetchFailedError(BallistaError):
         )
 
 
+class IntegrityError(BallistaError):
+    """Payload failed an integrity check (checksum mismatch or an
+    undecodable frame) at a named site — corruption detected *before* bad
+    bytes turn into wrong results or an opaque decode traceback.
+
+    Retryable: a re-fetch usually heals transient wire corruption; when it
+    doesn't, the caller escalates to ``FetchFailedError`` so shuffle
+    lineage recovery re-runs the producer.  Pickle-safe (crosses the
+    executor -> scheduler boundary inside failure messages).
+    """
+
+    retryable = True
+
+    def __init__(self, site: str, detail: str = "", **context):
+        super().__init__(site, detail, context)
+        self.site = site
+        self.detail = detail
+        self.context = context
+
+    def __str__(self):
+        ctx = " ".join(f"{k}={v}" for k, v in sorted(self.context.items()))
+        return f"integrity check failed at {self.site}: {self.detail}" + (
+            f" [{ctx}]" if ctx else "")
+
+
 class ExecutorKilled(BallistaError):
     """The ``faults`` kill action is abruptly stopping this executor.
 

@@ -7,12 +7,16 @@ actions; the plan's event log makes the injection schedule itself an
 assertable artifact, so the same seed + same plan must reproduce the same
 faults (the reproducibility test below).
 
-The four ISSUE scenarios:
+The ISSUE scenarios:
 
 1. executor killed mid-stage -> job completes, results identical,
 2. shuffle fetch failure -> lineage rollback re-runs the producer,
 3. status reports dropped -> reporter loop redeems them,
-4. scheduler restarts mid-job -> recovers the job from persistence.
+4. scheduler restarts mid-job -> recovers the job from persistence,
+5. straggling task -> speculative duplicate wins, loser cancelled,
+   results bit-identical (and the disabled-knob parity run),
+6. corrupt shuffle payload -> checksum verify -> re-fetch -> producer
+   re-run, never silently-wrong results.
 
 Plus: executor quarantine after consecutive failures (observable via
 metrics + REST), RPC deadline/backoff hardening, and unit coverage of the
@@ -641,3 +645,147 @@ def test_quarantine_bad_executor_job_still_completes():
     finally:
         api.stop()
         server.shutdown()
+
+
+# --------------------------------------------------------------------------
+# scenario 6: straggler -> speculative duplicate wins, results identical
+# --------------------------------------------------------------------------
+
+def _standalone_ctx(conf_extra=None, num_executors=2):
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    conf = {"ballista.shuffle.partitions": "4"}
+    conf.update(conf_extra or {})
+    ctx = BallistaContext.standalone(BallistaConfig(conf),
+                                     concurrent_tasks=2,
+                                     num_executors=num_executors)
+    rng = np.random.default_rng(23)
+    ctx.register_table("t", pa.table({
+        "g": pa.array(rng.integers(0, 7, 4000).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, 4000).astype(np.int64)),
+    }))
+    return ctx
+
+
+def test_straggler_speculative_duplicate_wins():
+    ctx = _standalone_ctx({
+        "ballista.speculation.enabled": "true",
+        "ballista.speculation.quantile": "0.5",
+        "ballista.speculation.multiplier": "1.2",
+        "ballista.speculation.min_runtime.seconds": "0.3",
+        "ballista.speculation.interval.seconds": "0.1",
+    })
+    try:
+        baseline = ctx.sql(SQL).to_pandas()
+
+        # the first stage-1 task executor-0 runs stalls for 2 s — far past
+        # the cutoff (min_runtime 0.3 s over a sub-ms baseline); the
+        # monitor must duplicate it onto executor-1, whose copy wins
+        plan = faults.FaultPlan.from_obj({"seed": 21, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 2000, "times": 1,
+            "match": {"stage_id": 1, "executor_id": "executor-0"}}]})
+        with faults.use_plan(plan):
+            got = ctx.sql(SQL).to_pandas()
+
+        assert plan.events, "the slow failpoint must actually have fired"
+        _frames_equal(got, baseline)
+
+        sched = ctx._standalone.scheduler
+        text = sched.metrics.gather()
+        assert "speculative_tasks_launched_total 1" in text
+        assert "speculative_wins_total 1" in text
+        job_id = list(sched.jobs._status)[-1]
+        stage = sched.jobs.get_graph(job_id).stages[1]
+        wins = [e for e in stage.attempt_log if e["state"] == "success"]
+        assert any(e["speculative"] for e in wins), \
+            "the duplicate attempt must be the recorded winner"
+        assert len([e for e in wins if e["partition"] ==
+                    next(e["partition"] for e in stage.attempt_log
+                         if e["speculative"])]) == 1, \
+            "first result wins exactly once per partition"
+        # the cancelled straggler eventually unwinds as killed (it wakes
+        # from the injected stall, sees the cancel, and reports in)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if any(e["state"] == "killed" for e in stage.attempt_log):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("cancelled straggler never unwound as killed: "
+                        f"{stage.attempt_log}")
+    finally:
+        ctx.shutdown()
+
+
+def test_speculation_disabled_parity():
+    """The same straggler with ``ballista.speculation.enabled`` unset (the
+    default): no monitor thread, no duplicate attempts, the job just waits
+    out the stall and completes with identical results."""
+    ctx = _standalone_ctx()
+    try:
+        baseline = ctx.sql(SQL).to_pandas()
+        plan = faults.FaultPlan.from_obj({"seed": 21, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 700, "times": 1,
+            "match": {"stage_id": 1, "executor_id": "executor-0"}}]})
+        with faults.use_plan(plan):
+            got = ctx.sql(SQL).to_pandas()
+        assert plan.events, "the slow failpoint must actually have fired"
+        _frames_equal(got, baseline)
+        sched = ctx._standalone.scheduler
+        assert sched._spec_monitor is None, "no monitor when disabled"
+        assert "speculative_tasks_launched_total 0" in sched.metrics.gather()
+        job_id = list(sched.jobs._status)[-1]
+        graph = sched.jobs.get_graph(job_id)
+        assert not any(e["speculative"] for s in graph.stages.values()
+                       for e in s.attempt_log)
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# scenario 7: corrupt shuffle payload -> verify -> re-fetch -> producer
+# re-run (never silently-wrong results)
+# --------------------------------------------------------------------------
+
+def test_corrupt_shuffle_payload_detected_and_recovered(tmp_path):
+    # same topology as the fetch-failure scenario: concurrent_tasks=1
+    # serializes the reducers so ONE logical fetch burns the whole corrupt
+    # budget across its in-loop retries, and high group cardinality forces
+    # a remote fetch.  Every corrupted payload must be caught by the CRC
+    # BEFORE deserialization; exhausting the retries escalates to lineage
+    # recovery, and the re-run producer's clean data yields exact results.
+    from arrow_ballista_tpu.net.dataplane import FETCH_RETRIES
+
+    sched, executors = _make_cluster(tmp_path, concurrent_tasks=1)
+    try:
+        c = _client(sched.port, n=20_000, groups=50_000, seed=19)
+        baseline = c.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 6, "rules": [{
+            "site": "shuffle.fetch.recv", "action": "corrupt",
+            "times": FETCH_RETRIES,
+            "match": {"stage_id": 1, "map_partition": 0}}]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        assert plan.schedule() == tuple(
+            ("shuffle.fetch.recv", 0, k, "corrupt")
+            for k in range(1, FETCH_RETRIES + 1)), \
+            "one logical fetch must absorb the whole corruption budget"
+        # the checksum caught it: integrity failures counted, the consumer
+        # rolled back, and the producer re-ran
+        text = sched.server.metrics.gather()
+        count = [int(float(line.split()[-1])) for line in text.splitlines()
+                 if line.startswith("shuffle_integrity_failures_total")]
+        assert count and count[0] >= 1, text
+        graphs = list(sched.server.jobs._graphs.values())
+        assert any(s.failures >= 1 for g in graphs
+                   for s in g.stages.values()), "no consumer rollback recorded"
+        assert any(s.stage_attempt >= 1 for g in graphs
+                   for s in g.stages.values()), "no producer re-run recorded"
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)

@@ -59,6 +59,8 @@ SAMPLES = {
     TaskId: [
         TaskId("job-1", 2, 7),
         TaskId("job-1", 2, 7, task_attempt=3, stage_attempt=1),
+        TaskId("job-1", 2, 7, task_attempt=4, stage_attempt=1,
+               speculative=True),
     ],
     TaskDescription: [
         TaskDescription(TaskId("job-1", 3, 0), _plan()),
@@ -86,9 +88,12 @@ SAMPLES = {
     ],
     ShuffleWritePartition: [
         ShuffleWritePartition(3, "/tmp/shuffle/data-3.arrow", 128, 8192),
+        ShuffleWritePartition(4, "/tmp/shuffle/data-4.arrow", 128, 8192,
+                              checksum=0xDEADBEEF),
     ],
     PartitionLocation: [
         PartitionLocation("exec-1", 0, 1, "/tmp/p"),
+        PartitionLocation("exec-1", 0, 2, "/tmp/p2", checksum=0xCAFEF00D),
         LOCATION,
     ],
     ExecutorMetadata: [

@@ -1,0 +1,321 @@
+"""Speculative execution: policy math, attempt-dedup races, monitor e2e.
+
+The policy functions are pure and tested directly; the attempt machinery
+is driven on a bare ExecutionGraph (reference execution_graph.rs test
+style — fabricated completions, no executors); the final test runs the
+real speculation monitor against a virtual cluster where one task is
+swallowed by its "host" and must be rescued by a duplicate attempt.
+"""
+import time
+
+import pytest
+
+from arrow_ballista_tpu.scheduler.execution_graph import (
+    RUNNING,
+    SUCCESSFUL,
+    ExecutionGraph,
+)
+from arrow_ballista_tpu.scheduler.scheduler import (
+    SchedulerConfig,
+    SchedulerServer,
+)
+from arrow_ballista_tpu.scheduler.speculation import (
+    SpeculationPolicy,
+    find_candidates,
+    speculation_cutoff_s,
+)
+from arrow_ballista_tpu.scheduler.types import (
+    IO_ERROR,
+    ExecutorMetadata,
+    FailedReason,
+    TaskStatus,
+)
+
+from .test_scheduler import (
+    VirtualTaskLauncher,
+    drain,
+    fake_success,
+    physical_plan,
+    run_job,
+)
+
+
+# --------------------------------------------------------------------------
+# policy math
+# --------------------------------------------------------------------------
+
+def test_cutoff_none_without_baseline():
+    assert speculation_cutoff_s([], 0.75, 1.5, 5.0) is None, \
+        "no completed attempts -> no cutoff (never speculate blind)"
+
+
+def test_cutoff_nearest_rank_quantile():
+    # q=0.75 over 4 samples -> 3rd smallest (nearest-rank), scaled by 2x
+    assert speculation_cutoff_s([1.0, 2.0, 3.0, 4.0], 0.75, 2.0, 0.0) \
+        == pytest.approx(6.0)
+    # single sample: the quantile IS that sample
+    assert speculation_cutoff_s([2.0], 0.75, 1.5, 0.0) == pytest.approx(3.0)
+
+
+def test_cutoff_min_runtime_floor():
+    # sub-millisecond baselines must not trigger hair-trigger duplicates
+    assert speculation_cutoff_s([0.001, 0.002], 0.75, 1.5, 5.0) \
+        == pytest.approx(5.0)
+
+
+def test_cutoff_quantile_clamped():
+    assert speculation_cutoff_s([1.0, 2.0], 9.0, 1.0, 0.0) == pytest.approx(2.0)
+    assert speculation_cutoff_s([1.0, 2.0], -1.0, 1.0, 0.0) == pytest.approx(1.0)
+
+
+def test_find_candidates_cutoff_budget_and_dedup():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=4))
+    tasks = {}
+    for _ in range(4):
+        t = graph.pop_next_task("exec-A")
+        tasks[t.task.partition] = t
+    # partitions 1-3 complete fast and form the duration baseline;
+    # partition 0 keeps running
+    for p in (1, 2, 3):
+        graph.update_task_status([fake_success(tasks[p], "exec-A")])
+    stage = graph.stages[1]
+    assert stage.state == RUNNING and len(stage.durations) == 3
+    policy = SpeculationPolicy(enabled=True, quantile=0.5, multiplier=1.0,
+                               min_runtime_s=1.0, max_concurrent=1)
+    started = stage.task_infos[0].started_at
+    assert find_candidates(graph, started + 0.5, policy) == [], \
+        "younger than the cutoff: not a straggler"
+    assert find_candidates(graph, started + 1.5, policy) \
+        == [(1, 0, "exec-A")]
+    # an in-flight duplicate removes the candidate AND spends the budget
+    assert graph.launch_speculative(1, 0, "exec-B") is not None
+    assert find_candidates(graph, started + 1.5, policy) == []
+
+
+# --------------------------------------------------------------------------
+# attempt-dedup races on the graph
+# --------------------------------------------------------------------------
+
+def test_launch_speculative_guards():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    assert graph.launch_speculative(1, 0, "exec-B") is None, "nothing running"
+    t = graph.pop_next_task("exec-A")
+    p = t.task.partition
+    assert graph.launch_speculative(1, p, "exec-A") is None, \
+        "a duplicate on the SAME host cannot dodge that host's slowness"
+    spec = graph.launch_speculative(1, p, "exec-B")
+    assert spec is not None and spec.task.speculative
+    assert spec.task.task_attempt != t.task.task_attempt
+    assert graph.launch_speculative(1, p, "exec-C") is None, \
+        "one duplicate per partition"
+    graph.update_task_status([fake_success(t, "exec-A")])
+    assert graph.launch_speculative(1, p, "exec-B") is None, "already finished"
+
+
+def test_primary_win_cancels_speculative_loser():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    t = graph.pop_next_task("exec-A")
+    p = t.task.partition
+    spec = graph.launch_speculative(1, p, "exec-B")
+    events = graph.update_task_status([fake_success(t, "exec-A")])
+    assert not any(k == "speculative_win" for k, _ in events)
+    cancels = [payload for kind, payload in events if kind == "cancel_task"]
+    assert len(cancels) == 1
+    executor_id, tid = cancels[0]
+    assert executor_id == "exec-B"
+    assert tid.task_attempt == spec.task.task_attempt and tid.speculative
+    stage = graph.stages[1]
+    assert stage.task_infos[p].state == "success"
+    assert stage.task_infos[p].attempt == t.task.task_attempt
+    assert p not in stage.speculative_tasks
+    assert len(stage.durations) == 1, "winner's duration feeds the baseline"
+    # the loser's late success must not disturb the recorded outputs
+    before = dict(stage.outputs)
+    assert graph.update_task_status([fake_success(spec, "exec-B")]) == []
+    assert stage.outputs == before
+    assert stage.task_infos[p].attempt == t.task.task_attempt
+
+
+def test_speculative_win_cancels_primary_loser():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    t = graph.pop_next_task("exec-A")
+    p = t.task.partition
+    spec = graph.launch_speculative(1, p, "exec-B")
+    events = graph.update_task_status([fake_success(spec, "exec-B")])
+    assert ("speculative_win", (1, p)) in events
+    cancels = [payload for kind, payload in events if kind == "cancel_task"]
+    assert len(cancels) == 1
+    executor_id, tid = cancels[0]
+    assert executor_id == "exec-A"
+    assert tid.task_attempt == t.task.task_attempt and not tid.speculative
+    stage = graph.stages[1]
+    assert stage.task_infos[p].state == "success"
+    assert stage.task_infos[p].attempt == spec.task.task_attempt
+    assert p not in stage.speculative_tasks
+    # the cancelled primary unwinds as killed: bookkeeping only, no reset
+    graph.update_task_status([TaskStatus(t.task, "exec-A", "killed")])
+    assert stage.task_infos[p].state == "success"
+    # exactly one terminal success per partition in the attempt log
+    wins = [e for e in stage.attempt_log
+            if e["partition"] == p and e["state"] == "success"]
+    assert len(wins) == 1 and wins[0]["speculative"]
+    drain(graph, "exec-B")
+    assert graph.status == "successful"
+
+
+def test_speculative_failure_is_a_free_drop():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    t = graph.pop_next_task("exec-A")
+    p = t.task.partition
+    spec = graph.launch_speculative(1, p, "exec-B")
+    events = graph.update_task_status([TaskStatus(
+        spec.task, "exec-B", "failed",
+        failure=FailedReason(IO_ERROR, "duplicate died"))])
+    assert events == []
+    stage = graph.stages[1]
+    assert stage.task_failures[p] == 0, \
+        "a dead duplicate must not charge the primary's retry budget"
+    assert p not in stage.speculative_tasks
+    assert stage.task_infos[p].state == "running", "primary unaffected"
+    graph.update_task_status([fake_success(t, "exec-A")])
+    drain(graph, "exec-A")
+    assert graph.status == "successful"
+
+
+def test_primary_failure_promotes_speculative():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    t = graph.pop_next_task("exec-A")
+    p = t.task.partition
+    spec = graph.launch_speculative(1, p, "exec-B")
+    graph.update_task_status([TaskStatus(
+        t.task, "exec-A", "failed",
+        failure=FailedReason(IO_ERROR, "primary died"))])
+    stage = graph.stages[1]
+    info = stage.task_infos[p]
+    assert info is not None and info.state == "running"
+    assert info.attempt == spec.task.task_attempt and info.speculative, \
+        "the in-flight duplicate is promoted instead of a third launch"
+    assert p not in stage.speculative_tasks
+    # the promoted attempt's success completes the partition (no
+    # speculative_win: it IS the primary now)
+    events = graph.update_task_status([fake_success(spec, "exec-B")])
+    assert not any(k in ("speculative_win", "cancel_task")
+                   for k, _ in events)
+    assert stage.task_infos[p].state == "success"
+
+
+def test_executor_lost_promotes_surviving_speculative():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    t = graph.pop_next_task("exec-A")
+    p = t.task.partition
+    spec = graph.launch_speculative(1, p, "exec-B")
+    graph.executor_lost("exec-A")
+    stage = graph.stages[1]
+    info = stage.task_infos[p]
+    assert info is not None and info.executor_id == "exec-B" \
+        and info.speculative
+    assert p not in stage.speculative_tasks
+    graph.update_task_status([fake_success(spec, "exec-B")])
+    assert stage.task_infos[p].state == "success"
+    drain(graph, "exec-B")
+    assert graph.status == "successful"
+
+
+def test_rollback_forgets_speculative_duplicates():
+    graph = ExecutionGraph.build("j", physical_plan(partitions=2))
+    t = graph.pop_next_task("exec-A")
+    graph.launch_speculative(1, t.task.partition, "exec-B")
+    stage = graph.stages[1]
+    assert stage.speculative_tasks
+    stage.rollback()
+    assert not stage.speculative_tasks
+    # late statuses from the rolled-back epoch are dropped entirely
+    assert graph.update_task_status([fake_success(t, "exec-A")]) == []
+    assert all(i is None for i in stage.task_infos)
+
+
+# --------------------------------------------------------------------------
+# the real monitor against a virtual cluster
+# --------------------------------------------------------------------------
+
+class StragglerLauncher(VirtualTaskLauncher):
+    """Answers every task instantly EXCEPT the first attempt of stage-1
+    partition 0, which it swallows — a task stuck on a sick host that will
+    never report.  Records task-level cancels."""
+
+    def __init__(self):
+        super().__init__()
+        self.swallowed = []
+        self.cancelled_tasks = []
+
+    def launch_tasks(self, executor_id, tasks):
+        report = []
+        with self._lock:
+            self.launched.extend((executor_id, t) for t in tasks)
+        for t in tasks:
+            tid = t.task
+            if tid.stage_id == 1 and tid.partition == 0 \
+                    and tid.task_attempt == 0:
+                self.swallowed.append((executor_id, tid))
+                continue
+            report.append(fake_success(t, executor_id))
+        if report:
+            self.scheduler.update_task_status(executor_id, report)
+
+    def cancel_task(self, executor_id, task):
+        self.cancelled_tasks.append((executor_id, task))
+
+
+def test_monitor_rescues_swallowed_task():
+    launcher = StragglerLauncher()
+    server = SchedulerServer(launcher, SchedulerConfig(
+        task_distribution="round-robin",
+        speculation_enabled=True, speculation_quantile=0.5,
+        speculation_multiplier=1.0, speculation_min_runtime_s=0.2,
+        speculation_max_concurrent=2, speculation_interval_s=0.05))
+    launcher.scheduler = server
+    server.init(start_reaper=False)
+    for i in range(2):
+        server.register_executor(ExecutorMetadata(f"exec-{i}", task_slots=4))
+    try:
+        status = run_job(server, physical_plan())
+        assert status.state == "successful", status.error
+        assert len(launcher.swallowed) == 1
+        stuck_executor, stuck_tid = launcher.swallowed[0]
+        spec_launches = [(eid, t) for eid, t in launcher.launched
+                         if t.task.speculative]
+        assert len(spec_launches) == 1, \
+            "exactly one duplicate for the one straggler"
+        spec_executor, spec_task = spec_launches[0]
+        assert spec_executor != stuck_executor, \
+            "the duplicate must land on a DIFFERENT executor"
+        assert spec_task.task.stage_id == 1 and spec_task.task.partition == 0
+        # first result wins: the stuck primary is told to die (the cancel
+        # is dispatched off the event loop — poll briefly for delivery)
+        deadline = time.monotonic() + 5.0
+        while (stuck_executor, stuck_tid) not in launcher.cancelled_tasks \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert (stuck_executor, stuck_tid) in launcher.cancelled_tasks
+        text = server.metrics.gather()
+        assert "speculative_tasks_launched_total 1" in text
+        assert "speculative_wins_total 1" in text
+        graph = server.jobs.get_graph("job1")
+        log = graph.stages[1].attempt_log
+        assert any(e["speculative"] and e["state"] == "success" for e in log)
+        assert graph.stages[1].state == SUCCESSFUL
+    finally:
+        server.shutdown()
+
+
+def test_monitor_not_started_when_disabled():
+    launcher = VirtualTaskLauncher()
+    server = SchedulerServer(launcher, SchedulerConfig())
+    launcher.scheduler = server
+    server.init(start_reaper=False)
+    try:
+        assert server._spec_monitor is None, \
+            "speculation off (the default) must add no background work"
+        assert not server.config.speculation.enabled
+    finally:
+        server.shutdown()

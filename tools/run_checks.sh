@@ -2,11 +2,13 @@
 # CI entrypoint for the repository's consistency checks:
 #   1. the static-analysis lint suite (AST rules + metrics-docs),
 #   2. generated-docs freshness (docs/user-guide/configs.md),
-#   3. the static-analysis + wire-serde test files (rule fixtures,
-#      plan-validator cases, exhaustive wire round-trips),
+#   3. the static-analysis + wire-serde + speculation test files (rule
+#      fixtures, plan-validator cases, exhaustive wire round-trips,
+#      speculation policy math and attempt-dedup races),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
-#      quarantine) — proves the fault-tolerance paths still recover.
+#      quarantine, straggler speculation, corrupt-shuffle checksums) —
+#      proves the fault-tolerance paths still recover.
 # tests/test_static_analysis.py also runs the lint suite inside tier-1, so
 # pytest alone still gates new violations; this script is the fast
 # standalone form for CI and pre-push hooks.
@@ -21,9 +23,9 @@ python -m arrow_ballista_tpu.analysis
 echo "== generated docs up to date =="
 python docs/gen_configs.py --check
 
-echo "== analysis + serde test files =="
+echo "== analysis + serde + speculation test files =="
 python -m pytest tests/test_static_analysis.py tests/test_serde_wire.py \
-    -q -p no:cacheprovider
+    tests/test_speculation.py -q -p no:cacheprovider
 
 echo "== chaos recovery suite (-m chaos) =="
 python -m pytest tests/test_chaos.py -q -m chaos -p no:cacheprovider
