@@ -92,6 +92,15 @@ class SpanCoverageRule(Rule):
     Compliant shapes: a ``with ctx.op_span(self):`` anywhere in the body,
     a body that only raises (abstract / refuses-to-run operators), or a
     delegation to a sibling ``self.execute*`` method that spans.
+
+    PR 6 extension: any OTHER operator-signature method in ops/ that
+    emits operator stats (``self.metrics()...`` or ``deferred_rows``)
+    is held to the same standard — metrics recorded outside a span are
+    invisible to the profile's operator attribution and silently skew
+    EXPLAIN ANALYZE.  Private helpers reached from a (checked) spanning
+    entry point are exempt: being called as ``self.<name>`` elsewhere in
+    the module (this covers overrides dispatched from a base class's
+    spanning execute) means the span is already open on the stack.
     """
 
     name = "span-coverage"
@@ -99,6 +108,7 @@ class SpanCoverageRule(Rule):
 
     DIR = f"{PKG}/ops/"
     METHODS = ("execute", "execute_write")
+    STATS_FNS = ("deferred_rows",)
 
     def check(self, project: Project) -> Iterable[Violation]:
         for sf in project.source_files():
@@ -108,20 +118,55 @@ class SpanCoverageRule(Rule):
                 if not isinstance(cls, ast.ClassDef):
                     continue
                 for fn in cls.body:
-                    if (isinstance(fn, ast.FunctionDef)
-                            and fn.name in self.METHODS
-                            and self._is_operator_sig(fn)
+                    if (not isinstance(fn, ast.FunctionDef)
+                            or not self._is_operator_sig(fn)):
+                        continue
+                    if fn.name in self.METHODS:
+                        if not self._compliant(fn):
+                            yield Violation(
+                                self.name, sf.path, fn.lineno,
+                                f"{cls.name}.{fn.name} is not wrapped in "
+                                f"ctx.op_span(self) (and neither raises nor "
+                                f"delegates to a spanning execute method)")
+                    elif (self._emits_stats(fn)
+                            and fn.name not in self._called_internally(
+                                sf.tree, excluding=fn)
                             and not self._compliant(fn)):
                         yield Violation(
                             self.name, sf.path, fn.lineno,
-                            f"{cls.name}.{fn.name} is not wrapped in "
-                            f"ctx.op_span(self) (and neither raises nor "
-                            f"delegates to a spanning execute method)")
+                            f"{cls.name}.{fn.name} emits operator metrics "
+                            f"but runs outside ctx.op_span(self) and is "
+                            f"never reached from a spanning entry point")
 
     @staticmethod
     def _is_operator_sig(fn: ast.FunctionDef) -> bool:
         args = [a.arg for a in fn.args.args]
         return len(args) >= 3 and args[0] == "self" and "ctx" in args
+
+    def _emits_stats(self, fn: ast.FunctionDef) -> bool:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d == "self.metrics" or d in self.STATS_FNS:
+                return True
+        return False
+
+    @staticmethod
+    def _called_internally(tree: ast.Module,
+                           excluding: ast.FunctionDef) -> Set[str]:
+        """Method names invoked as ``self.<name>(...)`` anywhere in the
+        module outside the method itself (recursion doesn't self-exempt;
+        module scope so a base class dispatching to an override counts)."""
+        skip = set(map(id, ast.walk(excluding)))
+        called: Set[str] = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call) and id(node) not in skip
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                called.add(node.func.attr)
+        return called
 
     def _compliant(self, fn: ast.FunctionDef) -> bool:
         body = [s for s in fn.body

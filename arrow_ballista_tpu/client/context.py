@@ -301,21 +301,76 @@ class BallistaContext:
              "value": [str(v) for _, v in rows]}))
 
     def _explain(self, stmt: "ast.Explain") -> BallistaDataFrame:
-        """EXPLAIN [VERBOSE] <select>: plan rows, DataFusion-shaped
-        (plan_type, plan); VERBOSE adds the distributed stage split.
-        Parity: the reference gets EXPLAIN from DataFusion through
-        ballista-cli; here the physical row shows the exchange/mesh
-        decisions this engine makes (SURVEY §1 ENGINE layer).  The result
-        is a static frame — nothing is registered in the catalog."""
+        """EXPLAIN [ANALYZE] [VERBOSE] <select>: plan rows,
+        DataFusion-shaped (plan_type, plan); VERBOSE adds the distributed
+        stage split, ANALYZE runs the query and appends a row with the
+        runtime-annotated plan (obs/stats.py).  Parity: the reference gets
+        EXPLAIN from DataFusion through ballista-cli; here the physical
+        row shows the exchange/mesh decisions this engine makes (SURVEY §1
+        ENGINE layer).  The result is a static frame — nothing is
+        registered in the catalog."""
         import pandas as pd
 
         from ..scheduler.physical_planner import explain_rows
 
         rows = explain_rows(self.catalog, self.config, stmt.statement,
                             verbose=stmt.verbose)
+        if stmt.analyze:
+            report = self._explain_analyze_statement(stmt.statement)
+            rows = rows + [{"plan_type": "explain_analyze",
+                            "plan": report["text"]}]
         return BallistaDataFrame(
             self, None,
             static=pd.DataFrame(rows, columns=["plan_type", "plan"]))
+
+    def explain_analyze(self, sql: str) -> Dict:
+        """Run ``sql`` and return the EXPLAIN ANALYZE report: the physical
+        plan annotated with observed rows/bytes/wall-time per operator and
+        skew/duration quantiles per stage.  The returned dict is the JSON
+        form (same shape as ``GET /api/job/<id>/stats``); its ``"text"``
+        key holds the rendered report.  Accepts either a bare SELECT or a
+        full ``EXPLAIN ANALYZE <select>`` statement."""
+        if self._remote is not None:
+            raise PlanningError(
+                "explain_analyze is not supported over a remote connection; "
+                "run the query and read GET /api/job/<id>/stats on the "
+                "scheduler's REST API instead")
+        stmt = parse_sql(sql)
+        if isinstance(stmt, ast.Explain):
+            stmt = stmt.statement
+        if not isinstance(stmt, ast.Select):
+            raise PlanningError("explain_analyze requires a SELECT query")
+        return self._explain_analyze_statement(stmt)
+
+    def _explain_analyze_statement(self, stmt: "ast.Node") -> Dict:
+        """Plan + run one SELECT and build the annotated report.  The
+        standalone engine reads the retained ExecutionGraph's stats store
+        (identical numbers to the profile endpoint); the local engine
+        reads metrics straight off the executed operator instances."""
+        import time
+
+        from ..obs.stats import explain_analyze_report, local_explain_report
+
+        logical = SqlToRel(self.catalog).plan(stmt)
+        planner = PhysicalPlanner(self.catalog, self.config)
+        planned = planner.plan_query(optimize(logical))
+        t0 = time.monotonic()
+        if self.engine == "local":
+            batches = self._execute_local(planned)
+            wall_ms = (time.monotonic() - t0) * 1000.0
+            return local_explain_report(
+                planned.plan, wall_ms,
+                rows_returned=sum(b.num_rows for b in batches))
+        batches = self._standalone.execute(planned)
+        wall_ms = (time.monotonic() - t0) * 1000.0
+        graph = self._standalone.scheduler.jobs.get_graph(
+            self._standalone.last_job_id)
+        if graph is None:
+            raise PlanningError(
+                f"job {self._standalone.last_job_id} graph is no longer "
+                "retained; cannot build the EXPLAIN ANALYZE report")
+        return explain_analyze_report(
+            graph, wall_ms, rows_returned=sum(b.num_rows for b in batches))
 
     def _create_external_table(self, stmt: ast.CreateExternalTable) -> BallistaDataFrame:
         schema = None

@@ -21,4 +21,16 @@ from .tracing import (  # noqa: F401
     span_to_obj,
 )
 from .profile import JobObservability, ProfileStore  # noqa: F401
+from .stats import (  # noqa: F401
+    ClusterHistory,
+    RuntimeStatsStore,
+    duration_quantiles,
+    explain_analyze_report,
+    local_explain_report,
+    nearest_rank_quantile,
+    render_explain_analyze,
+    row_histogram,
+    skew_coefficient,
+    stage_summary,
+)
 from .trace_event import spans_to_chrome  # noqa: F401

@@ -210,8 +210,16 @@ class JobObservability:
         for stage in graph.stages.values():
             for info in stage.task_infos:
                 st = getattr(info, "status", None)
-                if st is not None:
-                    spans.extend(getattr(st, "spans", None) or [])
+                if st is None:
+                    continue
+                # same attempt guard as _task_profile: a late loser's
+                # status must not add duplicate operator spans to the trace
+                st_att = getattr(getattr(st, "task", None), "task_attempt",
+                                 None)
+                if st_att is not None and st_att != getattr(info, "attempt",
+                                                            st_att):
+                    continue
+                spans.extend(getattr(st, "spans", None) or [])
         return spans
 
     def _job_spans(self, jt: _JobTrace, graph) -> List[Span]:
@@ -242,6 +250,13 @@ class JobObservability:
                 if info is None:
                     continue
                 tasks.append(_task_profile(info))
+            # in-flight speculative duplicates (PR 5): shown as their own
+            # running entries so the profile explains where a slot went;
+            # once the race resolves, only the winner keeps its task entry
+            # (the loser's snapshot is excluded by the attempt guard in
+            # _task_profile and ExecutionStage.operator_metrics)
+            for spec in getattr(stage, "speculative_tasks", {}).values():
+                tasks.append(_task_profile(spec))
             prof["stages"].append({
                 "stage_id": sid,
                 "state": stage.state,
@@ -257,8 +272,16 @@ def _task_profile(info) -> Dict:
     st = getattr(info, "status", None)
     t = {"partition": info.partition,
          "executor_id": info.executor_id,
-         "state": info.state}
+         "state": info.state,
+         "attempt": getattr(info, "attempt", 0),
+         "speculative": bool(getattr(info, "speculative", False))}
     if st is None:
+        return t
+    # attempt-aware dedup: a terminal status absorbed from a different
+    # attempt (a cancelled speculative loser reporting late) must not
+    # contribute its spans/metrics as if it were this task's run
+    st_att = getattr(getattr(st, "task", None), "task_attempt", None)
+    if st_att is not None and st_att != t["attempt"]:
         return t
     t.update(launch_ms=st.launch_time_ms, start_ms=st.start_time_ms,
              end_ms=st.end_time_ms,

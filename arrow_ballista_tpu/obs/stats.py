@@ -1,0 +1,366 @@
+"""Runtime statistics observatory: per-stage stats + cluster time series.
+
+PRs 2 and 5 left exact raw material lying around — per-operator metric
+snapshots on every task status, completed-attempt durations, and
+``ShuffleWritePartition`` row/byte/checksum records — but nothing folded
+them into a form the scheduler (or a human) can act on.  This module is
+that fold, the read side every adaptive-execution decision will consume
+(Flare's runtime re-specialization needs observed stats first):
+
+- :class:`RuntimeStatsStore` — per-job store of per-stage summaries
+  (per-partition row/byte distribution + histogram, skew coefficient,
+  bytes shuffled, task duration quantiles), refreshed as tasks complete
+  and kept live on the ExecutionGraph (``graph.stats``) so AQE code can
+  query it between stages.  ``GET /api/job/<id>/stats`` serves the same
+  snapshot.
+- :func:`explain_analyze_report` — EXPLAIN ANALYZE: the physical plan
+  re-rendered with actual rows/bytes/wall-time per operator and skew per
+  stage, in JSON and text forms, from the same ``operator_metrics()``
+  fold the profile endpoint uses (so the two cannot disagree).
+- :class:`ClusterHistory` — bounded ring buffer of periodic cluster
+  samples (executor utilization, admission queue depth, event-loop lag)
+  behind ``GET /api/cluster/history``.
+
+The nearest-rank quantile lives here and is shared with the speculation
+policy (``scheduler/speculation.py`` imports it), so "p95 task duration"
+means the same thing in a profile and in a straggler cutoff.
+
+Thread model: folding happens on the scheduler event loop (single
+writer); REST handlers read from other threads.  Summaries are plain
+dicts swapped in with one atomic assignment — readers always see a
+complete snapshot, no lock needed.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+# decade buckets for the per-partition row histogram: wide enough to span
+# a single-row reduce bucket and a 10^9-row scan without tuning
+ROW_HISTOGRAM_EDGES = (1, 10, 100, 1_000, 10_000, 100_000,
+                       1_000_000, 10_000_000, 100_000_000)
+
+_QUANTILES = ((0.5, "p50"), (0.75, "p75"), (0.95, "p95"))
+
+
+def nearest_rank_quantile(xs: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank quantile (q=0.75 over 4 samples -> 3rd smallest), the
+    same estimator the speculation cutoff uses — shared so stats views and
+    the straggler policy agree on what "p95" means."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    qq = min(max(float(q), 0.0), 1.0)
+    rank = max(1, int(math.ceil(qq * len(s))))
+    return s[rank - 1]
+
+
+def row_histogram(values: Sequence[int]) -> Dict[str, List[int]]:
+    """Histogram of per-partition row counts over decade buckets; the last
+    count is the overflow (> largest edge)."""
+    counts = [0] * (len(ROW_HISTOGRAM_EDGES) + 1)
+    for v in values:
+        for i, edge in enumerate(ROW_HISTOGRAM_EDGES):
+            if v <= edge:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+    return {"edges": list(ROW_HISTOGRAM_EDGES), "counts": counts}
+
+
+def skew_coefficient(values: Sequence[int]) -> float:
+    """max/mean over per-partition rows: 1.0 = perfectly balanced, N =
+    the hottest partition carries N× its fair share (the AQE trigger for
+    splitting hot partitions).  0.0 when the stage produced no rows."""
+    if not values:
+        return 0.0
+    mean = sum(values) / len(values)
+    if mean <= 0:
+        return 0.0
+    return max(values) / mean
+
+
+def duration_quantiles(durations: Sequence[float]) -> Dict[str, float]:
+    """Task-duration summary via the nearest-rank quantile."""
+    out: Dict[str, float] = {"count": len(durations)}
+    if not durations:
+        return out
+    for q, name in _QUANTILES:
+        out[name] = round(nearest_rank_quantile(durations, q), 4)
+    out["max"] = round(max(durations), 4)
+    out["mean"] = round(sum(durations) / len(durations), 4)
+    return out
+
+
+def stage_summary(stage) -> Dict:
+    """Fold one ExecutionStage's completed-task evidence into a summary.
+
+    Reads ``outputs`` (ShuffleWritePartition records keyed by map
+    partition), ``durations`` (completed-attempt seconds), the attempt
+    log, and ``operator_metrics()`` (the last-snapshot-per-process fold
+    the profile endpoint uses).  Pure read — never mutates the stage.
+    """
+    part_rows: Dict[int, int] = {}
+    part_bytes: Dict[int, int] = {}
+    for _map_part, (_executor_id, writes) in sorted(stage.outputs.items()):
+        for w in writes:
+            part_rows[w.output_partition] = \
+                part_rows.get(w.output_partition, 0) + int(w.num_rows)
+            part_bytes[w.output_partition] = \
+                part_bytes.get(w.output_partition, 0) + int(w.num_bytes)
+    rows_list = [part_rows[p] for p in sorted(part_rows)]
+    launches = list(getattr(stage, "attempt_log", ()))
+    return {
+        "stage_id": stage.stage_id,
+        "state": stage.state,
+        "stage_attempt": stage.stage_attempt,
+        "partitions": stage.partitions,
+        "planned_partitions": stage.planned_partitions,
+        "tasks_completed": sum(1 for t in stage.task_infos
+                               if t is not None and t.state == "success"),
+        "task_launches": len(launches),
+        "speculative_launches": sum(1 for e in launches if e["speculative"]),
+        "output_rows": sum(rows_list),
+        "output_bytes": sum(part_bytes.values()),
+        "partition_rows": {str(p): part_rows[p] for p in sorted(part_rows)},
+        "partition_bytes": {str(p): part_bytes[p]
+                            for p in sorted(part_bytes)},
+        "skew": round(skew_coefficient(rows_list), 4),
+        "row_histogram": row_histogram(rows_list),
+        "task_duration_s": duration_quantiles(list(stage.durations)),
+        "operators": stage.operator_metrics(),
+    }
+
+
+class RuntimeStatsStore:
+    """Per-job runtime statistics, kept live on the ExecutionGraph.
+
+    ``fold_stage`` is called from the graph's success path (event-loop
+    thread) every time a task completes, so the summary tracks a running
+    stage and is final the moment the stage turns SUCCESSFUL.  A summary
+    survives later rollbacks of its stage (the rolled-back attempt's
+    numbers stay queryable until a re-run refolds them) — AQE reads what
+    the *last completed* attempt actually produced.
+    """
+
+    def __init__(self, job_id: str):
+        self.job_id = job_id
+        self._stages: Dict[int, Dict] = {}
+
+    def fold_stage(self, stage) -> Dict:
+        summary = stage_summary(stage)
+        self._stages[stage.stage_id] = summary  # atomic swap (see module doc)
+        return summary
+
+    def stage(self, stage_id: int) -> Optional[Dict]:
+        return self._stages.get(stage_id)
+
+    def stage_ids(self) -> List[int]:
+        return sorted(self._stages)
+
+    def snapshot(self) -> Dict:
+        stages = [self._stages[sid] for sid in sorted(self._stages)]
+        return {
+            "job_id": self.job_id,
+            "stages": stages,
+            "total_output_rows": sum(s["output_rows"] for s in stages),
+            "total_shuffle_bytes": sum(s["output_bytes"] for s in stages),
+        }
+
+
+# --- EXPLAIN ANALYZE ------------------------------------------------------
+
+def _walk_plan(node, path="0", depth=0, out=None):
+    """Pre-order walk with the executor-side metric path key convention
+    ("0", "0.0", ...; execution_engine.collect_plan_metrics).  Shuffle
+    readers are stage leaves — their producers are other stages."""
+    if out is None:
+        out = []
+    out.append((path, depth, node))
+    if type(node).__name__ not in ("ShuffleReaderExec",
+                                   "UnresolvedShuffleExec"):
+        for i, c in enumerate(node.children()):
+            _walk_plan(c, f"{path}.{i}", depth + 1, out)
+    return out
+
+
+def _op_entry(path: str, depth: int, node, mm: Dict[str, float]) -> Dict:
+    time_ms = sum(v for k, v in mm.items() if k.endswith("_time")) * 1000.0
+    nbytes = sum(v for k, v in mm.items() if k.endswith("_bytes"))
+    label = node._label() if hasattr(node, "_label") else type(node).__name__
+    return {
+        "path": path,
+        "depth": depth,
+        "op": type(node).__name__,
+        "label": label,
+        "rows": int(mm["output_rows"]) if "output_rows" in mm else None,
+        "time_ms": round(time_ms, 3),
+        "bytes": int(nbytes),
+        "metrics": {k: round(v, 6) for k, v in sorted(mm.items())},
+    }
+
+
+def annotate_plan(plan, op_metrics: Dict[str, Dict[str, float]]) -> List[Dict]:
+    """Per-operator annotation entries for one stage plan, joined to the
+    stage's folded operator metrics by path key."""
+    return [
+        _op_entry(path, depth, node,
+                  op_metrics.get(f"{path}:{type(node).__name__}", {}))
+        for path, depth, node in _walk_plan(plan)
+    ]
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GB"
+
+
+def _op_suffix(op: Dict) -> str:
+    parts = []
+    if op["rows"] is not None:
+        parts.append(f"{op['rows']:,} rows")
+    if op["time_ms"]:
+        parts.append(f"{op['time_ms']:.1f} ms")
+    if op["bytes"]:
+        parts.append(_fmt_bytes(op["bytes"]))
+    return f"  [{' · '.join(parts)}]" if parts else ""
+
+
+def _stage_header(s: Dict) -> str:
+    dur = s.get("task_duration_s") or {}
+    bits = [
+        f"Stage {s['stage_id']} [{s['state']}]",
+        f"{s['tasks_completed']}/{s['partitions']} tasks",
+        f"{s['output_rows']:,} rows out",
+        _fmt_bytes(s["output_bytes"]),
+        f"skew {s['skew']:.2f}",
+    ]
+    if s.get("speculative_launches"):
+        bits.append(f"{s['speculative_launches']} speculative")
+    if dur.get("count"):
+        bits.append(f"task p50 {dur['p50']:.3f}s p95 {dur['p95']:.3f}s "
+                    f"max {dur['max']:.3f}s")
+    return " · ".join(bits)
+
+
+def render_explain_analyze(report: Dict) -> str:
+    """Text form of an explain-analyze report (the JSON is the report
+    itself)."""
+    head = [f"== EXPLAIN ANALYZE: job {report['job_id']} "
+            f"[{report['state']}] =="]
+    line2 = [f"wall time: {report['wall_time_ms']:.1f} ms"]
+    if report.get("rows_returned") is not None:
+        line2.append(f"rows returned: {report['rows_returned']:,}")
+    line2.append("bytes shuffled: "
+                 + _fmt_bytes(report.get("total_shuffle_bytes", 0)))
+    head.append(" · ".join(line2))
+    lines = head
+    for s in report["stages"]:
+        lines.append("")
+        lines.append(_stage_header(s))
+        for op in s.get("operator_tree", ()):  # pre-order, depth-indented
+            lines.append("  " * (op["depth"] + 1) + op["label"].splitlines()[0]
+                         + _op_suffix(op))
+    return "\n".join(lines)
+
+
+def explain_analyze_report(graph, wall_time_ms: float = 0.0,
+                           rows_returned: Optional[int] = None) -> Dict:
+    """EXPLAIN ANALYZE over a (finished or running) ExecutionGraph: the
+    per-stage summaries from ``graph.stats`` plus the per-operator
+    annotation of each stage's physical plan.  Numbers come from the same
+    folds as ``/api/job/<id>/profile`` — consistent by construction."""
+    stats = getattr(graph, "stats", None)
+    stages = []
+    for sid in sorted(graph.stages):
+        stage = graph.stages[sid]
+        summary = (stats.stage(sid) if stats is not None else None) \
+            or stage_summary(stage)
+        summary = dict(summary)
+        summary["operator_tree"] = annotate_plan(
+            stage.resolved_plan or stage.plan, summary["operators"])
+        stages.append(summary)
+    report = {
+        "job_id": graph.job_id,
+        "state": graph.status,
+        "wall_time_ms": round(float(wall_time_ms), 3),
+        "rows_returned": rows_returned,
+        "total_output_rows": sum(s["output_rows"] for s in stages),
+        "total_shuffle_bytes": sum(s["output_bytes"] for s in stages),
+        "stages": stages,
+    }
+    report["text"] = render_explain_analyze(report)
+    return report
+
+
+def local_explain_report(plan, wall_time_ms: float = 0.0,
+                         rows_returned: Optional[int] = None) -> Dict:
+    """EXPLAIN ANALYZE for the local (single-process) engine: no stage
+    DAG or shuffle files, so the whole plan is one synthetic stage and
+    metrics come straight off the executed operator instances."""
+    op_metrics = {
+        f"{path}:{type(node).__name__}": node.metrics().to_dict()
+        for path, _depth, node in _walk_plan(plan)
+        if hasattr(node, "metrics")
+    }
+    stage = {
+        "stage_id": 0,
+        "state": "successful",
+        "stage_attempt": 0,
+        "partitions": plan.output_partition_count(),
+        "planned_partitions": plan.output_partition_count(),
+        "tasks_completed": plan.output_partition_count(),
+        "task_launches": plan.output_partition_count(),
+        "speculative_launches": 0,
+        "output_rows": rows_returned or 0,
+        "output_bytes": 0,
+        "partition_rows": {},
+        "partition_bytes": {},
+        "skew": 0.0,
+        "row_histogram": row_histogram([]),
+        "task_duration_s": duration_quantiles([]),
+        "operators": op_metrics,
+        "operator_tree": annotate_plan(plan, op_metrics),
+    }
+    report = {
+        "job_id": "local",
+        "state": "successful",
+        "wall_time_ms": round(float(wall_time_ms), 3),
+        "rows_returned": rows_returned,
+        "total_output_rows": stage["output_rows"],
+        "total_shuffle_bytes": 0,
+        "stages": [stage],
+    }
+    report["text"] = render_explain_analyze(report)
+    return report
+
+
+# --- cluster time series --------------------------------------------------
+
+class ClusterHistory:
+    """Bounded ring buffer of periodic cluster samples (utilization,
+    queue depths, event-loop lag) behind ``GET /api/cluster/history`` —
+    the saturation record ROADMAP item 3's throughput benchmark reads.
+    Appends happen on the scheduler's sampler thread; ``deque(maxlen)``
+    appends and list() reads are atomic under the GIL, so REST readers
+    need no lock."""
+
+    def __init__(self, capacity: int = 512, interval_s: float = 5.0):
+        self.capacity = max(int(capacity), 1)
+        self.interval_s = float(interval_s)
+        self._samples: "deque[Dict]" = deque(maxlen=self.capacity)
+
+    def record(self, sample: Dict) -> None:
+        self._samples.append(sample)
+
+    def snapshot(self) -> Dict:
+        return {
+            "capacity": self.capacity,
+            "interval_s": self.interval_s,
+            "samples": list(self._samples),
+        }

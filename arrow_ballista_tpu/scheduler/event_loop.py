@@ -30,10 +30,20 @@ class EventLoop:
         # status (observed: a repr() crash inside a handler stranded the
         # job until its deadline)
         self._on_error = on_error
+        # entries are (enqueue_monotonic, event) so the consumer can measure
+        # queue lag — the ROADMAP item 3 saturation signal
         self._queue: "queue.Queue[object]" = queue.Queue(maxsize=buffer_size)
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self.slow_event_threshold_s = slow_event_threshold_s
+        # lag/latency counters: written only by the consumer thread, read by
+        # the metrics sampler — single-writer, so plain attributes suffice
+        self._events_processed = 0
+        self._slow_events = 0
+        self._last_lag_s = 0.0
+        self._max_lag_s = 0.0
+        self._handler_seconds_total = 0.0
+        self._handler_seconds_max = 0.0
 
     def start(self) -> None:
         if self._thread is not None:
@@ -53,14 +63,37 @@ class EventLoop:
     def post(self, event: object) -> None:
         if self._stopped.is_set():
             return
-        self._queue.put(event)
+        self._queue.put((time.monotonic(), event))
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def stats(self) -> dict:
+        """Lag/latency snapshot for the metrics sampler and
+        ``/api/cluster/history`` (lag = dequeue time - enqueue time)."""
+        n = self._events_processed
+        return {
+            "queue_depth": self._queue.qsize(),
+            "events_processed": n,
+            "slow_events": self._slow_events,
+            "last_lag_s": round(self._last_lag_s, 6),
+            "max_lag_s": round(self._max_lag_s, 6),
+            "handler_seconds_total": round(self._handler_seconds_total, 6),
+            "handler_seconds_max": round(self._handler_seconds_max, 6),
+            "handler_seconds_mean":
+                round(self._handler_seconds_total / n, 6) if n else 0.0,
+        }
 
     def _run(self) -> None:
         while not self._stopped.is_set():
-            event = self._queue.get()
-            if event is None:
+            item = self._queue.get()
+            if item is None:
                 continue
+            enqueued_at, event = item
             t0 = time.monotonic()
+            self._last_lag_s = t0 - enqueued_at
+            if self._last_lag_s > self._max_lag_s:
+                self._max_lag_s = self._last_lag_s
             try:
                 self._on_receive(event)
             except Exception as exc:  # noqa: BLE001 — the loop must survive
@@ -71,7 +104,12 @@ class EventLoop:
                     except Exception:  # noqa: BLE001
                         log.exception("%s: on_error hook raised", self.name)
             dt = time.monotonic() - t0
+            self._events_processed += 1
+            self._handler_seconds_total += dt
+            if dt > self._handler_seconds_max:
+                self._handler_seconds_max = dt
             if dt > self.slow_event_threshold_s:
+                self._slow_events += 1
                 # reference slow-event watchdog
                 # (query_stage_scheduler.rs:378-389)
                 log.warning("%s: slow event %r took %.2fs", self.name, event, dt)

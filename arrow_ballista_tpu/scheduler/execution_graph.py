@@ -32,6 +32,7 @@ from ..ops.shuffle import (
     ShuffleWritePartition,
     ShuffleWriterExec,
 )
+from ..obs.stats import RuntimeStatsStore
 from ..utils.errors import InternalError
 from .planner import (
     DistributedPlanner,
@@ -167,6 +168,13 @@ class ExecutionStage:
         for t in self.task_infos:
             st = getattr(t, "status", None)
             if st is None:
+                continue
+            # attempt-aware dedup: only the recorded winner's own status
+            # counts — a terminal status absorbed from a cancelled
+            # speculative loser carries a different task_attempt and must
+            # not add its (cumulative) snapshot to the fold
+            st_att = getattr(getattr(st, "task", None), "task_attempt", None)
+            if st_att is not None and st_att != getattr(t, "attempt", st_att):
                 continue
             dst = per_exec.setdefault(
                 getattr(st, "process_id", "") or getattr(t, "executor_id", ""),
@@ -345,6 +353,12 @@ class ExecutionGraph:
         self.trace: Dict[str, str] = {}
         # executor_id -> (host, port) of the data plane; None = local-only
         self.addr_resolver = None
+        # live per-stage runtime summaries (skew, histograms, duration
+        # quantiles) — refolded on every task success, read by EXPLAIN
+        # ANALYZE, /api/job/<id>/stats, and future AQE.  Not checkpointed
+        # (serde.graph_to_obj is field-explicit): a recovered graph starts
+        # with an empty store and refills as its re-run stages complete.
+        self.stats = RuntimeStatsStore(job_id)
         self._task_id_gen = itertools.count()
         self.revive()
 
@@ -513,6 +527,9 @@ class ExecutionGraph:
                                stage.output_locations(self.addr_resolver)))
             else:
                 self.revive()
+        # refold AFTER the state transition so the final summary records the
+        # stage as successful (AQE and EXPLAIN ANALYZE read this live)
+        self.stats.fold_stage(stage)
 
     def _on_task_failed(self, stage: ExecutionStage, st: TaskStatus,
                         events: List[Tuple[str, object]]) -> None:

@@ -48,6 +48,13 @@ def graph_to_dot(graph: ExecutionGraph) -> str:
         extra = f" {launches} launches" if launches > stage.partitions else ""
         if spec:
             extra += f" ({spec} speculative)"
+        # folded runtime summary (obs/stats.py): rows/bytes shuffled and the
+        # partition skew coefficient, once the stage has completed tasks
+        summary = graph.stats.stage(sid) if hasattr(graph, "stats") else None
+        if summary is not None and summary["output_rows"]:
+            extra += (f" · {summary['output_rows']:,} rows"
+                      f" · {summary['output_bytes'] / 1048576.0:.1f} MB"
+                      f" · skew {summary['skew']:.2f}")
         lines.append(f"  subgraph cluster_{sid} {{")
         lines.append(f'    label="stage {sid} [{stage.state}] '
                      f'{done}/{stage.partitions} tasks '

@@ -54,6 +54,10 @@ class SchedulerMetricsCollector:
     def record_speculative_launched(self, job_id: str) -> None: ...
     def record_speculative_win(self, job_id: str) -> None: ...
     def record_integrity_failure(self, executor_id: str) -> None: ...
+    # event-loop saturation (scheduler/event_loop.py, sampled by the
+    # cluster-history thread)
+    def set_event_queue_depth(self, value: int) -> None: ...
+    def set_event_loop_lag(self, seconds: float) -> None: ...
     def gather(self) -> str:
         return ""
 
@@ -85,6 +89,8 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.speculative_launched = 0
         self.speculative_wins = 0
         self.integrity_failures = 0
+        self.event_queue_depth = 0
+        self.event_loop_lag_s = 0.0
 
     def record_submitted(self, job_id, queued_at_ms, submitted_at_ms):
         with self._lock:
@@ -143,6 +149,14 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.integrity_failures += 1
 
+    def set_event_queue_depth(self, value):
+        with self._lock:
+            self.event_queue_depth = value
+
+    def set_event_loop_lag(self, seconds):
+        with self._lock:
+            self.event_loop_lag_s = seconds
+
     def gather(self) -> str:
         with self._lock:
             lines = []
@@ -190,6 +204,16 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             lines.append("# TYPE admission_queue_depth_max gauge")
             lines.append(
                 f"admission_queue_depth_max {self.admission_queue_depth_max}")
+            lines.append("# HELP scheduler_event_queue_depth events waiting "
+                         "in the scheduler event loop")
+            lines.append("# TYPE scheduler_event_queue_depth gauge")
+            lines.append(
+                f"scheduler_event_queue_depth {self.event_queue_depth}")
+            lines.append("# HELP scheduler_event_loop_lag_seconds "
+                         "enqueue-to-dequeue lag of the most recent event")
+            lines.append("# TYPE scheduler_event_loop_lag_seconds gauge")
+            lines.append(
+                f"scheduler_event_loop_lag_seconds {self.event_loop_lag_s}")
             for name, h, help_ in [
                 ("planning_time_seconds", self.planning_time, "job planning time"),
                 ("job_exec_time_seconds", self.exec_time, "job execution time"),

@@ -378,6 +378,11 @@ class SchedulerNetService:
         stmt = parse_sql(payload["sql"])
         verbose = False
         if isinstance(stmt, sqlast.Explain):
+            if stmt.analyze:
+                raise PlanningError(
+                    "EXPLAIN ANALYZE is not supported over the wire: run "
+                    "the query, then read GET /api/job/<id>/stats on the "
+                    "scheduler's REST API for the same report")
             verbose = stmt.verbose
             stmt = stmt.statement
         return {"rows": explain_rows(catalog, config, stmt, verbose)}, b""

@@ -18,6 +18,11 @@ Beyond the reference surface:
     GET  /api/quarantine       quarantined/probation executors + counters
     GET  /api/job/<id>/profile per-stage -> per-task -> per-operator profile
     GET  /api/job/<id>/trace   Chrome trace-event JSON (Perfetto-loadable)
+    GET  /api/job/<id>/stats   EXPLAIN ANALYZE report: per-stage skew /
+                               histograms / duration quantiles + annotated
+                               operator tree (obs/stats.py)
+    GET  /api/cluster/history  ring-buffer time series of cluster samples
+                               (utilization, queue depths, event-loop lag)
 """
 from __future__ import annotations
 
@@ -27,6 +32,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..obs.stats import explain_analyze_report
 from .graph_dot import graph_to_dot
 from .scheduler import SchedulerServer
 
@@ -121,6 +127,16 @@ class RestApi:
                 h._send(404, json.dumps({"error": "no trace for job"}))
             else:
                 h._send(200, json.dumps(trace))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "stats":
+            graph = self.server.jobs.get_graph(rest[1])
+            if graph is None:
+                h._send(404, json.dumps({"error": "no such job"}))
+            else:
+                h._send(200, json.dumps(explain_analyze_report(graph)))
+        elif rest == ["cluster", "history"]:
+            hist = self.server.history.snapshot()
+            hist["now"] = self.server.cluster_sample()
+            h._send(200, json.dumps(hist))
         elif len(rest) == 3 and rest[0] == "job" and rest[2] == "dot":
             graph = self.server.jobs.get_graph(rest[1])
             if graph is None:

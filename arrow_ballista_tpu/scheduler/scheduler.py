@@ -145,7 +145,9 @@ class SchedulerConfig:
                  speculation_multiplier: Optional[float] = None,
                  speculation_min_runtime_s: Optional[float] = None,
                  speculation_max_concurrent: Optional[int] = None,
-                 speculation_interval_s: Optional[float] = None):
+                 speculation_interval_s: Optional[float] = None,
+                 stats_history_capacity: Optional[int] = None,
+                 stats_history_interval_s: Optional[float] = None):
         from ..utils.config import (BallistaConfig,
                                     CLUSTER_EXECUTOR_TIMEOUT_S,
                                     QUARANTINE_FAILURES,
@@ -155,7 +157,9 @@ class SchedulerConfig:
                                     SPECULATION_MAX_CONCURRENT,
                                     SPECULATION_MIN_RUNTIME_S,
                                     SPECULATION_MULTIPLIER,
-                                    SPECULATION_QUANTILE)
+                                    SPECULATION_QUANTILE,
+                                    STATS_HISTORY_CAPACITY,
+                                    STATS_HISTORY_INTERVAL_S)
 
         assert policy in ("push", "pull")  # reference TaskSchedulingPolicy
         defaults = BallistaConfig()
@@ -193,6 +197,14 @@ class SchedulerConfig:
             interval_s=float(speculation_interval_s
                              if speculation_interval_s is not None
                              else defaults.get(SPECULATION_INTERVAL_S)))
+        # cluster time-series sampler (obs/stats.py ClusterHistory): knobs
+        # default from the ballista.stats.* config-registry entries
+        self.stats_history_capacity = int(
+            stats_history_capacity if stats_history_capacity is not None
+            else defaults.get(STATS_HISTORY_CAPACITY))
+        self.stats_history_interval_s = float(
+            stats_history_interval_s if stats_history_interval_s is not None
+            else defaults.get(STATS_HISTORY_INTERVAL_S))
         self.reaper_interval_s = reaper_interval_s
         self.event_buffer_size = event_buffer_size
         self.policy = policy
@@ -214,7 +226,7 @@ class SchedulerServer:
                  cluster_state=None, observability=None):
         import uuid
 
-        from ..obs import JobObservability
+        from ..obs import ClusterHistory, JobObservability
         from .metrics import InMemoryMetricsCollector
 
         self.config = config or SchedulerConfig()
@@ -244,6 +256,12 @@ class SchedulerServer:
                                                thread_name_prefix="launch")
         self._reaper: Optional[threading.Thread] = None
         self._spec_monitor: Optional[threading.Thread] = None
+        self._history_sampler: Optional[threading.Thread] = None
+        # cluster time series behind GET /api/cluster/history: periodic
+        # utilization / queue-depth / event-loop-lag samples in a bounded
+        # ring buffer (obs/stats.py)
+        self.history = ClusterHistory(self.config.stats_history_capacity,
+                                      self.config.stats_history_interval_s)
         self._stopped = threading.Event()
         self._cleanup_timers: Dict[str, threading.Timer] = {}
         self._cleanup_lock = threading.Lock()
@@ -276,6 +294,10 @@ class SchedulerServer:
                 target=self._speculation_loop, name="speculation-monitor",
                 daemon=True)
             self._spec_monitor.start()
+        self._history_sampler = threading.Thread(
+            target=self._history_loop, name="cluster-history-sampler",
+            daemon=True)
+        self._history_sampler.start()
 
     def shutdown(self) -> None:
         # order matters: stop the event loop BEFORE closing the launch pool,
@@ -839,6 +861,46 @@ class SchedulerServer:
                     partition, executor_id, running_on)
                 self.metrics.record_speculative_launched(graph.job_id)
                 self._submit_work(self._launch, executor_id, [task])
+
+    # --- cluster time series (obs/stats.py ClusterHistory) ---------------
+    def cluster_sample(self) -> Dict:
+        """One utilization/saturation sample (pure read — also served fresh
+        as the ``now`` field of GET /api/cluster/history)."""
+        total = self.cluster.total_slots()
+        available = self.cluster.total_available()
+        ev = self._event_loop.stats()
+        return {
+            "ts": round(time.time(), 3),
+            "executors_alive": len(self.cluster.alive_executors(
+                self.config.executor_timeout_s)),
+            "executors_total": len(self.cluster.executors()),
+            "total_slots": total,
+            "available_slots": available,
+            "utilization": round((total - available) / total, 4)
+            if total else 0.0,
+            "pending_tasks": self.pending_task_count(),
+            "active_jobs": len(self.jobs.active_graphs()),
+            "admission_queue_depth": self.admission.queue_depth(),
+            "event_queue_depth": ev["queue_depth"],
+            "event_loop_lag_s": ev["last_lag_s"],
+            "event_loop_max_lag_s": ev["max_lag_s"],
+            "event_handler_seconds_mean": ev["handler_seconds_mean"],
+            "slow_events": ev["slow_events"],
+        }
+
+    def _history_loop(self) -> None:
+        """Sampler thread: appends a cluster sample to the ring buffer and
+        refreshes the event-loop gauges.  Not an event handler — blocking
+        waits are fine here (same idiom as ``_reap_loop``)."""
+        while not self._stopped.wait(self.config.stats_history_interval_s):
+            try:
+                sample = self.cluster_sample()
+            except Exception:  # noqa: BLE001 — sampling must outlive one bad read
+                log.exception("cluster history sampling failed")
+                continue
+            self.history.record(sample)
+            self.metrics.set_event_queue_depth(sample["event_queue_depth"])
+            self.metrics.set_event_loop_lag(sample["event_loop_lag_s"])
 
     # --- failure detection ----------------------------------------------
     def _reap_loop(self) -> None:

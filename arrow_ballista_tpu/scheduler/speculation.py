@@ -15,8 +15,9 @@ there, so all graph reads happen single-threaded (no locks, no sleeps).
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import List, Optional, Sequence, Tuple
+
+from ..obs.stats import nearest_rank_quantile
 
 
 @dataclasses.dataclass
@@ -42,12 +43,10 @@ def speculation_cutoff_s(durations: Sequence[float], quantile: float,
     nearest-rank method (q=0.75 over 4 samples -> 3rd smallest); the
     cutoff is ``max(quantile_duration * multiplier, min_runtime_s)``.
     """
-    if not durations:
+    base = nearest_rank_quantile(durations, quantile)
+    if base is None:
         return None
-    xs = sorted(durations)
-    q = min(max(float(quantile), 0.0), 1.0)
-    rank = max(1, int(math.ceil(q * len(xs))))
-    return max(xs[rank - 1] * float(multiplier), float(min_runtime_s))
+    return max(base * float(multiplier), float(min_runtime_s))
 
 
 def find_candidates(graph, now: float,

@@ -105,6 +105,7 @@ class StandaloneCluster:
             observability=JobObservability.from_config(self.config))
         self.launcher.scheduler = self.scheduler
         self.scheduler.init()
+        self.last_job_id: Optional[str] = None
         self.executors: List[Executor] = []
         for i in range(num_executors):
             meta = ExecutorMetadata(executor_id=f"executor-{i}",
@@ -144,6 +145,9 @@ class StandaloneCluster:
             scalars[sid] = extract_scalar(splan, scalar_ctx)
 
         job_id = random_job_id()
+        # remembered so explain_analyze can find the job's retained graph
+        # (and its RuntimeStatsStore) after execute() returns
+        self.last_job_id = job_id
         from ..admission import AdmissionRequest
         from ..obs import new_trace_context
 

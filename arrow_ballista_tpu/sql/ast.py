@@ -212,10 +212,13 @@ class SetVariable(Node):
 
 @dataclasses.dataclass
 class Explain(Node):
-    """EXPLAIN [VERBOSE] <select> — returns plan rows instead of results
-    (reference: DataFusion's EXPLAIN through ballista-cli)."""
+    """EXPLAIN [ANALYZE] [VERBOSE] <select> — returns plan rows instead of
+    results (reference: DataFusion's EXPLAIN through ballista-cli).  With
+    ANALYZE the query actually runs and the physical plan comes back
+    annotated with observed rows/bytes/time per operator (obs/stats.py)."""
     statement: Node
     verbose: bool = False
+    analyze: bool = False
 
 
 @dataclasses.dataclass

@@ -78,8 +78,16 @@ class Parser:
     def parse_statement(self) -> ast.Node:
         if self.at_kw("EXPLAIN"):
             self.expect_kw("EXPLAIN")
-            verbose = self.eat_kw("VERBOSE")
-            stmt = ast.Explain(self.parse_select(), verbose=bool(verbose))
+            analyze = verbose = False
+            while True:  # ANALYZE / VERBOSE accepted in either order
+                if self.eat_kw("ANALYZE"):
+                    analyze = True
+                elif self.eat_kw("VERBOSE"):
+                    verbose = True
+                else:
+                    break
+            stmt = ast.Explain(self.parse_select(), verbose=verbose,
+                               analyze=analyze)
         elif self.at_kw("SELECT"):
             stmt = self.parse_select()
         elif self.at_kw("CREATE"):

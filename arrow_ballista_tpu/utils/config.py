@@ -73,6 +73,9 @@ SPECULATION_MAX_CONCURRENT = "ballista.speculation.max_concurrent"
 SPECULATION_INTERVAL_S = "ballista.speculation.interval.seconds"
 # shuffle partition integrity (ops/shuffle.py + net/dataplane.py)
 SHUFFLE_INTEGRITY = "ballista.shuffle.integrity.verify"
+# runtime statistics observatory (obs/stats.py + scheduler sampler)
+STATS_HISTORY_CAPACITY = "ballista.stats.history.capacity"
+STATS_HISTORY_INTERVAL_S = "ballista.stats.history.interval.seconds"
 
 
 @dataclasses.dataclass
@@ -291,6 +294,12 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "deserialization; a mismatch raises a retryable "
                     "IntegrityError (re-fetch, then lineage rollback) "
                     "instead of decoding corrupt bytes"),
+        ConfigEntry(STATS_HISTORY_CAPACITY, 512, int,
+                    "ring-buffer capacity of the cluster time series behind "
+                    "GET /api/cluster/history (oldest samples are evicted)"),
+        ConfigEntry(STATS_HISTORY_INTERVAL_S, 5.0, float,
+                    "seconds between cluster-history samples (executor "
+                    "utilization, admission queue depth, event-loop lag)"),
     ]
 }
 

@@ -51,6 +51,9 @@ SCALE = float(os.environ.get("BENCH_SCALE", "1"))
 QUERIES = os.environ.get("BENCH_QUERIES", "1,6,3,5,18")
 MESH_QUERIES = os.environ.get("BENCH_MESH_QUERIES", "1,6,3")
 SF10_QUERIES = os.environ.get("BENCH_SF10_QUERIES", "1,3,5,18")
+# iteration knobs: drop to 1 to trade steady-state fidelity for budget
+ITERS = int(os.environ.get("BENCH_ITERS", "2"))
+SF10_ITERS = int(os.environ.get("BENCH_SF10_ITERS", "2"))
 DATA_DIR = os.environ.get(
     "BENCH_DATA", os.path.join(REPO, ".bench_data", f"tpch-sf{SCALE:g}")
 )
@@ -293,9 +296,38 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
             result["value"] = round(value, 1)
             result["vs_baseline"] = round(value / BASELINE_ROWS_PER_S, 4)
 
-    def run_queries(ctx, queries, label, dest, iters=2, rows=None, sf_label=None):
+    def _stage_breakdown(ctx):
+        """Compact per-stage runtime stats of the most recent job, read off
+        the graph's RuntimeStatsStore fold (obs/stats.py): rows/bytes
+        shuffled, partition skew, and task-duration p50/max.  Lands in the
+        bench JSON so a regression is attributable to a STAGE, not just a
+        query."""
+        try:
+            sa = ctx._standalone
+            graph = sa.scheduler.jobs.get_graph(sa.last_job_id)
+            if graph is None:
+                return {}
+            out = {}
+            for s in graph.stats.snapshot()["stages"]:
+                d = s["task_duration_s"]
+                out[f"s{s['stage_id']}"] = {
+                    "rows": s["output_rows"],
+                    "mb": round(s["output_bytes"] / 1048576.0, 2),
+                    "skew": s["skew"],
+                    "p50_s": d.get("p50", 0.0),
+                    "max_s": d.get("max", 0.0),
+                }
+            return out
+        except Exception as e:  # noqa: BLE001 — profiling must never kill a bench
+            return {"error": str(e)}
+
+    def run_queries(ctx, queries, label, dest, iters=ITERS, rows=None,
+                    sf_label=None, min_slack_s=60.0):
+        # min_slack_s: don't START a query with less than this left on the
+        # clock — SF10 legs pass a larger slack since one iteration there
+        # can run minutes (the BENCH_r05 rc=124 overrun)
         for q in queries:
-            if time.time() > deadline - 60:
+            if time.time() > deadline - min_slack_s:
                 dest[f"q{q}_skipped"] = "deadline"
                 print(f"[worker] {label} q{q} skipped: deadline", file=sys.stderr)
                 continue
@@ -309,6 +341,7 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
                     print(f"[worker] {label} q{q} iter{it}: {per[-1]*1000:.0f} ms "
                           f"({nrows} rows)", file=sys.stderr)
                 dest[f"q{q}_ms"] = round(min(per) * 1000, 1)
+                dest[f"q{q}_stages"] = _stage_breakdown(ctx)
                 print(f"[worker] {label} q{q} metrics: "
                       f"{json.dumps(_job_metrics(ctx))}", file=sys.stderr)
             except Exception as e:  # noqa: BLE001 — record, keep benching
@@ -330,6 +363,49 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
                            engine.get("q1_error", "not in BENCH_QUERIES"))
     else:
         result.pop("error", None)
+
+    # --- SF10 rider: the reference baseline IS SF10 (README.md:52-60) ---
+    # runs whenever a prior round generated the data, without making the
+    # headline depend on a 13-minute generation step.  Deliberately BEFORE
+    # the mesh and kernel-join legs: SF10 q1 is the headline metric, so it
+    # gets first claim on whatever budget remains (BENCH_r05 ran it last
+    # and timed out with no SF10 number at all)
+    sf10_dir = os.path.join(REPO, ".bench_data", "tpch-sf10")
+    if (SCALE == 1 and os.path.exists(os.path.join(sf10_dir, "lineitem.parquet"))
+            and time.time() < deadline - 180):
+        try:
+            _warm_cache([os.path.join(sf10_dir, "lineitem.parquet")], "sf10")
+            ctx10 = BallistaContext.standalone(
+                BallistaConfig(dict(base_config)), concurrent_tasks=4)
+            try:
+                register_tables(ctx10, sf10_dir)
+                rows10 = ctx10.catalog.provider("lineitem").row_count()
+                sf10 = result.setdefault("engine_sf10", {})
+                sf10_queries = [int(x) for x in SF10_QUERIES.split(",") if x.strip()]
+                # warm iterations (default 2): the warm number is the steady
+                # state the scan cache is designed for, and iter0 alone would
+                # publish conversion-cold walls (observed: q3 80 s cold vs
+                # 29 s warm).  min_slack 180 s: one SF10 iteration can run
+                # minutes, so don't start one that can't finish in budget.
+                run_queries(ctx10, [q for q in sf10_queries if q == 1],
+                            "sf10", sf10, iters=SF10_ITERS, min_slack_s=180)
+                q1_10 = sf10.get("q1_ms", 0.0) / 1000.0
+                if q1_10:
+                    sf10["q1_rows_per_sec"] = round(rows10 / q1_10, 1)
+                    sf10["vs_baseline_sf10"] = round(
+                        rows10 / q1_10 / BASELINE_ROWS_PER_S, 4)
+                    # the like-for-like datapoint becomes the headline; the
+                    # SF1 numbers stay in `engine`
+                    result["metric"] = "tpch_q1_sf10_engine_rows_per_sec"
+                    result["value"] = sf10["q1_rows_per_sec"]
+                    result["vs_baseline"] = sf10["vs_baseline_sf10"]
+                    emit("sf10-q1")
+                run_queries(ctx10, [q for q in sf10_queries if q != 1],
+                            "sf10", sf10, iters=SF10_ITERS, min_slack_s=180)
+            finally:
+                ctx10.shutdown()
+        except Exception as e:  # noqa: BLE001 — rider must not kill the run
+            result["engine_sf10"] = {"error": f"{type(e).__name__}: {e}"}
 
     # --- mesh path: same queries, ICI all_to_all shuffle ----------------
     # guarded end to end: a mesh-path failure must never discard the file
@@ -399,44 +475,6 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
         del pk, bk, pmask_j, bmask_j
         emit("kernel-join")
 
-    # --- SF10 rider: the reference baseline IS SF10 (README.md:52-60) ---
-    # runs whenever a prior round generated the data, without making the
-    # headline depend on a 13-minute generation step
-    sf10_dir = os.path.join(REPO, ".bench_data", "tpch-sf10")
-    if (SCALE == 1 and os.path.exists(os.path.join(sf10_dir, "lineitem.parquet"))
-            and time.time() < deadline - 600):
-        try:
-            _warm_cache([os.path.join(sf10_dir, "lineitem.parquet")], "sf10")
-            ctx10 = BallistaContext.standalone(
-                BallistaConfig(dict(base_config)), concurrent_tasks=4)
-            try:
-                register_tables(ctx10, sf10_dir)
-                rows10 = ctx10.catalog.provider("lineitem").row_count()
-                sf10 = result.setdefault("engine_sf10", {})
-                sf10_queries = [int(x) for x in SF10_QUERIES.split(",") if x.strip()]
-                # every rider query runs 2 iters: the warm number is the
-                # steady state the scan cache is designed for, and iter0
-                # alone would publish conversion-cold walls (observed: q3
-                # 80 s cold vs 29 s warm)
-                run_queries(ctx10, [q for q in sf10_queries if q == 1],
-                            "sf10", sf10, iters=2)
-                q1_10 = sf10.get("q1_ms", 0.0) / 1000.0
-                if q1_10:
-                    sf10["q1_rows_per_sec"] = round(rows10 / q1_10, 1)
-                    sf10["vs_baseline_sf10"] = round(
-                        rows10 / q1_10 / BASELINE_ROWS_PER_S, 4)
-                    # the like-for-like datapoint becomes the headline; the
-                    # SF1 numbers stay in `engine`
-                    result["metric"] = "tpch_q1_sf10_engine_rows_per_sec"
-                    result["value"] = sf10["q1_rows_per_sec"]
-                    result["vs_baseline"] = sf10["vs_baseline_sf10"]
-                    emit("sf10-q1")
-                run_queries(ctx10, [q for q in sf10_queries if q != 1],
-                            "sf10", sf10, iters=2)
-            finally:
-                ctx10.shutdown()
-        except Exception as e:  # noqa: BLE001 — rider must not kill the run
-            result["engine_sf10"] = {"error": f"{type(e).__name__}: {e}"}
     emit("done")
 
 
@@ -462,7 +500,12 @@ class WorkerProc:
         self.log_path = os.path.join(LOG_DIR, f"attempt-{stamp}-{platform}{tag}.log")
         self.out_path = self.log_path + ".stdout"
         self.err_path = self.log_path + ".stderr"
-        self.init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "600"))
+        # the init watchdog can never exceed the attempt budget itself —
+        # under a tight total budget a 600 s init allowance would let one
+        # hung backend-init eat the whole run (the BENCH_r05 overrun)
+        self.init_timeout = min(
+            float(os.environ.get("BENCH_INIT_TIMEOUT", "600")),
+            max(60.0, timeout - 30.0))
         self.t0 = time.time()
         self.timed_out: str | None = None
         self.result: dict | None = None
@@ -588,9 +631,14 @@ def main() -> None:
 
     ensure_data()
 
-    total_budget = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "5400"))
+    # default budget fits the 870 s tier-1 harness with margin (BENCH_r05
+    # died at rc=124: the old 5400 s default let the TPU retry loop outlive
+    # the external timeout even after the CPU worker had finished).  Longer
+    # local runs: BENCH_TOTAL_TIMEOUT=5400 restores the old behavior.
+    total_budget = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "780"))
     tpu_budget = float(os.environ.get("BENCH_TPU_TIMEOUT", str(total_budget - 120)))
-    cpu_budget = float(os.environ.get("BENCH_CPU_TIMEOUT", "2700"))
+    cpu_budget = float(os.environ.get("BENCH_CPU_TIMEOUT",
+                                      str(total_budget - 60)))
     t_start = time.time()
     hard_deadline = t_start + total_budget
     os.makedirs(LOG_DIR, exist_ok=True)

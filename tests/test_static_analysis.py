@@ -135,6 +135,46 @@ def test_span_coverage_fires_and_accepts_compliant_shapes(tmp_path):
     assert found[0].line == 2 and "Unwrapped.execute" in found[0].message
 
 
+def test_span_coverage_checks_stats_emitting_helpers(tmp_path):
+    """PR 6 extension: operator-signature methods that emit stats
+    (self.metrics() / deferred_rows) outside execute* are span-checked
+    too, unless reached from a spanning entry point in the module."""
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/statops.py", """\
+        class LeakyStats:
+            def refresh(self, partition, ctx):
+                self.metrics().add("recompiles", 1)
+                return []
+
+        class SpannedStats:
+            def refresh(self, partition, ctx):
+                with ctx.op_span(self):
+                    self.metrics().add("recompiles", 1)
+
+        class HelperReached:
+            def execute(self, partition, ctx):
+                with ctx.op_span(self):
+                    return self._fold(partition, ctx)
+
+            def _fold(self, partition, ctx):
+                deferred_rows(self.metrics(), "output_rows", [])
+                return []
+
+        class OverrideReached(HelperReached):
+            def _fold(self, partition, ctx):
+                self.metrics().add("recompiles", 1)
+                return []
+
+        class NoStats:
+            def transform(self, partition, ctx):
+                return []
+        """)
+    found = lint(tmp_path, "span-coverage")
+    assert len(found) == 1
+    assert found[0].line == 2
+    assert "LeakyStats.refresh" in found[0].message
+    assert "emits operator metrics" in found[0].message
+
+
 def test_serde_completeness_fires_and_respects_suppression(tmp_path):
     write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/types.py", """\
         import dataclasses

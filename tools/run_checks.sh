@@ -2,9 +2,10 @@
 # CI entrypoint for the repository's consistency checks:
 #   1. the static-analysis lint suite (AST rules + metrics-docs),
 #   2. generated-docs freshness (docs/user-guide/configs.md),
-#   3. the static-analysis + wire-serde + speculation test files (rule
-#      fixtures, plan-validator cases, exhaustive wire round-trips,
-#      speculation policy math and attempt-dedup races),
+#   3. the static-analysis + wire-serde + speculation + observability
+#      test files (rule fixtures, plan-validator cases, exhaustive wire
+#      round-trips, speculation policy math and attempt-dedup races,
+#      runtime-stats folding / EXPLAIN ANALYZE / cluster history),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
 #      quarantine, straggler speculation, corrupt-shuffle checksums) —
@@ -23,9 +24,10 @@ python -m arrow_ballista_tpu.analysis
 echo "== generated docs up to date =="
 python docs/gen_configs.py --check
 
-echo "== analysis + serde + speculation test files =="
+echo "== analysis + serde + speculation + observability test files =="
 python -m pytest tests/test_static_analysis.py tests/test_serde_wire.py \
-    tests/test_speculation.py -q -p no:cacheprovider
+    tests/test_speculation.py tests/test_observatory.py \
+    -q -p no:cacheprovider
 
 echo "== chaos recovery suite (-m chaos) =="
 python -m pytest tests/test_chaos.py -q -m chaos -p no:cacheprovider
