@@ -16,7 +16,12 @@ from .framework import (
     run_lints,
     text_report,
 )
-from .plan_checks import check_graph, validate_graph
+from .plan_checks import (
+    check_graph,
+    check_rewritten_stage,
+    validate_graph,
+    validate_rewrite,
+)
 
 __all__ = [
     "Project",
@@ -25,9 +30,11 @@ __all__ = [
     "Violation",
     "all_rules",
     "check_graph",
+    "check_rewritten_stage",
     "json_report",
     "register",
     "run_lints",
     "text_report",
     "validate_graph",
+    "validate_rewrite",
 ]

@@ -34,7 +34,11 @@ from ..ops.operators import (
     SortExec,
 )
 from ..ops.physical import ExecutionPlan, Partitioning
-from ..ops.shuffle import ShuffleWriterExec, UnresolvedShuffleExec
+from ..ops.shuffle import (
+    ShuffleReaderExec,
+    ShuffleWriterExec,
+    UnresolvedShuffleExec,
+)
 from ..utils.errors import PlanValidationError
 
 PASS_THROUGH = (FilterExec, SortExec, LimitExec, CoalescePartitionsExec,
@@ -146,6 +150,11 @@ def check_graph(graph) -> List[str]:
         for node in _walk(stage.plan):
             if not isinstance(node, JoinExec):
                 continue
+            if node.dist == "broadcast":
+                # the build side is read in full by every probe partition;
+                # co-partitioning is not required (an AQE broadcast switch
+                # legitimately leaves the two inputs partitioned apart)
+                continue
             kids = node.children()
             if len(kids) != 2:
                 continue
@@ -187,4 +196,89 @@ def check_graph(graph) -> List[str]:
                     f"child's schema ({kids[0].schema.names()} -> "
                     f"{node.schema.names()}) but is a pass-through operator")
 
+    return errors
+
+
+# --------------------------------------------------------------------------
+# AQE rewrite re-validation (scheduler/aqe.py calls this after every
+# runtime mutation of the graph; a failure here means the rewrite itself
+# is buggy, so it raises instead of letting a corrupt plan launch tasks)
+# --------------------------------------------------------------------------
+
+def validate_rewrite(graph, stage, prior_schema) -> None:
+    """Raise ``PlanValidationError`` if a runtime rewrite of ``stage``
+    broke a graph invariant.  ``prior_schema`` is the stage root's schema
+    before the rewrite (None skips the schema comparison, e.g. for a
+    broadcast flip that by construction preserves it)."""
+    errors = check_rewritten_stage(graph, stage, prior_schema)
+    if errors:
+        raise PlanValidationError(graph.job_id, errors)
+
+
+def check_rewritten_stage(graph, stage, prior_schema) -> List[str]:
+    """Like ``validate_rewrite`` but returns the error list.
+
+    Stage-local checks run on the stage's live plan (resolved or not):
+    the rewrite must not change the stage's output schema, its partition
+    bookkeeping must agree with the plan, and every shuffle reader's
+    location keys must fit its partition count.  Graph-wide checks catch
+    dangling edges a bad exchange graft would leave behind: orphaned
+    stages, missing producers, and producer/consumer link asymmetry."""
+    errors: List[str] = []
+    plan = stage.resolved_plan if stage.resolved_plan is not None else stage.plan
+
+    if prior_schema is not None and plan.schema != prior_schema:
+        errors.append(
+            f"stage {stage.stage_id}: rewrite changed the output schema "
+            f"({prior_schema.names()} -> {plan.schema.names()})")
+    if plan.output_partition_count() != stage.partitions:
+        errors.append(
+            f"stage {stage.stage_id}: rewrite left the stage bookkeeping "
+            f"at {stage.partitions} partitions but the plan produces "
+            f"{plan.output_partition_count()}")
+    if len(stage.task_infos) != stage.partitions:
+        errors.append(
+            f"stage {stage.stage_id}: task slots ({len(stage.task_infos)}) "
+            f"disagree with the partition count ({stage.partitions})")
+    if len(stage.task_failures) < stage.partitions \
+            or len(stage.task_attempts) < stage.partitions:
+        errors.append(
+            f"stage {stage.stage_id}: attempt/failure budgets are shorter "
+            f"than the partition count ({stage.partitions})")
+    for node in _walk(plan):
+        if isinstance(node, ShuffleReaderExec):
+            bad = sorted(q for q in node.locations
+                         if not 0 <= q < node.partition_count)
+            if bad:
+                errors.append(
+                    f"stage {stage.stage_id}: shuffle reader of stage "
+                    f"{node.stage_id} holds locations for partitions "
+                    f"{bad} outside its partition count "
+                    f"{node.partition_count}")
+
+    # graph-wide link integrity (an exchange graft edits three stages)
+    stages = graph.stages
+    reachable = set()
+    frontier = [graph.final_stage_id] if graph.final_stage_id in stages else []
+    while frontier:
+        sid = frontier.pop()
+        if sid in reachable:
+            continue
+        reachable.add(sid)
+        frontier.extend(p for p in stages[sid].producer_ids if p in stages)
+    for sid in sorted(set(stages) - reachable):
+        errors.append(f"orphan stage {sid} after rewrite: unreachable from "
+                      f"final stage {graph.final_stage_id}")
+    for sid, s in sorted(stages.items()):
+        for pid in s.producer_ids:
+            if pid not in stages:
+                errors.append(f"stage {sid} reads producer stage {pid} "
+                              f"which is no longer in the graph")
+            elif sid not in stages[pid].output_links:
+                errors.append(f"stage {sid} reads stage {pid} but is "
+                              f"missing from its output links")
+        for cid in s.output_links:
+            if cid not in stages:
+                errors.append(f"stage {sid} feeds stage {cid} which is no "
+                              f"longer in the graph")
     return errors

@@ -53,6 +53,9 @@ KNOWN_SITES = frozenset({
     "shuffle.fetch.recv",           # net/dataplane.py, per fetch attempt
     "scheduler.heartbeat.receive",  # scheduler/netservice.py handler
     "scheduler.status.receive",     # scheduler/netservice.py handler
+    "scheduler.aqe.before_rewrite",  # scheduler/aqe.py, between an AQE
+                                     # rewrite decision and the graph
+                                     # mutation (drop => skip the rewrite)
 })
 
 ACTIONS = frozenset({"raise", "delay", "drop", "corrupt", "kill"})

@@ -131,6 +131,10 @@ def stage_summary(stage) -> Dict:
         "row_histogram": row_histogram(rows_list),
         "task_duration_s": duration_quantiles(list(stage.durations)),
         "operators": stage.operator_metrics(),
+        # runtime rewrites applied to this stage (scheduler/aqe.py):
+        # coalesce / skew-split / broadcast records with before/after
+        # partition counts
+        "aqe": [dict(r) for r in getattr(stage, "aqe_rewrites", [])],
     }
 
 
@@ -242,6 +246,13 @@ def _stage_header(s: Dict) -> str:
     ]
     if s.get("speculative_launches"):
         bits.append(f"{s['speculative_launches']} speculative")
+    for r in s.get("aqe") or ():
+        kinds = "+".join(r.get("kinds", ())) or "rewrite"
+        if "partitions_before" in r:
+            bits.append(f"aqe {kinds} {r['partitions_before']}->"
+                        f"{r['partitions_after']}")
+        else:
+            bits.append(f"aqe {kinds}")
     if dur.get("count"):
         bits.append(f"task p50 {dur['p50']:.3f}s p95 {dur['p95']:.3f}s "
                     f"max {dur['max']:.3f}s")
@@ -325,6 +336,7 @@ def local_explain_report(plan, wall_time_ms: float = 0.0,
         "row_histogram": row_histogram([]),
         "task_duration_s": duration_quantiles([]),
         "operators": op_metrics,
+        "aqe": [],
         "operator_tree": annotate_plan(plan, op_metrics),
     }
     report = {

@@ -34,6 +34,7 @@ from ..ops.shuffle import (
 )
 from ..obs.stats import RuntimeStatsStore
 from ..utils.errors import InternalError
+from .aqe import AqePolicy, maybe_broadcast_switch, rewrite_resolved_stage
 from .planner import (
     DistributedPlanner,
     QueryStage,
@@ -110,6 +111,9 @@ class ExecutionStage:
         self._attempt_index: Dict[Tuple[int, int, int], dict] = {}
         # map partition -> (executor_id, [ShuffleWritePartition])
         self.outputs: Dict[int, Tuple[str, List[ShuffleWritePartition]]] = {}
+        # AQE rewrite records applied to this stage (scheduler/aqe.py);
+        # append-only, entries carry their stage_attempt epoch
+        self.aqe_rewrites: List[dict] = []
 
     # --- attempt bookkeeping ---------------------------------------------
     def new_attempt(self, partition: int, executor_id: str,
@@ -359,6 +363,13 @@ class ExecutionGraph:
         # (serde.graph_to_obj is field-explicit): a recovered graph starts
         # with an empty store and refills as its re-run stages complete.
         self.stats = RuntimeStatsStore(job_id)
+        # adaptive query execution (scheduler/aqe.py): per-job policy (the
+        # scheduler overwrites it from the session config right after
+        # build), the flat rewrite log (bench/REST/serde), and the pending
+        # metric events the scheduler drains into its collector
+        self.aqe = AqePolicy()
+        self.aqe_log: List[dict] = []
+        self.aqe_events: List[Tuple[str, int]] = []
         self._task_id_gen = itertools.count()
         self.revive()
 
@@ -381,7 +392,14 @@ class ExecutionGraph:
                 stage.resolved_plan = remove_unresolved_shuffles(stage.plan, locations) \
                     if stage.producer_ids else stage.plan
                 if stage.producer_ids:
-                    stage.maybe_coalesce()
+                    if self.aqe.enabled:
+                        # dynamic coalescing + skew splitting off the
+                        # observed shuffle sizes (subsumes the static
+                        # heuristic below, which stays byte-identical for
+                        # ballista.aqe.enabled=false)
+                        rewrite_resolved_stage(self, stage, self.aqe)
+                    else:
+                        stage.maybe_coalesce()
                 stage.state = RUNNING
                 changed = True
         return changed
@@ -519,17 +537,24 @@ class ExecutionGraph:
                                        speculative=st.task.speculative,
                                        started_at=started)
         stage.outputs[p] = (st.executor_id, list(st.shuffle_writes))
-        if stage.all_successful() and stage.state == RUNNING:
+        completed = stage.all_successful() and stage.state == RUNNING
+        if completed:
             stage.state = SUCCESSFUL
+        # refold AFTER the state transition (the final summary must record
+        # the stage as successful) and BEFORE downstream stages resolve:
+        # the AQE passes read the completed stage's folded stats
+        self.stats.fold_stage(stage)
+        if completed:
             if stage.stage_id == self.final_stage_id:
                 self.status = "successful"
                 events.append(("job_successful",
                                stage.output_locations(self.addr_resolver)))
             else:
+                # broadcast-switch pass first: a flipped join changes what
+                # revive() resolves (and may graft away an exchange whose
+                # cancellations ride out on ``events``)
+                maybe_broadcast_switch(self, stage, events, self.aqe)
                 self.revive()
-        # refold AFTER the state transition so the final summary records the
-        # stage as successful (AQE and EXPLAIN ANALYZE read this live)
-        self.stats.fold_stage(stage)
 
     def _on_task_failed(self, stage: ExecutionStage, st: TaskStatus,
                         events: List[Tuple[str, object]]) -> None:

@@ -55,6 +55,15 @@ def graph_to_dot(graph: ExecutionGraph) -> str:
             extra += (f" · {summary['output_rows']:,} rows"
                       f" · {summary['output_bytes'] / 1048576.0:.1f} MB"
                       f" · skew {summary['skew']:.2f}")
+        # adaptive rewrites applied to this stage, with before/after
+        # partition counts (scheduler/aqe.py)
+        for r in getattr(stage, "aqe_rewrites", ()):
+            kinds = "+".join(r.get("kinds", ())) or "rewrite"
+            if "partitions_before" in r:
+                extra += (f" · aqe {kinds} {r['partitions_before']}->"
+                          f"{r['partitions_after']}")
+            else:
+                extra += f" · aqe {kinds}"
         lines.append(f"  subgraph cluster_{sid} {{")
         lines.append(f'    label="stage {sid} [{stage.state}] '
                      f'{done}/{stage.partitions} tasks '

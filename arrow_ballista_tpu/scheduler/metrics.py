@@ -54,6 +54,10 @@ class SchedulerMetricsCollector:
     def record_speculative_launched(self, job_id: str) -> None: ...
     def record_speculative_win(self, job_id: str) -> None: ...
     def record_integrity_failure(self, executor_id: str) -> None: ...
+    # adaptive query execution (scheduler/aqe.py)
+    def record_aqe_coalesce(self, partitions: int) -> None: ...
+    def record_aqe_broadcast_switch(self, joins: int) -> None: ...
+    def record_aqe_skew_split(self, partitions: int) -> None: ...
     # event-loop saturation (scheduler/event_loop.py, sampled by the
     # cluster-history thread)
     def set_event_queue_depth(self, value: int) -> None: ...
@@ -89,6 +93,9 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.speculative_launched = 0
         self.speculative_wins = 0
         self.integrity_failures = 0
+        self.aqe_coalesced = 0
+        self.aqe_broadcast_switches = 0
+        self.aqe_skew_splits = 0
         self.event_queue_depth = 0
         self.event_loop_lag_s = 0.0
 
@@ -149,6 +156,18 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.integrity_failures += 1
 
+    def record_aqe_coalesce(self, partitions):
+        with self._lock:
+            self.aqe_coalesced += partitions
+
+    def record_aqe_broadcast_switch(self, joins):
+        with self._lock:
+            self.aqe_broadcast_switches += joins
+
+    def record_aqe_skew_split(self, partitions):
+        with self._lock:
+            self.aqe_skew_splits += partitions
+
     def set_event_queue_depth(self, value):
         with self._lock:
             self.event_queue_depth = value
@@ -188,6 +207,16 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                     self.integrity_failures,
                     "shuffle partitions that failed checksum/decode "
                     "verification after fetch retries")
+            counter("aqe_coalesced_partitions_total", self.aqe_coalesced,
+                    "planned reduce partitions merged away by adaptive "
+                    "partition coalescing")
+            counter("aqe_broadcast_switches_total",
+                    self.aqe_broadcast_switches,
+                    "partitioned joins flipped to broadcast at runtime "
+                    "after their build side measured small")
+            counter("aqe_skew_splits_total", self.aqe_skew_splits,
+                    "hot partitions split into multiple tasks by adaptive "
+                    "skew mitigation")
             lines.append("# HELP quarantined_executors executors currently "
                          "quarantined (no new offers)")
             lines.append("# TYPE quarantined_executors gauge")

@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..admission import AdmissionController, AdmissionRequest
 from ..analysis.plan_checks import validate_graph
 from ..utils.config import ANALYSIS_PLAN_CHECKS
+from .aqe import AqePolicy
 from .cluster import ClusterState, JobState
 from .event_loop import EventLoop
 from .execution_graph import ExecutionGraph
@@ -475,6 +476,9 @@ class SchedulerServer:
                     # pre-launch sanity validation (analysis/plan_checks.py):
                     # reject broken stage wiring before any task runs
                     validate_graph(graph)
+                # runtime re-optimization knobs for this job's lifetime
+                # (ballista.aqe.*, defaults apply when no session config)
+                graph.aqe = AqePolicy.from_config(cfg)
                 graph.scalars = scalars
                 graph.addr_resolver = self._resolve_addr
                 self._event_loop.post(JobPlanned(ev.job_id, graph))
@@ -545,6 +549,9 @@ class SchedulerServer:
         self.quarantine.remove(ev.executor_id)
         for graph in self.jobs.active_graphs():
             graph.executor_lost(ev.executor_id)
+            # rolled-back stages re-resolve inside executor_lost, which may
+            # re-apply AQE rewrites — surface their metric events too
+            self._drain_aqe_events(graph)
         self._offer()
 
     def _on_job_cancel(self, ev: JobCancel) -> None:
@@ -767,8 +774,25 @@ class SchedulerServer:
                 self._queued_at_ms.pop(job_id, None)
                 self._cancel_running(graph)
                 self._schedule_job_data_cleanup(graph)
+        self._drain_aqe_events(graph)
         if not checkpointed:
             self._checkpoint(graph)
+
+    def _drain_aqe_events(self, graph) -> None:
+        """Fold the graph's buffered AQE rewrite events into the metrics
+        collector (rewrites happen inside graph mutation, which has no
+        collector handle; the scheduler drains after every absorb)."""
+        events = getattr(graph, "aqe_events", None)
+        if not events:
+            return
+        for kind, n in events:
+            if kind == "coalesce":
+                self.metrics.record_aqe_coalesce(n)
+            elif kind == "broadcast":
+                self.metrics.record_aqe_broadcast_switch(n)
+            elif kind == "skew":
+                self.metrics.record_aqe_skew_split(n)
+        events.clear()
 
     def _resolve_addr(self, executor_id: str):
         meta = self.cluster.get_executor(executor_id)

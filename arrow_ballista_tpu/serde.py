@@ -285,11 +285,17 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
                 "partitioning": partitioning_to_obj(p.partitioning),
                 "stage_id": p.stage_id}
     if isinstance(p, SH.ShuffleReaderExec):
-        return {"t": "shuffleread", "stage_id": p.stage_id,
-                "schema": schema_to_obj(p.schema),
-                "partition_count": p.partition_count,
-                "locations": {str(k): [location_to_obj(l) for l in v]
-                              for k, v in p.locations.items()}}
+        out = {"t": "shuffleread", "stage_id": p.stage_id,
+               "schema": schema_to_obj(p.schema),
+               "partition_count": p.partition_count,
+               "locations": {str(k): [location_to_obj(l) for l in v]
+                             for k, v in p.locations.items()}}
+        # adaptive coalescing/skew rewrites remap the reader; a recovered
+        # graph must be able to roll it back to the PLANNED partitioning
+        orig = getattr(p, "_orig_partition_count", None)
+        if orig is not None:
+            out["orig_partition_count"] = orig
+        return out
     if isinstance(p, SH.UnresolvedShuffleExec):
         return {"t": "unresolvedshuffle", "stage_id": p.stage_id,
                 "schema": schema_to_obj(p.schema),
@@ -392,10 +398,13 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
                                     partitioning_from_obj(o["partitioning"]),
                                     stage_id=o["stage_id"])
     if t == "shuffleread":
-        return SH.ShuffleReaderExec(
+        reader = SH.ShuffleReaderExec(
             o["stage_id"], schema_from_obj(o["schema"]), o["partition_count"],
             {int(k): [location_from_obj(l) for l in v]
              for k, v in o["locations"].items()})
+        if o.get("orig_partition_count") is not None:
+            reader._orig_partition_count = o["orig_partition_count"]
+        return reader
     if t == "unresolvedshuffle":
         return SH.UnresolvedShuffleExec(o["stage_id"], schema_from_obj(o["schema"]),
                                         o["partition_count"])
@@ -426,13 +435,23 @@ def graph_to_obj(graph) -> dict:
             "stage_attempt": s.stage_attempt,
             "failures": s.failures,
             "task_failures": list(s.task_failures),
+            # AQE rewrites change the live partition count away from the
+            # planner-derived one; a recovered graph must resume with the
+            # MUTATED shape, not re-derive the original from the plan
+            "partitions": s.partitions,
+            "orig_partitions": getattr(s, "_orig_partitions", None),
+            "aqe_rewrites": [dict(r) for r in getattr(s, "aqe_rewrites", [])],
             "successes": {
                 str(p): {"executor_id": ex,
                          "writes": [vars(w) for w in writes]}
                 for p, (ex, writes) in s.outputs.items()},
         })
+    import dataclasses as _dc
+    aqe = getattr(graph, "aqe", None)
     return {"job_id": graph.job_id, "status": graph.status,
             "error": graph.error, "scalars": dict(graph.scalars),
+            "aqe": _dc.asdict(aqe) if aqe is not None else None,
+            "aqe_log": [dict(r) for r in getattr(graph, "aqe_log", [])],
             "stages": stages}
 
 
@@ -463,6 +482,10 @@ def graph_from_obj(o: dict):
     graph.status = o["status"]
     graph.error = o.get("error", "")
     graph.scalars = dict(o.get("scalars", {}))
+    if o.get("aqe") is not None:
+        from .scheduler.aqe import AqePolicy
+        graph.aqe = AqePolicy(**o["aqe"])
+    graph.aqe_log = [dict(r) for r in o.get("aqe_log", [])]
     for sid, (st, plan_resolved) in meta.items():
         stage = graph.stages[sid]
         stage.state = st["state"]
@@ -471,7 +494,21 @@ def graph_from_obj(o: dict):
         stage.task_failures = list(st["task_failures"])
         if plan_resolved is not None and stage.state in (RUNNING, SUCCESSFUL):
             stage.resolved_plan = plan_resolved
+        # AQE rewrites mutate the live partition count; resume with the
+        # checkpointed shape, not the planner-derived one (pre-AQE
+        # checkpoints carry neither key and keep the constructor's count)
+        if st.get("partitions") is not None:
+            stage.partitions = st["partitions"]
+        if st.get("orig_partitions") is not None:
+            stage._orig_partitions = st["orig_partitions"]
+        stage.aqe_rewrites = [dict(r) for r in st.get("aqe_rewrites", [])]
         stage.task_infos = [None] * stage.partitions
+        if len(stage.task_attempts) < stage.partitions:
+            stage.task_attempts.extend(
+                [0] * (stage.partitions - len(stage.task_attempts)))
+        if len(stage.task_failures) < stage.partitions:
+            stage.task_failures.extend(
+                [0] * (stage.partitions - len(stage.task_failures)))
         for p_str, rec in st["successes"].items():
             p = int(p_str)
             stage.outputs[p] = (rec["executor_id"],

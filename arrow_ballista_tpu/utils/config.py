@@ -71,6 +71,16 @@ SPECULATION_MULTIPLIER = "ballista.speculation.multiplier"
 SPECULATION_MIN_RUNTIME_S = "ballista.speculation.min_runtime.seconds"
 SPECULATION_MAX_CONCURRENT = "ballista.speculation.max_concurrent"
 SPECULATION_INTERVAL_S = "ballista.speculation.interval.seconds"
+# adaptive query execution (scheduler/aqe.py + execution_graph.py)
+AQE_ENABLED = "ballista.aqe.enabled"
+AQE_COALESCE_ENABLED = "ballista.aqe.coalesce.enabled"
+AQE_COALESCE_TARGET_ROWS = "ballista.aqe.coalesce.target.rows"
+AQE_COALESCE_TARGET_BYTES = "ballista.aqe.coalesce.target.bytes"
+AQE_BROADCAST_ENABLED = "ballista.aqe.broadcast.enabled"
+AQE_BROADCAST_THRESHOLD_ROWS = "ballista.aqe.broadcast.threshold.rows"
+AQE_SKEW_ENABLED = "ballista.aqe.skew.enabled"
+AQE_SKEW_FACTOR = "ballista.aqe.skew.factor"
+AQE_SKEW_MIN_ROWS = "ballista.aqe.skew.min.rows"
 # shuffle partition integrity (ops/shuffle.py + net/dataplane.py)
 SHUFFLE_INTEGRITY = "ballista.shuffle.integrity.verify"
 # runtime statistics observatory (obs/stats.py + scheduler sampler)
@@ -288,6 +298,48 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(SPECULATION_INTERVAL_S, 1.0, float,
                     "seconds between speculation-monitor scans of running "
                     "tasks"),
+        ConfigEntry(AQE_ENABLED, True, _parse_bool,
+                    "adaptive query execution: re-optimize not-yet-resolved "
+                    "downstream stages from the observed shuffle statistics "
+                    "of completed producers (dynamic partition coalescing, "
+                    "shuffle-join -> broadcast switch, skew splitting).  "
+                    "False freezes the plan at submit time, today's "
+                    "behavior; results are identical either way (see "
+                    "docs/user-guide/aqe.md)"),
+        ConfigEntry(AQE_COALESCE_ENABLED, True, _parse_bool,
+                    "AQE rewrite 1: merge tiny reduce partitions of an "
+                    "unresolved stage up to the coalesce targets so a "
+                    "many-task stage over a few thousand rows launches a "
+                    "handful of tasks instead"),
+        ConfigEntry(AQE_COALESCE_TARGET_ROWS, 8192, int,
+                    "coalesced-partition target size in observed rows; "
+                    "adjacent partitions merge while the merged group stays "
+                    "at or under this (0 disables the row target)"),
+        ConfigEntry(AQE_COALESCE_TARGET_BYTES, 1 << 20, int,
+                    "coalesced-partition target size in observed shuffle "
+                    "bytes; a merged group must also stay at or under this "
+                    "(0 disables the byte target)"),
+        ConfigEntry(AQE_BROADCAST_ENABLED, True, _parse_bool,
+                    "AQE rewrite 2: when a completed stage's actual shuffle "
+                    "output is under the broadcast threshold, flip the "
+                    "downstream partitioned join that consumes it to a "
+                    "broadcast join and graft away the probe side's "
+                    "now-unnecessary exchange where the plan allows"),
+        ConfigEntry(AQE_BROADCAST_THRESHOLD_ROWS, 4_000_000, int,
+                    "observed build-side rows at or under which the "
+                    "broadcast switch fires (mirrors the planner's "
+                    "estimate-based ballista.join.broadcast_threshold)"),
+        ConfigEntry(AQE_SKEW_ENABLED, True, _parse_bool,
+                    "AQE rewrite 3: split a hot reduce partition into "
+                    "several tasks, each reading a sub-range of the "
+                    "producer's map outputs"),
+        ConfigEntry(AQE_SKEW_FACTOR, 4.0, float,
+                    "a partition is 'hot' when its observed rows exceed "
+                    "factor x the mean partition rows of the stage"),
+        ConfigEntry(AQE_SKEW_MIN_ROWS, 1_000_000, int,
+                    "never skew-split a partition smaller than this many "
+                    "observed rows (protects small stages from pointless "
+                    "task fan-out)"),
         ConfigEntry(SHUFFLE_INTEGRITY, True, _parse_bool,
                     "verify the producer-recorded CRC-32 checksum of every "
                     "remotely fetched shuffle partition before "

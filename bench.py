@@ -321,6 +321,25 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
         except Exception as e:  # noqa: BLE001 — profiling must never kill a bench
             return {"error": str(e)}
 
+    def _aqe_decisions(ctx):
+        """The most recent job's adaptive-rewrite decisions (scheduler/
+        aqe.py's graph.aqe_log): which stages were coalesced / switched to
+        broadcast / skew-split, with before/after partition counts.  Lands
+        next to the stage breakdown so a perf delta is attributable to a
+        plan DECISION, not just a stage."""
+        try:
+            sa = ctx._standalone
+            graph = sa.scheduler.jobs.get_graph(sa.last_job_id)
+            if graph is None:
+                return []
+            return [{"stage": r["stage_id"],
+                     "kinds": list(r.get("kinds", ())),
+                     "before": r.get("partitions_before"),
+                     "after": r.get("partitions_after")}
+                    for r in getattr(graph, "aqe_log", [])]
+        except Exception as e:  # noqa: BLE001 — profiling must never kill a bench
+            return [{"error": str(e)}]
+
     def run_queries(ctx, queries, label, dest, iters=ITERS, rows=None,
                     sf_label=None, min_slack_s=60.0):
         # min_slack_s: don't START a query with less than this left on the
@@ -342,6 +361,7 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
                           f"({nrows} rows)", file=sys.stderr)
                 dest[f"q{q}_ms"] = round(min(per) * 1000, 1)
                 dest[f"q{q}_stages"] = _stage_breakdown(ctx)
+                dest[f"q{q}_aqe"] = _aqe_decisions(ctx)
                 print(f"[worker] {label} q{q} metrics: "
                       f"{json.dumps(_job_metrics(ctx))}", file=sys.stderr)
             except Exception as e:  # noqa: BLE001 — record, keep benching
@@ -357,6 +377,31 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
     run_queries(ctx, queries, "file", engine, rows=lineitem_rows,
                 sf_label=f"sf{SCALE:g}")
     ctx.shutdown()
+
+    # --- AQE A/B leg: q1/q18 with runtime re-optimization OFF -----------
+    # same iteration count as the on-leg so min-vs-min compares like with
+    # like; the ratio is still order-biased (the off leg reuses the warm
+    # process / XLA cache), so it's recorded as a raw ratio, not a claim
+    if time.time() < deadline - 120:
+        try:
+            ctx_off = BallistaContext.standalone(
+                BallistaConfig({**base_config,
+                                "ballista.aqe.enabled": "false"}),
+                concurrent_tasks=4)
+            try:
+                register_tables(ctx_off, DATA_DIR)
+                aqe_off = result.setdefault("engine_aqe_off", {})
+                run_queries(ctx_off, [q for q in (1, 18) if q in queries],
+                            "aqe-off", aqe_off)
+                for q in (1, 18):
+                    on, off = engine.get(f"q{q}_ms"), aqe_off.get(f"q{q}_ms")
+                    if on and off:
+                        aqe_off[f"q{q}_off_over_on"] = round(off / on, 3)
+            finally:
+                ctx_off.shutdown()
+        except Exception as e:  # noqa: BLE001 — A/B leg must not kill the run
+            result["engine_aqe_off"] = {"error": f"{type(e).__name__}: {e}"}
+
     if not engine.get("q1_ms"):
         # a 0.0 headline must be distinguishable from a measured zero
         result["error"] = ("q1 not measured: " +

@@ -789,3 +789,53 @@ def test_corrupt_shuffle_payload_detected_and_recovered(tmp_path):
         c.shutdown()
     finally:
         _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 7: executor killed while a downstream stage is being AQE-rewritten
+# -> rollback restores the planned exchange, recovery re-applies the rewrite,
+# results bit-identical (ISSUE 7)
+# --------------------------------------------------------------------------
+
+def test_executor_killed_during_aqe_rewrite_recovers(tmp_path):
+    # The group-by job's reduce stage (stage 2) is tiny, so the default-on
+    # AQE pass coalesces it as soon as the map stage completes.  A delay
+    # rule at scheduler.aqe.before_rewrite widens that rewrite window, and
+    # a kill rule takes down whichever executor first RUNS a task of the
+    # rewritten stage — losing half the map outputs.  Recovery must roll
+    # the coalesced consumer back to its planned partitioning, re-run the
+    # lost producers, re-apply the rewrite against the fresh stats, and
+    # still produce bit-identical results.
+    sched, executors = _make_cluster(tmp_path)
+    try:
+        c = _client(sched.port)
+        baseline = c.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 9, "rules": [
+            {"site": "scheduler.aqe.before_rewrite", "action": "delay",
+             "delay_ms": 200, "times": -1},
+            {"site": "executor.task.before_run", "action": "kill",
+             "match": {"stage_id": 2}, "on_hit": 1, "times": 1},
+        ]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        kills = [e for e in plan.events if e["action"] == "kill"]
+        assert len(kills) == 1, plan.events
+        assert any(ex._killed for ex in executors), \
+            "the kill must reach a registered executor"
+        # the rewrite fired once before the kill and again during recovery
+        rewrites = [e for e in plan.events
+                    if e["site"] == "scheduler.aqe.before_rewrite"]
+        assert len(rewrites) >= 2, plan.events
+        # the rolled-back consumer carries a rewrite record from BOTH
+        # stage-attempt epochs (executor loss bumps stage_attempt)
+        graphs = list(sched.server.jobs._graphs.values())
+        assert any(len({r["stage_attempt"] for r in s.aqe_rewrites}) >= 2
+                   and s.stage_attempt >= 1
+                   for g in graphs for s in g.stages.values()), \
+            "no rewritten stage was rolled back and re-rewritten"
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)

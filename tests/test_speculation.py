@@ -319,3 +319,69 @@ def test_monitor_not_started_when_disabled():
         assert not server.config.speculation.enabled
     finally:
         server.shutdown()
+
+
+# --------------------------------------------------------------------------
+# speculation x adaptive execution (ISSUE 7 regression)
+# --------------------------------------------------------------------------
+
+def test_speculative_loser_after_consumer_coalesce():
+    """A speculative duplicate still in flight when its stage completes —
+    and the CONSUMER stage then gets AQE-coalesced — must neither
+    double-count outputs when its late status lands nor wedge the attempt
+    bookkeeping."""
+    from arrow_ballista_tpu.ops.shuffle import ShuffleWritePartition
+    from arrow_ballista_tpu.scheduler.aqe import AqePolicy
+
+    def sized(task, executor_id):
+        writes = [ShuffleWritePartition(
+            q, f"/fake/j/1/{task.task.partition}/data-{q}.arrow", 100, 100)
+            for q in range(task.plan.partitioning.count)]
+        return TaskStatus(task.task, executor_id, "success",
+                          shuffle_writes=writes)
+
+    graph = ExecutionGraph.build("j", physical_plan(partitions=8))
+    graph.aqe = AqePolicy(coalesce_target_rows=1700, coalesce_target_bytes=0,
+                          skew_enabled=False, broadcast_enabled=False)
+    tasks = [graph.pop_next_task("exec-A") for _ in range(8)]
+    assert all(t is not None and t.task.stage_id == 1 for t in tasks)
+    # everything but the last partition completes; the straggler gets a
+    # speculative duplicate on another executor
+    for t in tasks[:-1]:
+        graph.update_task_status([sized(t, "exec-A")])
+    straggler = tasks[-1]
+    spec = graph.launch_speculative(1, straggler.task.partition, "exec-B")
+    assert spec is not None
+
+    # the primary wins; stage 1 completes; stage 2 resolves AND coalesces
+    # 8 -> 4 with the duplicate still in flight
+    events = graph.update_task_status([sized(straggler, "exec-A")])
+    stage1, stage2 = graph.stages[1], graph.stages[2]
+    assert stage1.state == SUCCESSFUL
+    assert stage2.state == RUNNING and stage2.partitions == 4
+    cancels = [payload for kind, payload in events if kind == "cancel_task"]
+    assert any(tid.task_attempt == spec.task.task_attempt
+               for _eid, tid in cancels), "the loser must be cancelled"
+
+    # the loser's late success arrives AFTER the consumer was rewritten:
+    # dropped entirely — outputs, rewrite, and attempt log all unchanged
+    before_outputs = dict(stage1.outputs)
+    before_rewrites = list(stage2.aqe_rewrites)
+    assert graph.update_task_status([sized(spec, "exec-B")]) == []
+    assert stage1.outputs == before_outputs
+    assert stage2.aqe_rewrites == before_rewrites
+    assert stage2.partitions == 4
+    p = straggler.task.partition
+    assert stage1.task_infos[p].attempt == straggler.task.task_attempt
+    assert p not in stage1.speculative_tasks
+    # attempt ids stay monotonic: primary + duplicate = two draws
+    assert stage1.task_attempts[p] == 2
+    # the audit log records BOTH attempts' terminal states, exactly once
+    # each — no duplicated or dangling entries after the rewrite
+    entries = [e for e in stage1.attempt_log if e["partition"] == p]
+    assert len(entries) == 2
+    assert {e["attempt"] for e in entries} \
+        == {straggler.task.task_attempt, spec.task.task_attempt}
+    assert all(e["state"] != "running" for e in entries)
+    drain(graph, "exec-A")
+    assert graph.status == "successful"

@@ -13,7 +13,13 @@ import numpy as np
 import pyarrow as pa
 import pytest
 
-from arrow_ballista_tpu.analysis import check_graph, run_lints, validate_graph
+from arrow_ballista_tpu.analysis import (
+    check_graph,
+    check_rewritten_stage,
+    run_lints,
+    validate_graph,
+    validate_rewrite,
+)
 from arrow_ballista_tpu.analysis.framework import all_rules
 from arrow_ballista_tpu.models import expr as E
 from arrow_ballista_tpu.models.schema import INT64, Field, Schema
@@ -585,3 +591,85 @@ def test_plan_checks_config_gate():
             sched_mod.validate_graph = original
     finally:
         server.shutdown()
+
+
+# --------------------------------------------------------------------------
+# runtime-rewrite validator (AQE, ISSUE 7): seeded broken-graph fixtures
+# --------------------------------------------------------------------------
+
+def test_rewrite_validator_accepts_untouched_stage():
+    graph = two_stage_graph()
+    stage = graph.stages[2]
+    validate_rewrite(graph, stage, stage.plan.schema)  # must not raise
+
+
+def test_rewrite_validator_rejects_schema_change():
+    graph = two_stage_graph()
+    stage = graph.stages[2]
+    prior = Schema([Field("k", INT64)])  # pretend the stage used to
+    # project a single column: the "rewrite" widened its output
+    with pytest.raises(PlanValidationError, match="changed the output schema"):
+        validate_rewrite(graph, stage, prior)
+    errors = check_rewritten_stage(graph, stage, prior)
+    assert any("changed the output schema" in e for e in errors)
+
+
+def test_rewrite_validator_rejects_partition_bookkeeping_drift():
+    # a coalesce that resized the bookkeeping but not the plan (or vice
+    # versa) must be rejected before any task launches against it
+    graph = two_stage_graph()
+    stage = graph.stages[2]
+    stage.partitions = 2  # plan still produces 4
+    errors = check_rewritten_stage(graph, stage, stage.plan.schema)
+    assert any("bookkeeping" in e and "4" in e for e in errors)
+    assert any("task slots" in e for e in errors)
+    with pytest.raises(PlanValidationError):
+        validate_rewrite(graph, stage, stage.plan.schema)
+
+
+def test_rewrite_validator_rejects_short_attempt_budgets():
+    graph = two_stage_graph()
+    stage = graph.stages[2]
+    stage.task_attempts = stage.task_attempts[:1]
+    errors = check_rewritten_stage(graph, stage, stage.plan.schema)
+    assert any("attempt/failure budgets" in e for e in errors)
+
+
+def test_rewrite_validator_rejects_reader_locations_out_of_range():
+    from arrow_ballista_tpu.ops.shuffle import ShuffleReaderExec
+    graph = two_stage_graph()
+    stage = graph.stages[2]
+    # resolve the consumer by hand, with a location key past the reader's
+    # partition count (a botched coalesce group map would do this)
+    reader = ShuffleReaderExec(1, SCHEMA, 4, locations={0: [], 7: []})
+    stage.resolved_plan = ShuffleWriterExec(reader, partitioning=None,
+                                            stage_id=2)
+    errors = check_rewritten_stage(graph, stage, stage.plan.schema)
+    assert any("locations for partitions [7]" in e for e in errors)
+
+
+def test_rewrite_validator_rejects_orphaned_exchange():
+    # simulate a bad broadcast graft: the probe exchange was unlinked from
+    # its consumer but left in the graph -> orphan; and the converse,
+    # a consumer still reading a deleted stage -> missing producer
+    graph = two_stage_graph()
+    orphan = ShuffleWriterExec(
+        memscan(), Partitioning.hash([E.Column("k")], 4), stage_id=7)
+    graph.stages[7] = type(graph.stages[1])(7, orphan)
+    errors = check_rewritten_stage(graph, graph.stages[2],
+                                   graph.stages[2].plan.schema)
+    assert any("orphan stage 7" in e for e in errors)
+
+    graph = two_stage_graph()
+    del graph.stages[1]  # grafted away, but stage 2 still reads it
+    errors = check_rewritten_stage(graph, graph.stages[2],
+                                   graph.stages[2].plan.schema)
+    assert any("reads producer stage 1" in e for e in errors)
+
+
+def test_rewrite_validator_rejects_link_asymmetry():
+    graph = two_stage_graph()
+    graph.stages[1].output_links.remove(2)
+    errors = check_rewritten_stage(graph, graph.stages[2],
+                                   graph.stages[2].plan.schema)
+    assert any("missing from its output links" in e for e in errors)

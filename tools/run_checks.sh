@@ -2,10 +2,11 @@
 # CI entrypoint for the repository's consistency checks:
 #   1. the static-analysis lint suite (AST rules + metrics-docs),
 #   2. generated-docs freshness (docs/user-guide/configs.md),
-#   3. the static-analysis + wire-serde + speculation + observability
-#      test files (rule fixtures, plan-validator cases, exhaustive wire
-#      round-trips, speculation policy math and attempt-dedup races,
-#      runtime-stats folding / EXPLAIN ANALYZE / cluster history),
+#   3. the static-analysis + wire-serde + speculation + observability +
+#      adaptive-execution test files (rule fixtures, plan-validator cases,
+#      exhaustive wire round-trips, speculation policy math and
+#      attempt-dedup races, runtime-stats folding / EXPLAIN ANALYZE /
+#      cluster history, AQE rewrites + rollback + serde),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
 #      quarantine, straggler speculation, corrupt-shuffle checksums) —
@@ -24,9 +25,9 @@ python -m arrow_ballista_tpu.analysis
 echo "== generated docs up to date =="
 python docs/gen_configs.py --check
 
-echo "== analysis + serde + speculation + observability test files =="
+echo "== analysis + serde + speculation + observability + aqe test files =="
 python -m pytest tests/test_static_analysis.py tests/test_serde_wire.py \
-    tests/test_speculation.py tests/test_observatory.py \
+    tests/test_speculation.py tests/test_observatory.py tests/test_aqe.py \
     -q -p no:cacheprovider
 
 echo "== chaos recovery suite (-m chaos) =="
