@@ -125,7 +125,34 @@ class RemoteCluster:
         return batches
 
     def _fetch(self, loc, schema) -> List[ColumnBatch]:
-        from ..net.dataplane import fetch_partition_batches
+        from ..net.dataplane import (
+            StreamUnsupported,
+            fetch_partition_batches,
+            fetch_partition_stream,
+        )
+        from ..utils.config import (
+            SHUFFLE_INTEGRITY,
+            SHUFFLE_WIRE_CHUNK_ROWS,
+            SHUFFLE_WIRE_COMPRESSION,
+            SHUFFLE_WIRE_STREAMING,
+        )
 
+        expected = int(loc.checksum) if (
+            bool(self.config.get(SHUFFLE_INTEGRITY))
+            and loc.checksum >= 0) else -1
+        # result collection rides the same compressed chunked protocol as
+        # executor-to-executor shuffle; grpc_port=0 (native data plane or
+        # pre-upgrade executor metadata) keeps the whole-file path
+        if bool(self.config.get(SHUFFLE_WIRE_STREAMING)) and loc.grpc_port > 0:
+            try:
+                batches, _ = fetch_partition_stream(
+                    loc.host, loc.grpc_port, loc.path, schema,
+                    self.config.batch_size, expected_checksum=expected,
+                    chunk_rows=int(self.config.get(SHUFFLE_WIRE_CHUNK_ROWS)),
+                    compression=str(self.config.get(SHUFFLE_WIRE_COMPRESSION)))
+                return batches
+            except StreamUnsupported:
+                pass
         return fetch_partition_batches(loc.host, loc.port, loc.path, schema,
-                                       self.config.batch_size)
+                                       self.config.batch_size,
+                                       expected_checksum=expected)

@@ -154,6 +154,7 @@ class Executor:
                               work_dir=self.work_dir, job_id=tid.job_id,
                               stage_id=tid.stage_id,
                               executor_id=self.metadata.executor_id,
+                              executor_host=self.metadata.host,
                               cancelled=lambda: self._is_cancelled(tid),
                               span_recorder=recorder)
             start_ms = int(time.time() * 1000)

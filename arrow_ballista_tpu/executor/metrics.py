@@ -61,6 +61,32 @@ class ExecutorMetrics:
                     self.shuffle_bytes, "shuffle bytes written")
             counter("executor_shuffle_rows_written_total",
                     self.shuffle_rows, "shuffle rows written")
+            # read-side data-plane accounting: process-global (one view per
+            # executor process; standalone in-proc executors share it)
+            from ..net.dataplane import STATS as dp_stats
+
+            snap = dp_stats.snapshot()
+            name = "shuffle_bytes_fetched_total"
+            lines.append(f"# HELP {name} shuffle bytes read by this process, "
+                         "by transport path (local_mmap = zero-copy "
+                         "co-located read, local_copy = non-mmap local read, "
+                         "remote = data-plane fetch; remote counts "
+                         "bytes-on-wire, post-compression)")
+            lines.append(f"# TYPE {name} counter")
+            for p, v in sorted(snap["bytes_fetched"].items()):
+                lines.append(f'{name}{{path="{p}"}} {v}')
+            counter("shuffle_fetch_chunks_total", snap["chunks"],
+                    "chunks received over the streaming shuffle protocol")
+            counter("shuffle_fetch_chunks_resumed_total",
+                    snap["resumed_chunks"],
+                    "chunks skipped by resuming a retried stream at the "
+                    "first unverified chunk")
+            lines.append("# HELP shuffle_wire_compression_ratio raw/wire "
+                         "byte ratio of streamed shuffle fetches (>1 = "
+                         "compression shrank the wire; 1.0 = none yet)")
+            lines.append("# TYPE shuffle_wire_compression_ratio gauge")
+            lines.append("shuffle_wire_compression_ratio "
+                         f"{dp_stats.compression_ratio():.4f}")
             lines.append("# HELP executor_active_tasks tasks currently "
                          "executing")
             lines.append("# TYPE executor_active_tasks gauge")

@@ -272,6 +272,8 @@ class ExecutorServer:
         self.rpc.register("cancel_tasks", self._cancel_tasks)
         self.rpc.register("cancel_task", self._cancel_task)
         self.rpc.register("fetch_partition", self._fetch_partition)
+        self.rpc.register_stream("fetch_partition_stream",
+                                 self._fetch_partition_stream)
         self.rpc.register("remove_job_data", self._remove_job_data)
         self.rpc.register("stop_executor", self._stop_executor)
         self.rpc.register("ping", lambda p, b: ({"executor_id": executor_id}, b""))
@@ -562,6 +564,21 @@ class ExecutorServer:
         with open(path, "rb") as f:
             data = f.read()
         return {"num_bytes": len(data)}, data
+
+    def _fetch_partition_stream(self, payload: dict, _bin: bytes, send):
+        """Chunked shuffle fetch: same auth + path guard as the whole-file
+        protocol, then the framing is delegated to the shared data-plane
+        server half (net/dataplane.stream_partition)."""
+        from ..net.dataplane import stream_partition
+
+        if self._dp_token and payload.get("token", "") != self._dp_token:
+            raise ExecutionError("data plane auth failed")
+        path = payload["path"]
+        if not self._is_under_work_dir(path):
+            raise ExecutionError(f"path {path!r} escapes the work dir")
+        if not os.path.exists(path):
+            raise ExecutionError(f"no such shuffle file: {path}")
+        stream_partition(path, payload, send)
 
     def _remove_job_data(self, payload: dict, _bin: bytes):
         from .executor import remove_job_data

@@ -51,6 +51,8 @@ KNOWN_SITES = frozenset({
     "executor.heartbeat.send",      # executor/server.py heartbeat -> scheduler
     "rpc.client.send",              # net/wire.py, every client-side RPC
     "shuffle.fetch.recv",           # net/dataplane.py, per fetch attempt
+                                    # (+ per chunk on the streaming path,
+                                    # with "chunk" in the match context)
     "scheduler.heartbeat.receive",  # scheduler/netservice.py handler
     "scheduler.status.receive",     # scheduler/netservice.py handler
     "scheduler.aqe.before_rewrite",  # scheduler/aqe.py, between an AQE

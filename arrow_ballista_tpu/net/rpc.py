@@ -19,11 +19,15 @@ from .wire import recv_frame, send_frame
 log = logging.getLogger(__name__)
 
 Handler = Callable[[dict, bytes], Tuple[dict, bytes]]
+#: streaming handler: pushes 0+ frames itself via ``send(frame, binary)``
+#: and returns when the stream is complete (the shuffle chunk protocol)
+StreamHandler = Callable[[dict, bytes, Callable[[dict, bytes], None]], None]
 
 
 class RpcServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.handlers: Dict[str, Handler] = {}
+        self.stream_handlers: Dict[str, StreamHandler] = {}
         outer = self
 
         class _Conn(socketserver.BaseRequestHandler):
@@ -49,6 +53,13 @@ class RpcServer:
     def register(self, method: str, fn: Handler) -> None:
         self.handlers[method] = fn
 
+    def register_stream(self, method: str, fn: StreamHandler) -> None:
+        """Register a handler that writes its OWN response frames (many per
+        request) through the ``send`` callback — the chunked shuffle fetch.
+        Frame ordering is the handler thread's: one connection, one handler
+        at a time, so chunks arrive in emission order."""
+        self.stream_handlers[method] = fn
+
     def start(self) -> None:
         self._thread.start()
 
@@ -62,6 +73,22 @@ class RpcServer:
 
     def _dispatch(self, sock, req: dict, binary: bytes) -> None:
         method = req.get("method", "")
+        sfn = self.stream_handlers.get(method)
+        if sfn is not None:
+            try:
+                sfn(req.get("payload", {}), binary,
+                    lambda frame, rbin=b"": send_frame(sock, frame, rbin))
+            except BallistaError as e:
+                # mid-stream failure: the error frame takes the slot of the
+                # next chunk; the client sees ok=false and maps error_kind
+                # back to its exception taxonomy
+                send_frame(sock, {"ok": False, "error": str(e),
+                                  "error_kind": type(e).__name__})
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                log.exception("rpc stream handler %s failed", method)
+                send_frame(sock, {"ok": False,
+                                  "error": f"{type(e).__name__}: {e}"})
+            return
         fn = self.handlers.get(method)
         if fn is None:
             send_frame(sock, {"ok": False, "error": f"unknown method {method!r}"})

@@ -72,7 +72,11 @@ def _substitute_scalars(e: E.Expr, scalars: Dict[str, object]) -> E.Expr:
         if key not in scalars:
             raise InternalError("scalar subquery value missing at execution time")
         v = scalars[key]
-        dt = e.plan.schema.fields[0].dtype
+        # deserialized refs carry the dtype instead of the plan (serde
+        # ships {"t": "scalarref", "id", "dt"}; the plan never crosses)
+        dt = getattr(e, "scalar_dtype", None)
+        if dt is None:
+            dt = e.plan.schema.fields[0].dtype
         if dt.is_decimal:
             # value arrives as raw scaled int -> keep exact by re-scaling to float
             return E.Lit(v / (10 ** dt.scale) if isinstance(v, int) else v)

@@ -207,6 +207,11 @@ class TaskContext:
     job_id: str = ""
     stage_id: int = 0
     executor_id: str = ""  # identity of the executing node (shuffle locality)
+    # advertised host of the executing node: a PartitionLocation whose host
+    # matches is on the same machine, so its shuffle file can be mmap'd
+    # locally instead of fetched over the data plane ("" = unknown, never
+    # host-matches)
+    executor_host: str = ""
     # shuffle partition locations: (stage_id, partition) -> list of paths/addrs
     shuffle_locations: Dict = dataclasses.field(default_factory=dict)
     # cooperative cancellation probe (executor wires the job's cancel flag);

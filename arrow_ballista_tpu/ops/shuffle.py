@@ -71,6 +71,14 @@ class PartitionLocation:
     host: str = ""
     port: int = 0
     checksum: int = -1  # producer-recorded CRC-32; -1 = unknown, skip verify
+    # control-plane (Python RPC) port of the owning executor: ``port`` may
+    # address the native whole-file data plane, so streaming fetches dial
+    # here instead.  0 = producer predates streaming, whole-file only.
+    grpc_port: int = 0
+    # on-disk representation; "" = legacy/unknown (treated as arrow_file).
+    # Lets a consumer reject a same-host mmap of a format it can't read
+    # if the disk layout ever changes.
+    format: str = ""
 
 
 class ShuffleWriterExec(ExecutionPlan):
@@ -236,7 +244,12 @@ class ShuffleReaderExec(ExecutionPlan):
             raise InternalError(
                 f"no shuffle locations for stage {self.stage_id} partition {partition}"
             )
+        from ..utils.config import SHUFFLE_LOCAL_HOST_MATCH
+
+        host_match = bool(ctx.config.get(SHUFFLE_LOCAL_HOST_MATCH)) \
+            and bool(ctx.executor_host)
         paths = []
+        colocated: List[PartitionLocation] = []
         remote: List[PartitionLocation] = []
         for loc in locs:
             if loc.num_rows == 0:
@@ -252,15 +265,101 @@ class ShuffleReaderExec(ExecutionPlan):
                         loc.executor_id, self.stage_id, loc.map_partition,
                         f"shuffle file missing: {loc.path}")
                 paths.append(loc.path)
+            elif (host_match and loc.host == ctx.executor_host
+                  and loc.format in ("", "arrow_file")
+                  and os.path.exists(loc.path)):
+                # co-located producer on the SAME advertised host: its file
+                # is reachable through the filesystem, so mmap it instead of
+                # round-tripping the bytes through the data plane.  The host
+                # stamp comes from cluster metadata (not path guessing) and
+                # the size/CRC check below rejects a stale same-named file;
+                # any doubt falls back to the remote fetch.
+                colocated.append(loc)
             else:
                 remote.append(loc)
         with self.metrics().timer("fetch_time"):
             batches = read_ipc_files(paths, self._schema, capacity=ctx.config.batch_size)
+            for loc in colocated:
+                got = self._read_colocated(loc, ctx)
+                if got is None:
+                    remote.append(loc)  # verification failed -> fetch instead
+                else:
+                    batches.extend(got)
             batches.extend(self._fetch_remote_all(remote, ctx))
         self.metrics().add("output_rows", sum(b.num_rows for b in batches))
         return batches
 
-    MAX_CONCURRENT_FETCHES = 50  # reference semaphore size, shuffle_reader.rs:123
+    # back-compat alias: the reference semaphore size (shuffle_reader.rs:123),
+    # now the default of config key ballista.shuffle.max_concurrent_fetches
+    MAX_CONCURRENT_FETCHES = 50
+
+    def _read_colocated(self, loc: PartitionLocation,
+                        ctx: TaskContext) -> Optional[List[ColumnBatch]]:
+        """Zero-copy read of a co-located producer's shuffle file via mmap,
+        with lazy integrity verification: size checked against the producer's
+        recorded num_bytes, then (under shuffle integrity) CRC-32 computed
+        over the mapped buffer — the kernel faults pages in as the checksum
+        walks them, so cold files stream once and page-cache-hot files verify
+        without any copy.  Returns None when anything disagrees (stale file,
+        checksum mismatch, mmap failure): the caller silently falls back to
+        the remote fetch, which has its own verification + lineage escalation.
+        """
+        import zlib
+
+        import pyarrow as pa
+        import pyarrow.ipc as ipc
+
+        from ..models.ipc import physical_table_to_batches
+        from ..net.dataplane import STATS
+        from ..utils.config import SHUFFLE_INTEGRITY
+
+        try:
+            st = os.stat(loc.path)
+            if loc.num_bytes > 0 and st.st_size != loc.num_bytes:
+                return None  # stale or partially-written same-named file
+            path_label = "local_mmap"
+            try:
+                source = pa.memory_map(loc.path, "r")
+            except OSError:
+                # filesystem refuses mmap (some network mounts): plain read
+                source = pa.OSFile(loc.path, "rb")
+                path_label = "local_copy"
+            with source:
+                if ctx.config.get(SHUFFLE_INTEGRITY) and loc.checksum >= 0:
+                    buf = source.read_buffer()  # zero-copy view of the map
+                    if zlib.crc32(memoryview(buf)) != loc.checksum:
+                        return None
+                    source.seek(0)
+                table = ipc.open_file(source).read_all()
+            batches = physical_table_to_batches(table, self._schema,
+                                                capacity=ctx.config.batch_size)
+        except Exception:  # noqa: BLE001 — any local doubt -> remote fetch
+            return None
+        STATS.record(path_label, st.st_size)
+        self.metrics().add(f"bytes_{path_label}", st.st_size)
+        return batches
+
+    # process-shared fetch pool: one bounded pool for ALL concurrent reduce
+    # tasks, not one ThreadPoolExecutor per task invocation — with 8 reduce
+    # tasks each fanning out to 48 map outputs the old scheme spun up (and
+    # tore down) ~400 threads per wave.  The semaphore (sized per-call from
+    # ballista.shuffle.max_concurrent_fetches) bounds in-flight fetches; the
+    # pool itself is a reusable hard cap.
+    _FETCH_POOL = None
+    _FETCH_POOL_LOCK = __import__("threading").Lock()
+    _FETCH_POOL_WORKERS = 64
+
+    @classmethod
+    def _fetch_pool(cls):
+        if cls._FETCH_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with cls._FETCH_POOL_LOCK:
+                if cls._FETCH_POOL is None:
+                    cls._FETCH_POOL = ThreadPoolExecutor(
+                        max_workers=cls._FETCH_POOL_WORKERS,
+                        thread_name_prefix="shuffle-fetch")
+        return cls._FETCH_POOL
 
     def _fetch_remote_all(self, remote: List[PartitionLocation],
                           ctx: TaskContext) -> List[ColumnBatch]:
@@ -273,35 +372,61 @@ class ShuffleReaderExec(ExecutionPlan):
         if len(remote) == 1:
             return self._fetch_remote(remote[0], ctx)
         import random
-        from concurrent.futures import ThreadPoolExecutor
+        import threading
 
+        from ..utils.config import SHUFFLE_MAX_CONCURRENT_FETCHES
+
+        limit = max(1, int(ctx.config.get(SHUFFLE_MAX_CONCURRENT_FETCHES)))
+        gate = threading.Semaphore(min(limit, len(remote)))
         order = list(remote)
         random.shuffle(order)
+
+        def fetch(loc: PartitionLocation) -> List[ColumnBatch]:
+            with gate:
+                return self._fetch_remote(loc, ctx)
+
         out: List[ColumnBatch] = []
-        with ThreadPoolExecutor(
-            max_workers=min(self.MAX_CONCURRENT_FETCHES, len(order)),
-            thread_name_prefix="shuffle-fetch",
-        ) as pool:
-            for got in pool.map(lambda loc: self._fetch_remote(loc, ctx), order):
-                out.extend(got)
+        for got in self._fetch_pool().map(fetch, order):
+            out.extend(got)
         return out
 
     def _fetch_remote(self, loc: PartitionLocation, ctx: TaskContext) -> List[ColumnBatch]:
-        from ..net.dataplane import fetch_partition_batches
+        from ..net.dataplane import (StreamUnsupported,
+                                     fetch_partition_batches,
+                                     fetch_partition_stream)
         from ..net.retry import RetryPolicy
+        from ..utils.config import (SHUFFLE_INTEGRITY, SHUFFLE_WIRE_CHUNK_ROWS,
+                                    SHUFFLE_WIRE_COMPRESSION,
+                                    SHUFFLE_WIRE_STREAMING)
 
+        policy = RetryPolicy.from_config(ctx.config)
+        expected = (loc.checksum
+                    if ctx.config.get(SHUFFLE_INTEGRITY) else -1)
+        fault_ctx = {"stage_id": self.stage_id,
+                     "map_partition": loc.map_partition,
+                     "executor_id": loc.executor_id}
         try:
-            from ..utils.config import SHUFFLE_INTEGRITY
-
+            if ctx.config.get(SHUFFLE_WIRE_STREAMING) and loc.grpc_port > 0:
+                try:
+                    batches, stats = fetch_partition_stream(
+                        loc.host, loc.grpc_port, loc.path,
+                        self._schema, ctx.config.batch_size,
+                        policy=policy, expected_checksum=expected,
+                        chunk_rows=int(ctx.config.get(SHUFFLE_WIRE_CHUNK_ROWS)),
+                        compression=str(ctx.config.get(SHUFFLE_WIRE_COMPRESSION)),
+                        fault_ctx=fault_ctx)
+                    self.metrics().add("remote_fetches", 1)
+                    self.metrics().add("fetch_chunks", stats["chunks"])
+                    self.metrics().add("wire_bytes", stats["wire_bytes"])
+                    self.metrics().add("raw_bytes", stats["raw_bytes"])
+                    return batches
+                except StreamUnsupported:
+                    pass  # pre-upgrade peer: fall through to whole-file
             batches = fetch_partition_batches(
                 loc.host, loc.port, loc.path,
                 self._schema, ctx.config.batch_size,
-                policy=RetryPolicy.from_config(ctx.config),
-                expected_checksum=(loc.checksum
-                                   if ctx.config.get(SHUFFLE_INTEGRITY) else -1),
-                fault_ctx={"stage_id": self.stage_id,
-                           "map_partition": loc.map_partition,
-                           "executor_id": loc.executor_id})
+                policy=policy, expected_checksum=expected,
+                fault_ctx=fault_ctx)
             self.metrics().add("remote_fetches", 1)
             return batches
         except Exception as err:  # noqa: BLE001 — retries exhausted

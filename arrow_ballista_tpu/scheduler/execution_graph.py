@@ -218,18 +218,25 @@ class ExecutionStage:
 
     def output_locations(self, addr_resolver=None) -> Dict[int, List[PartitionLocation]]:
         """output partition -> locations across all map tasks.
-        ``addr_resolver(executor_id) -> (host, port)`` stamps the data-plane
-        address for remote fetch (None in purely local deployments)."""
+        ``addr_resolver(executor_id) -> (host, port[, grpc_port])`` stamps
+        the data-plane address for remote fetch (None in purely local
+        deployments); the optional third element is the executor's control
+        port, where the chunked ``fetch_partition_stream`` protocol lives
+        (0 = whole-file fetch only, e.g. a pre-upgrade resolver)."""
         locs: Dict[int, List[PartitionLocation]] = {}
         for map_part, (executor_id, writes) in sorted(self.outputs.items()):
-            host, port = ("", 0)
+            host, port, grpc_port = ("", 0, 0)
             if addr_resolver is not None:
-                host, port = addr_resolver(executor_id)
+                addr = addr_resolver(executor_id)
+                host, port = addr[0], addr[1]
+                grpc_port = addr[2] if len(addr) > 2 else 0
             for w in writes:
                 locs.setdefault(w.output_partition, []).append(
                     PartitionLocation(executor_id, map_part, w.output_partition,
                                       w.path, w.num_rows, w.num_bytes,
-                                      host, port, checksum=w.checksum))
+                                      host, port, checksum=w.checksum,
+                                      grpc_port=grpc_port,
+                                      format="arrow_file"))
         return locs
 
     # --- adaptive exchange coalescing ------------------------------------

@@ -795,8 +795,12 @@ class SchedulerServer:
         events.clear()
 
     def _resolve_addr(self, executor_id: str):
+        # (host, data-plane port, control-plane port): the data plane may be
+        # the native whole-file server, so streaming fetches dial grpc_port
+        # (the Python RPC server, which speaks fetch_partition_stream)
         meta = self.cluster.get_executor(executor_id)
-        return (meta.host, meta.port) if meta is not None else ("", 0)
+        return (meta.host, meta.port, meta.grpc_port) \
+            if meta is not None else ("", 0, 0)
 
     # --- push scheduling -------------------------------------------------
     def _offer(self) -> None:

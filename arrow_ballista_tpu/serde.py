@@ -111,7 +111,14 @@ def expr_to_obj(e: Optional[E.Expr]):
         sid = getattr(e, "scalar_id", None)
         if sid is None:
             raise InternalError("unplanned scalar subquery cannot be serialized")
-        return {"t": "scalarref", "id": sid}
+        # the result dtype must cross too: executors re-scale decimal
+        # scaled-int values at substitution time and have no plan to ask
+        dt = (e.plan.schema.fields[0].dtype if e.plan is not None
+              else getattr(e, "scalar_dtype", None))
+        obj = {"t": "scalarref", "id": sid}
+        if dt is not None:
+            obj["dt"] = dtype_to_obj(dt)
+        return obj
     raise InternalError(f"cannot serialize expr {type(e).__name__}")
 
 
@@ -151,6 +158,8 @@ def expr_from_obj(o) -> Optional[E.Expr]:
     if t == "scalarref":
         sq = E.ScalarSubquery(None)
         object.__setattr__(sq, "scalar_id", o["id"])
+        if o.get("dt") is not None:
+            object.__setattr__(sq, "scalar_dtype", dtype_from_obj(o["dt"]))
         return sq
     raise InternalError(f"cannot deserialize expr tag {t!r}")
 
@@ -178,7 +187,13 @@ def location_to_obj(l: PartitionLocation) -> dict:
 
 
 def location_from_obj(o: dict) -> PartitionLocation:
-    return PartitionLocation(**o)
+    # tolerant across wire versions: unknown keys (from a NEWER peer) are
+    # dropped, missing keys (from an OLDER peer) take dataclass defaults —
+    # a rolling upgrade must not wedge on shuffle metadata
+    import dataclasses as _dc
+
+    known = {f.name for f in _dc.fields(PartitionLocation)}
+    return PartitionLocation(**{k: v for k, v in o.items() if k in known})
 
 
 # --------------------------------------------------------------------------

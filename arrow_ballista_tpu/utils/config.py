@@ -83,6 +83,13 @@ AQE_SKEW_FACTOR = "ballista.aqe.skew.factor"
 AQE_SKEW_MIN_ROWS = "ballista.aqe.skew.min.rows"
 # shuffle partition integrity (ops/shuffle.py + net/dataplane.py)
 SHUFFLE_INTEGRITY = "ballista.shuffle.integrity.verify"
+# shuffle transport (ops/shuffle.py + net/dataplane.py): local mmap fast
+# path, streaming chunked remote fetch, and wire compression
+SHUFFLE_LOCAL_HOST_MATCH = "ballista.shuffle.local.host_match"
+SHUFFLE_MAX_CONCURRENT_FETCHES = "ballista.shuffle.max_concurrent_fetches"
+SHUFFLE_WIRE_STREAMING = "ballista.shuffle.wire.streaming"
+SHUFFLE_WIRE_CHUNK_ROWS = "ballista.shuffle.wire.chunk_rows"
+SHUFFLE_WIRE_COMPRESSION = "ballista.shuffle.wire.compression"
 # runtime statistics observatory (obs/stats.py + scheduler sampler)
 STATS_HISTORY_CAPACITY = "ballista.stats.history.capacity"
 STATS_HISTORY_INTERVAL_S = "ballista.stats.history.interval.seconds"
@@ -346,6 +353,38 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "deserialization; a mismatch raises a retryable "
                     "IntegrityError (re-fetch, then lineage rollback) "
                     "instead of decoding corrupt bytes"),
+        ConfigEntry(SHUFFLE_LOCAL_HOST_MATCH, True, _parse_bool,
+                    "zero-copy local handoff: a reader whose executor "
+                    "advertises the same host as a shuffle producer reads "
+                    "the producer's IPC file directly via mmap instead of "
+                    "fetching it over the data plane.  The mapped bytes are "
+                    "lazily CRC-verified (when "
+                    "ballista.shuffle.integrity.verify is on) and any "
+                    "mismatch or missing file silently falls back to the "
+                    "remote fetch path, so a stale same-named file can "
+                    "never corrupt results"),
+        ConfigEntry(SHUFFLE_MAX_CONCURRENT_FETCHES, 50, int,
+                    "per reduce-task cap on concurrent remote shuffle "
+                    "fetches (the reference's 50-permit semaphore, "
+                    "shuffle_reader.rs:123); fetches run on a shared "
+                    "process-level pool rather than a per-task one"),
+        ConfigEntry(SHUFFLE_WIRE_STREAMING, True, _parse_bool,
+                    "chunked streaming remote fetch: shuffle partitions "
+                    "stream as framed Arrow IPC chunks (per-chunk CRC-32) "
+                    "so the reader decodes batches while later chunks are "
+                    "in flight, and a retry resumes from the last good "
+                    "chunk instead of re-pulling the whole file.  False = "
+                    "legacy whole-file fetch_partition blobs"),
+        ConfigEntry(SHUFFLE_WIRE_CHUNK_ROWS, 1 << 16, int,
+                    "rows per streamed shuffle chunk; chunk boundaries are "
+                    "deterministic multiples of this so resume-from-chunk "
+                    "is exact"),
+        ConfigEntry(SHUFFLE_WIRE_COMPRESSION, "lz4", str,
+                    "Arrow IPC buffer compression on the streaming remote "
+                    "path: 'lz4' (default), 'zstd', or 'none'.  Applied "
+                    "per-fetch on the network path only — local files and "
+                    "mmap readers always see uncompressed bytes; an "
+                    "unavailable codec silently degrades to 'none'"),
         ConfigEntry(STATS_HISTORY_CAPACITY, 512, int,
                     "ring-buffer capacity of the cluster time series behind "
                     "GET /api/cluster/history (oldest samples are evicted)"),

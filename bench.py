@@ -452,6 +452,122 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
         except Exception as e:  # noqa: BLE001 — rider must not kill the run
             result["engine_sf10"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # --- shuffle-transport A/B leg: the shuffle-heavy queries through a
+    # REAL 2-executor TCP cluster (standalone's identity-local path never
+    # touches the transport), one cluster per leg:
+    #   mmap   — shipped defaults: host-match mmap + streaming + lz4
+    #   wire   — host-match off, so co-located reads take the compressed
+    #            chunked streaming path (bytes-on-wire measurement)
+    #   legacy — streaming off too: the whole-file uncompressed protocol
+    # DataPlaneStats is process-global and the executors are in-proc
+    # threads, so snapshot deltas attribute bytes/chunks to each query.
+    if time.time() < deadline - 240:
+        try:
+            import shutil
+            import tempfile
+
+            from arrow_ballista_tpu.executor.server import ExecutorServer
+            from arrow_ballista_tpu.net import dataplane as dp
+            from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+            transport_queries = [
+                int(x) for x in
+                os.environ.get("BENCH_TRANSPORT_QUERIES", "3,5,21").split(",")
+                if x.strip()]
+            # legacy first: the first leg pays the cold XLA compiles, so
+            # giving that to the BASELINE biases the ms ratios against the
+            # new transports, never for them.  byte counts are exact either
+            # way — they're the headline; ms is a raw corroborating ratio.
+            legs = [
+                ("legacy", {"ballista.shuffle.local.host_match": "false",
+                            "ballista.shuffle.wire.streaming": "false"}),
+                ("wire", {"ballista.shuffle.local.host_match": "false"}),
+                ("mmap", {}),
+            ]
+            transport = result.setdefault("engine_transport", {})
+            for leg, overrides in legs:
+                if time.time() > deadline - 150:
+                    transport[f"{leg}_skipped"] = "deadline"
+                    break
+                conf = {**base_config, **overrides}
+                tmp = tempfile.mkdtemp(prefix=f"bench-transport-{leg}-")
+                sched = SchedulerNetService(
+                    "127.0.0.1", 0, config=BallistaConfig(dict(conf)))
+                sched.start()
+                executors = []
+                try:
+                    for i in range(2):
+                        work = os.path.join(tmp, f"exec{i}")
+                        os.makedirs(work)
+                        ex = ExecutorServer(
+                            "127.0.0.1", sched.port, "127.0.0.1", 0,
+                            work_dir=work, concurrent_tasks=2,
+                            executor_id=f"bench-{leg}-{i}",
+                            config=BallistaConfig(dict(conf)))
+                        ex.start()
+                        executors.append(ex)
+                    tctx = BallistaContext.remote(
+                        "127.0.0.1", sched.port, BallistaConfig(dict(conf)))
+                    try:
+                        register_tables(tctx, DATA_DIR)
+                        for q in transport_queries:
+                            if time.time() > deadline - 100:
+                                transport[f"q{q}_skipped"] = "deadline"
+                                continue
+                            s0 = dp.STATS.snapshot()
+                            t0 = time.perf_counter()
+                            res = tctx.sql(SQL[q]).collect()
+                            wall = time.perf_counter() - t0
+                            s1 = dp.STATS.snapshot()
+                            rec = transport.setdefault(
+                                f"q{q}_shuffle_transport", {})
+                            rec[leg] = {
+                                "ms": round(wall * 1000, 1),
+                                "rows": sum(b.num_rows for b in res),
+                                "local_bytes": (
+                                    s1["bytes_fetched"]["local_mmap"]
+                                    - s0["bytes_fetched"]["local_mmap"]
+                                    + s1["bytes_fetched"]["local_copy"]
+                                    - s0["bytes_fetched"]["local_copy"]),
+                                "remote_bytes": (
+                                    s1["bytes_fetched"]["remote"]
+                                    - s0["bytes_fetched"]["remote"]),
+                                "chunks": s1["chunks"] - s0["chunks"],
+                                "raw_bytes": s1["raw_bytes"] - s0["raw_bytes"],
+                                "wire_bytes": (s1["wire_bytes"]
+                                               - s0["wire_bytes"]),
+                            }
+                            print(f"[worker] transport {leg} q{q}: "
+                                  f"{wall*1000:.0f} ms "
+                                  f"{json.dumps(rec[leg])}", file=sys.stderr)
+                    finally:
+                        tctx.shutdown()
+                finally:
+                    for ex in executors:
+                        ex.stop(notify=False)
+                    sched.stop()
+                    shutil.rmtree(tmp, ignore_errors=True)
+                emit(f"transport-{leg}")
+            # headline deltas per query: wall-clock of the default path vs
+            # the legacy wire, and bytes-on-wire of compressed streaming vs
+            # whole-file (the remote series counts post-compression bytes)
+            for q in transport_queries:
+                rec = transport.get(f"q{q}_shuffle_transport")
+                if not rec:
+                    continue
+                mmap_l, wire_l, legacy_l = (rec.get("mmap"), rec.get("wire"),
+                                            rec.get("legacy"))
+                if mmap_l and legacy_l and mmap_l["ms"]:
+                    rec["legacy_over_mmap_ms"] = round(
+                        legacy_l["ms"] / mmap_l["ms"], 3)
+                if wire_l and legacy_l and wire_l["remote_bytes"]:
+                    rec["legacy_over_wire_bytes"] = round(
+                        legacy_l["remote_bytes"] / wire_l["remote_bytes"], 3)
+            emit("transport-ab")
+        except Exception as e:  # noqa: BLE001 — A/B leg must not kill the run
+            result["engine_transport"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[worker] transport bench failed: {e}", file=sys.stderr)
+
     # --- mesh path: same queries, ICI all_to_all shuffle ----------------
     # guarded end to end: a mesh-path failure must never discard the file
     # numbers already measured above

@@ -350,6 +350,13 @@ CHAOS_CONF = {
     "ballista.rpc.retry.base.seconds": "0.05",
     "ballista.rpc.retry.cap.seconds": "0.2",
     "ballista.rpc.retry.deadline.seconds": "1.5",
+    # both chaos executors run on 127.0.0.1, so the co-located mmap fast
+    # path would bypass the remote fetch (and its failpoints) entirely —
+    # these scenarios exist to exercise the network path, so disable it
+    "ballista.shuffle.local.host_match": "false",
+    # small streaming chunks so multi-chunk streams (and the mid-stream
+    # chunk failpoints) exist even at chaos-suite data sizes
+    "ballista.shuffle.wire.chunk_rows": "1024",
 }
 
 SQL = "select g, sum(v) as s, count(*) as n from t group by g order by g"
@@ -835,6 +842,106 @@ def test_executor_killed_during_aqe_rewrite_recovers(tmp_path):
                    and s.stage_attempt >= 1
                    for g in graphs for s in g.stages.values()), \
             "no rewritten stage was rolled back and re-rewritten"
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 8: mid-stream chunk faults on the chunked shuffle protocol —
+# a single corrupted or dropped chunk heals INSIDE the fetch (resume from
+# the first unverified chunk), and a persistent mid-stream loss escalates
+# to lineage rollback with bit-identical results (ISSUE 8)
+# --------------------------------------------------------------------------
+
+def test_mid_stream_chunk_corruption_heals_in_fetch(tmp_path):
+    # chunk_rows=1024 (CHAOS_CONF) and ~20k rows across 4x4 shuffle files
+    # give every remote fetch several chunks.  Corrupting exactly ONE
+    # mid-stream chunk (match {"chunk": 1}) must be caught by the per-chunk
+    # CRC and healed by an immediate resume at that chunk — chunks 0..k-1
+    # are already decoded and are NOT re-fetched, and the failure never
+    # reaches the scheduler (no rollback, no producer re-run).
+    sched, executors = _make_cluster(tmp_path, concurrent_tasks=1)
+    try:
+        c = _client(sched.port, n=20_000, groups=50_000, seed=29)
+        baseline = c.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 8, "rules": [{
+            "site": "shuffle.fetch.recv", "action": "corrupt", "times": 1,
+            "match": {"stage_id": 1, "chunk": 1}}]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        assert plan.schedule() == (("shuffle.fetch.recv", 0, 1, "corrupt"),)
+        # healed in-fetch: no stage ever failed or re-ran
+        graphs = list(sched.server.jobs._graphs.values())
+        assert not any(s.failures for g in graphs for s in g.stages.values())
+        assert not any(s.stage_attempt for g in graphs
+                       for s in g.stages.values())
+        _frames_equal(got, baseline)
+        # the resumed retry skipped the already-verified chunk 0
+        from arrow_ballista_tpu.net.dataplane import STATS
+        assert STATS.snapshot()["resumed_chunks"] >= 1
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+def test_mid_stream_chunk_drop_heals_in_fetch(tmp_path):
+    # same shape with a DROPPED chunk: the stream dies mid-flight
+    # (ConnectionError), the retry backs off and resumes at the lost chunk
+    sched, executors = _make_cluster(tmp_path, concurrent_tasks=1)
+    try:
+        c = _client(sched.port, n=20_000, groups=50_000, seed=31)
+        baseline = c.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 4, "rules": [{
+            "site": "shuffle.fetch.recv", "action": "drop", "times": 1,
+            "match": {"stage_id": 1, "chunk": 1}}]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        assert plan.schedule() == (("shuffle.fetch.recv", 0, 1, "drop"),)
+        graphs = list(sched.server.jobs._graphs.values())
+        assert not any(s.failures for g in graphs for s in g.stages.values())
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+def test_mid_stream_producer_loss_rolls_back_and_recovers(tmp_path):
+    # A producer that dies while serving a stream is indistinguishable from
+    # a dropped connection at the consumer: every resume attempt of ONE
+    # logical fetch dies at chunk 1 (times=FETCH_RETRIES burns the whole
+    # in-call retry budget), so the consumer escalates FetchFailedError ->
+    # lineage rollback -> producer re-run, and the re-fetch of the fresh
+    # file succeeds.  Results must be bit-identical: partially-decoded
+    # chunks from the dead stream are discarded with the failed task.
+    from arrow_ballista_tpu.net.dataplane import FETCH_RETRIES
+
+    sched, executors = _make_cluster(tmp_path, concurrent_tasks=1)
+    try:
+        c = _client(sched.port, n=20_000, groups=50_000, seed=37)
+        baseline = c.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 2, "rules": [{
+            "site": "shuffle.fetch.recv", "action": "drop",
+            "times": FETCH_RETRIES,
+            "match": {"stage_id": 1, "map_partition": 0, "chunk": 1}}]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        assert plan.schedule() == tuple(
+            ("shuffle.fetch.recv", 0, k, "drop")
+            for k in range(1, FETCH_RETRIES + 1)), \
+            "one logical fetch must absorb the whole drop budget"
+        graphs = list(sched.server.jobs._graphs.values())
+        assert any(s.failures >= 1 for g in graphs
+                   for s in g.stages.values()), "no consumer rollback recorded"
+        assert any(s.stage_attempt >= 1 for g in graphs
+                   for s in g.stages.values()), "no producer re-run recorded"
         _frames_equal(got, baseline)
         c.shutdown()
     finally:

@@ -94,6 +94,10 @@ SAMPLES = {
     PartitionLocation: [
         PartitionLocation("exec-1", 0, 1, "/tmp/p"),
         PartitionLocation("exec-1", 0, 2, "/tmp/p2", checksum=0xCAFEF00D),
+        PartitionLocation("exec-1", 1, 3, "/tmp/p3", num_rows=9,
+                          num_bytes=512, host="10.0.0.3", port=50051,
+                          checksum=0x1234, grpc_port=50052,
+                          format="arrow_file"),
         LOCATION,
     ],
     ExecutorMetadata: [
@@ -168,3 +172,35 @@ def test_heartbeat_nested_metadata_round_trips():
     decoded = from_obj(json.loads(json.dumps(to_obj(hb))))
     assert decoded.metadata == hb.metadata
     assert from_obj(to_obj(SAMPLES[ExecutorHeartbeat][0])).metadata is None
+
+
+def test_scalarref_carries_dtype_for_planless_substitution():
+    """A deserialized scalar ref has no plan (only the id crosses the
+    wire) — the result dtype must ride along so remote executors can
+    re-scale decimal scaled-int values without dereferencing the plan."""
+    from arrow_ballista_tpu.models.schema import DataType
+    from arrow_ballista_tpu.ops.operators import _substitute_scalars
+
+    dec = Schema([Field("s", DataType("decimal", 2))])
+
+    class _Plan:  # serialization only reads plan.schema
+        schema = dec
+
+    plan = E.ScalarSubquery(_Plan())
+    object.__setattr__(plan, "scalar_id", "sq7")
+
+    obj = json.loads(json.dumps(serde.expr_to_obj(plan)))
+    assert obj["dt"] == {"kind": "decimal", "scale": 2}
+
+    decoded = serde.expr_from_obj(obj)
+    assert decoded.plan is None
+    assert decoded.scalar_dtype == DataType("decimal", 2)
+    # re-serialization of a deserialized ref keeps the dtype (executors
+    # re-serde plans on some paths)
+    assert serde.expr_to_obj(decoded)["dt"] == obj["dt"]
+
+    # value arrives as a raw scaled int; substitution must rescale it
+    # using the attached dtype, not the (absent) plan schema
+    lit = _substitute_scalars(decoded, {"sq7": 12345})
+    assert isinstance(lit, E.Lit)
+    assert lit.value == 123.45

@@ -1,0 +1,160 @@
+"""Transport-equivalence sweep: all 22 TPC-H queries, SF1, through a real
+2-executor TCP cluster, once per transport configuration, results compared
+**bit-identically** against the first leg.
+
+The shuffle data plane (docs/user-guide/shuffle.md) has three transports —
+co-located mmap, chunked+compressed streaming, legacy whole-file — chosen
+per location at runtime.  This sweep is the oracle that the choice is
+invisible: every query must return byte-for-byte identical frames no
+matter which transport carried the shuffle.
+
+    python -m tools.transport_sweep            # writes TRANSPORT_SWEEP.json
+
+Legs (executor-side config):
+
+- ``mmap``:   shipped defaults (host-match mmap + streaming + lz4)
+- ``wire``:   host_match=false                 -> compressed chunked stream
+- ``legacy``: host_match=false, streaming=false -> whole-file protocol
+
+Env knobs: ``BENCH_DATA`` (default ``.bench_data/tpch-sf1``),
+``SWEEP_QUERIES`` (default all 22), ``SWEEP_LEGS`` (first leg is the
+bit-identity baseline), ``SWEEP_OUT`` (artifact path).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DATA_DIR = os.environ.get(
+    "BENCH_DATA", os.path.join(REPO, ".bench_data", "tpch-sf1"))
+OUT = os.environ.get("SWEEP_OUT", os.path.join(REPO, "TRANSPORT_SWEEP.json"))
+
+LEGS = {
+    "mmap": {},
+    "wire": {"ballista.shuffle.local.host_match": "false"},
+    "legacy": {"ballista.shuffle.local.host_match": "false",
+               "ballista.shuffle.wire.streaming": "false"},
+}
+
+
+def _run_leg(leg: str, overrides: dict, queries, artifact: dict):
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.net import dataplane as dp
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+    from benchmarks.queries import QUERIES
+    from benchmarks.tpch import register_tables
+
+    conf = {
+        "ballista.shuffle.partitions": "8",
+        "ballista.batch.size": str(1 << 20),
+        "ballista.job.timeout.seconds": "1800",
+        **overrides,
+    }
+    tmp = tempfile.mkdtemp(prefix=f"transport-sweep-{leg}-")
+    sched = SchedulerNetService("127.0.0.1", 0, config=BallistaConfig(dict(conf)))
+    sched.start()
+    executors = []
+    frames = {}
+    s0 = dp.STATS.snapshot()
+    try:
+        for i in range(2):
+            work = os.path.join(tmp, f"exec{i}")
+            os.makedirs(work)
+            ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                                work_dir=work, concurrent_tasks=2,
+                                executor_id=f"sweep-{leg}-{i}",
+                                config=BallistaConfig(dict(conf)))
+            ex.start()
+            executors.append(ex)
+        ctx = BallistaContext.remote("127.0.0.1", sched.port,
+                                     BallistaConfig(dict(conf)))
+        try:
+            register_tables(ctx, DATA_DIR)
+            for q in queries:
+                t0 = time.time()
+                frames[q] = ctx.sql(QUERIES[q]).to_pandas()
+                artifact.setdefault(f"q{q}", {})[f"{leg}_s"] = round(
+                    time.time() - t0, 1)
+                print(f"[sweep] {leg} q{q}: {time.time()-t0:.1f}s "
+                      f"({len(frames[q])} rows)", flush=True)
+        finally:
+            ctx.shutdown()
+    finally:
+        for ex in executors:
+            ex.stop(notify=False)
+        sched.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    s1 = dp.STATS.snapshot()
+    artifact[f"{leg}_dataplane"] = {
+        "bytes_local_mmap": s1["bytes_fetched"]["local_mmap"]
+        - s0["bytes_fetched"]["local_mmap"],
+        "bytes_remote": s1["bytes_fetched"]["remote"]
+        - s0["bytes_fetched"]["remote"],
+        "chunks": s1["chunks"] - s0["chunks"],
+        "raw_bytes": s1["raw_bytes"] - s0["raw_bytes"],
+        "wire_bytes": s1["wire_bytes"] - s0["wire_bytes"],
+    }
+    return frames
+
+
+def main() -> None:
+    import pandas as pd
+
+    from benchmarks.queries import QUERIES
+
+    if not os.path.exists(os.path.join(DATA_DIR, "lineitem.parquet")):
+        raise SystemExit(f"no data at {DATA_DIR}; run benchmarks.tpch convert")
+
+    queries = sorted(
+        int(x) for x in os.environ.get(
+            "SWEEP_QUERIES", ",".join(map(str, sorted(QUERIES)))).split(",")
+        if x.strip())
+    legs = [x for x in os.environ.get(
+        "SWEEP_LEGS", "mmap,wire,legacy").split(",") if x.strip()]
+
+    t_all = time.time()
+    artifact: dict = {"data": DATA_DIR, "legs": legs}
+    baseline_leg = legs[0]
+    baseline = _run_leg(baseline_leg, LEGS[baseline_leg], queries, artifact)
+    ok = 0
+    mismatches = []
+    for leg in legs[1:]:
+        frames = _run_leg(leg, LEGS[leg], queries, artifact)
+        for q in queries:
+            entry = artifact.setdefault(f"q{q}", {})
+            try:
+                # bit-identical: exact dtypes, exact values, exact order
+                pd.testing.assert_frame_equal(
+                    baseline[q].reset_index(drop=True),
+                    frames[q].reset_index(drop=True), check_exact=True)
+                entry[f"{leg}_identical"] = True
+            except Exception as e:  # noqa: BLE001 — record and continue
+                entry[f"{leg}_identical"] = False
+                entry[f"{leg}_error"] = str(e)[:500]
+                mismatches.append((q, leg))
+    for q in queries:
+        entry = artifact[f"q{q}"]
+        if all(entry.get(f"{leg}_identical") for leg in legs[1:]):
+            ok += 1
+    artifact["identical"] = ok
+    artifact["total"] = len(queries)
+    artifact["wall_s"] = round(time.time() - t_all, 1)
+    with open(OUT, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"[sweep] {ok}/{len(queries)} bit-identical across {legs} -> {OUT}",
+          flush=True)
+    if mismatches:
+        raise SystemExit(f"transport mismatch: {mismatches}")
+
+
+if __name__ == "__main__":
+    main()
