@@ -377,9 +377,9 @@ class LockDisciplineRule(Rule):
         (f"{PKG}/scheduler/session.py", "SessionManager"):
             {"_sessions"},
         (f"{PKG}/scheduler/scheduler.py", "SchedulerServer"):
-            {"_cleanup_timers"},
+            {"_cleanup_timers", "_status_inbox"},
     }
-    LOCK_ATTRS = {"_lock", "_cond", "_cleanup_lock"}
+    LOCK_ATTRS = {"_lock", "_cond", "_cleanup_lock", "_status_lock"}
     MUTATORS = {"append", "pop", "clear", "update", "setdefault", "add",
                 "remove", "extend", "popitem", "insert", "discard"}
 

@@ -42,10 +42,15 @@ class BallistaDataFrame:
     mirroring RemoteDataFrame."""
 
     def __init__(self, ctx: "BallistaContext", logical: Optional[L.LogicalPlan],
-                 static=None):
+                 static=None, sql_text: Optional[str] = None):
         self.ctx = ctx
         self.logical = logical
         self._static = static
+        # original statement text for pristine sql() SELECTs: lets the
+        # standalone engine route through the serving caches (plan/result
+        # reuse keyed on normalized text); None for DDL/EXPLAIN/derived
+        # frames, which execute the logical plan directly
+        self._sql_text = sql_text
 
     @property
     def schema(self) -> Schema:
@@ -61,7 +66,7 @@ class BallistaDataFrame:
     def collect(self) -> List[ColumnBatch]:
         if self.logical is None:
             return []
-        return self.ctx._execute_logical(self.logical)
+        return self.ctx._execute_logical(self.logical, self._sql_text)
 
     def to_arrow(self):
         import pyarrow as pa
@@ -125,6 +130,22 @@ class BallistaContext:
         self.work_dir = work_dir or os.path.join(tempfile.gettempdir(), "ballista_tpu")
         self._standalone = None
         self._remote = None
+        # per-session parsed-AST memo: hot clients resubmitting the same
+        # statement text skip the parser entirely (LRU, text -> AST)
+        from collections import OrderedDict
+
+        self._ast_memo: "OrderedDict[str, object]" = OrderedDict()
+
+    def _parse_cached(self, sql: str):
+        stmt = self._ast_memo.get(sql)
+        if stmt is not None:
+            self._ast_memo.move_to_end(sql)
+            return stmt
+        stmt = parse_sql(sql)
+        self._ast_memo[sql] = stmt
+        while len(self._ast_memo) > 256:
+            self._ast_memo.popitem(last=False)
+        return stmt
 
     # --- constructors (parity: context.rs:80-212) -----------------------
     @staticmethod
@@ -214,7 +235,7 @@ class BallistaContext:
     def sql(self, sql: str) -> "BallistaDataFrame":
         if self._remote is not None:
             return self._remote_sql(sql)
-        stmt = parse_sql(sql)
+        stmt = self._parse_cached(sql)
         if isinstance(stmt, ast.SetVariable):
             self.config.set(stmt.key, stmt.value)
             return self._empty_df()
@@ -243,13 +264,15 @@ class BallistaContext:
             self.register_table(name, t)
             return self.sql(f"select column_name, data_type from {name}")
         logical = SqlToRel(self.catalog).plan(stmt)
-        return BallistaDataFrame(self, logical)
+        return BallistaDataFrame(self, logical,
+                                 sql_text=sql if isinstance(stmt, ast.Select)
+                                 else None)
 
     def _remote_sql(self, sql: str) -> "RemoteDataFrame":
         # DDL and SHOW are handled via scheduler RPCs; SELECT ships verbatim
         import pandas as pd
 
-        stmt = parse_sql(sql)
+        stmt = self._parse_cached(sql)
         if isinstance(stmt, ast.SetVariable):
             # validate locally, then update BOTH ends: the scheduler plans
             # with the session config, the client uses its copy for
@@ -386,7 +409,14 @@ class BallistaContext:
         return self._empty_df()
 
     # --- execution ------------------------------------------------------
-    def _execute_logical(self, logical: L.LogicalPlan) -> List[ColumnBatch]:
+    def _execute_logical(self, logical: L.LogicalPlan,
+                         sql_text: Optional[str] = None) -> List[ColumnBatch]:
+        if self.engine == "standalone" and sql_text is not None:
+            # serving path: the scheduler's plan/result caches key on the
+            # statement text; a hit skips (re-)planning entirely
+            return self._standalone.execute_sql(
+                sql_text, self.catalog, self.config,
+                statement=self._parse_cached(sql_text))
         optimized = optimize(logical)
         planner = PhysicalPlanner(self.catalog, self.config)
         planned = planner.plan_query(optimized)

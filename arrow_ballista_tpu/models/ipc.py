@@ -171,6 +171,24 @@ def read_ipc_files(paths: Sequence[str], schema: Schema, capacity: Optional[int]
     return physical_table_to_batches(table, schema, capacity)
 
 
+def read_ipc_buffers(buffers: Sequence[bytes], schema: Schema,
+                     capacity: Optional[int] = None) -> List[ColumnBatch]:
+    """In-memory twin of :func:`read_ipc_files` for serving cached results
+    (scheduler/serving_cache.py): identical decode pipeline over IPC file
+    bytes held in RAM, so a cached result is bit-identical to re-reading
+    the original shuffle files."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.ipc as ipc
+
+    tables = [ipc.open_file(io.BytesIO(b)).read_all() for b in buffers]
+    if not tables:
+        return [ColumnBatch.empty(schema, capacity or 1024)]
+    table = pa.concat_tables(tables, promote_options="permissive") if len(tables) > 1 else tables[0]
+    return physical_table_to_batches(table, schema, capacity)
+
+
 def physical_table_to_batches(table, schema: Schema, capacity: Optional[int] = None) -> List[ColumnBatch]:
     import pyarrow as pa
     import pyarrow.compute as pc

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import io
 import json
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -42,8 +43,31 @@ from ..models.schema import Schema
 from . import wire
 from .retry import RetryPolicy
 
+log = logging.getLogger(__name__)
+
 FETCH_RETRIES = 3
 RETRY_BACKOFF_S = 3.0
+
+# convert/upload workers for the streaming path: chunk k's
+# IPC-table -> device-batch conversion runs here while the socket reads
+# chunk k+1 (at most one in flight per stream, so ordering and resume
+# bookkeeping stay trivial).  Module-level + lazy: threads are shared by
+# every concurrent fetch in the process and never spawned for
+# non-streaming workloads.
+_CONVERT_POOL = None
+_CONVERT_POOL_LOCK = threading.Lock()
+
+
+def _convert_pool():
+    global _CONVERT_POOL
+    if _CONVERT_POOL is None:
+        with _CONVERT_POOL_LOCK:
+            if _CONVERT_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _CONVERT_POOL = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="dp-convert")
+    return _CONVERT_POOL
 
 #: codecs the streaming path may negotiate ("none" disables compression)
 WIRE_CODECS = ("lz4", "zstd")
@@ -356,6 +380,24 @@ def fetch_partition_stream(host: str, port: int, path: str, schema: Schema,
             req["token"] = token
         if state["next_chunk"]:
             state["resumed"] = state["next_chunk"]
+        # decode/upload pipeline: at most ONE chunk's
+        # physical_table_to_batches (the device-transfer half) runs on the
+        # convert pool while this thread reads + CRC-checks + IPC-decodes
+        # the next frame off the socket.  next_chunk/wire_bytes commit only
+        # when the convert completes, so a mid-stream failure still resumes
+        # at the first chunk whose batches aren't in `batches`.
+        pending = None  # (chunk_idx, Future[List[ColumnBatch]], wire_len)
+
+        def _commit_pending() -> None:
+            nonlocal pending
+            if pending is None:
+                return
+            pidx, fut, wlen = pending
+            pending = None
+            batches.extend(fut.result())
+            state["next_chunk"] = pidx + 1
+            state["wire_bytes"] += wlen
+
         sock = wire.connect(host, port, policy.connect_timeout_s)
         try:
             sock.settimeout(policy.read_timeout_s)
@@ -377,6 +419,7 @@ def fetch_partition_stream(host: str, port: int, path: str, schema: Schema,
                         resp.get("error_kind", ""))
                 p = resp.get("payload", {})
                 if p.get("eos"):
+                    _commit_pending()
                     state["raw_bytes"] = int(p.get("raw_bytes", 0))
                     state["chunks"] = int(p.get("chunks", 0))
                     state["codec"] = p.get("codec", "none")
@@ -411,14 +454,32 @@ def fetch_partition_stream(host: str, port: int, path: str, schema: Schema,
                         f"{decode_err}",
                         host=host, port=port, path=path, chunk=idx,
                         **(fault_ctx or {})) from decode_err
-                # chunk verified + decoded: commit before reading the next
-                # frame so a later failure resumes exactly here
+                # chunk verified + decoded: retire the previous chunk's
+                # convert (ordered commit), then hand this one to the pool
+                # and go straight back to the socket
+                _commit_pending()
                 if table.num_rows:
-                    batches.extend(physical_table_to_batches(
-                        table, schema, capacity=capacity))
-                state["next_chunk"] = idx + 1
-                state["wire_bytes"] += len(chunk)
+                    pending = (idx, _convert_pool().submit(
+                        physical_table_to_batches, table, schema,
+                        capacity=capacity), len(chunk))
+                else:
+                    state["next_chunk"] = idx + 1
+                    state["wire_bytes"] += len(chunk)
         finally:
+            if pending is not None:
+                # unwinding on error with a convert in flight: commit it if
+                # it succeeds (it was verified) so the resume skips it; if
+                # the CONVERT itself failed, leave next_chunk pointing at it
+                # so the retry re-fetches and re-converts
+                pidx, fut, wlen = pending
+                pending = None
+                try:
+                    batches.extend(fut.result())
+                    state["next_chunk"] = pidx + 1
+                    state["wire_bytes"] += wlen
+                except Exception:  # noqa: BLE001
+                    log.warning("chunk %s convert failed during unwind; "
+                                "retry will re-fetch it", pidx, exc_info=True)
             sock.close()
 
     err: Exception = RuntimeError("unreachable")

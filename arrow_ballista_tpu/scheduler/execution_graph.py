@@ -411,6 +411,32 @@ class ExecutionGraph:
                 changed = True
         return changed
 
+    def preload_stage(self, stage_id: int,
+                      outputs: Dict[int, Tuple[str, List["ShuffleWritePartition"]]]
+                      ) -> bool:
+        """Complete a stage from cached shuffle output without running any
+        of its tasks (serving subplan cache, scheduler/serving_cache.py).
+        Only a stage that is already resolved (RUNNING) and untouched is
+        eligible — resolution must run normally so fetch-failure recovery
+        keeps working on preloaded stages (reopen_partitions requires
+        resolved_plan).  The final stage is never preloaded: its output is
+        the result cache's domain."""
+        stage = self.stages.get(stage_id)
+        if stage is None or stage.state != RUNNING:
+            return False
+        if not stage.output_links:
+            return False
+        if any(t is not None for t in stage.task_infos):
+            return False
+        if sorted(outputs) != list(range(stage.partitions)):
+            return False  # adaptive rewrites changed the task shape
+        stage.outputs = dict(outputs)
+        for p in range(stage.partitions):
+            stage.task_infos[p] = TaskInfo(p, "subplan-cache", "success")
+        stage.state = SUCCESSFUL
+        self.revive()
+        return True
+
     def available_task_count(self) -> int:
         if self.status != "running":
             return 0

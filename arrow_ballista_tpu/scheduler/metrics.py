@@ -62,6 +62,11 @@ class SchedulerMetricsCollector:
     # cluster-history thread)
     def set_event_queue_depth(self, value: int) -> None: ...
     def set_event_loop_lag(self, seconds: float) -> None: ...
+    # serving caches (scheduler/serving_cache.py)
+    def record_plan_cache_hit(self) -> None: ...
+    def record_plan_cache_miss(self) -> None: ...
+    def record_result_cache_hit(self) -> None: ...
+    def record_cache_eviction(self) -> None: ...
     def gather(self) -> str:
         return ""
 
@@ -98,6 +103,10 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.aqe_skew_splits = 0
         self.event_queue_depth = 0
         self.event_loop_lag_s = 0.0
+        self.plan_cache_hits = 0
+        self.plan_cache_misses = 0
+        self.result_cache_hits = 0
+        self.cache_evictions = 0
 
     def record_submitted(self, job_id, queued_at_ms, submitted_at_ms):
         with self._lock:
@@ -176,6 +185,22 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.event_loop_lag_s = seconds
 
+    def record_plan_cache_hit(self):
+        with self._lock:
+            self.plan_cache_hits += 1
+
+    def record_plan_cache_miss(self):
+        with self._lock:
+            self.plan_cache_misses += 1
+
+    def record_result_cache_hit(self):
+        with self._lock:
+            self.result_cache_hits += 1
+
+    def record_cache_eviction(self):
+        with self._lock:
+            self.cache_evictions += 1
+
     def gather(self) -> str:
         with self._lock:
             lines = []
@@ -217,6 +242,18 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             counter("aqe_skew_splits_total", self.aqe_skew_splits,
                     "hot partitions split into multiple tasks by adaptive "
                     "skew mitigation")
+            counter("plan_cache_hits_total", self.plan_cache_hits,
+                    "SQL submissions served from a prepared-plan template "
+                    "(parse/plan/validate skipped)")
+            counter("plan_cache_misses_total", self.plan_cache_misses,
+                    "SQL submissions that planned from scratch (no valid "
+                    "template for the text/params/config/table versions)")
+            counter("result_cache_hits_total", self.result_cache_hits,
+                    "queries or shuffle stages served from cached results "
+                    "without executing any task")
+            counter("cache_evictions_total", self.cache_evictions,
+                    "plan templates and result/subplan entries evicted by "
+                    "the serving caches' LRU byte/entry budgets")
             lines.append("# HELP quarantined_executors executors currently "
                          "quarantined (no new offers)")
             lines.append("# TYPE quarantined_executors gauge")

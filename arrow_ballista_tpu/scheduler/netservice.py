@@ -255,6 +255,11 @@ class SchedulerNetService:
         self._max_schemas = 1024
         self._lock = threading.Lock()
         self._default_prepared: Dict[str, tuple] = {}
+        # result-cache hits parked for one fetch_result round-trip: the
+        # execute_query reply stays a tiny job handle either way, and the
+        # client pulls the bytes exactly once (entries are popped)
+        self._cached_results: "OrderedDict[str, dict]" = OrderedDict()
+        self._max_cached_results = 64
 
         # per-session isolation (reference session_manager.rs:27-57; the
         # Flight-SQL-analog surface below opens one session per client)
@@ -270,6 +275,7 @@ class SchedulerNetService:
         r("explain", self._explain)
         r("execute_query", self._execute_query)
         r("get_job_status", self._get_job_status)
+        r("fetch_result", self._fetch_result)
         r("cancel_job", self._cancel_job)
         r("register_executor", self._register_executor)
         r("heartbeat", self._heartbeat)
@@ -401,26 +407,25 @@ class SchedulerNetService:
             sql = payload["sql"]
         job_id = random_job_id()
 
-        def plan_fn():
-            from ..client.context import extract_scalar
-            from ..ops.physical import TaskContext
-            from ..sql.optimizer import optimize
-            from ..sql.parser import parse_sql
-            from ..sql.planner import SqlToRel
-            from .physical_planner import PhysicalPlanner
+        from .serving import prepare_sql_submission
 
-            logical = optimize(SqlToRel(catalog).plan(parse_sql(sql)))
-            planned = PhysicalPlanner(catalog, session_config).plan_query(logical)
-            ctx = TaskContext(config=session_config, job_id=f"{job_id}-scalars")
-            scalars: Dict[str, object] = {}
-            for sid, splan in planned.scalars:
-                ctx.scalars = scalars
-                scalars[sid] = extract_scalar(splan, ctx)
+        def schema_cb(schema):
             with self._lock:
-                self._final_schemas[job_id] = planned.plan.schema
+                self._final_schemas[job_id] = schema
                 while len(self._final_schemas) > self._max_schemas:
                     self._final_schemas.popitem(last=False)
-            return planned.plan, scalars
+
+        # subplan_ok=False: spooled stage files are served by filesystem
+        # path (port-0 locations), which networked executors cannot reach
+        cached, plan_fn, serving = prepare_sql_submission(
+            self.server, sql, catalog, session_config, job_id,
+            subplan_ok=False, schema_cb=schema_cb)
+        if cached is not None:
+            with self._lock:
+                self._cached_results[job_id] = cached
+                while len(self._cached_results) > self._max_cached_results:
+                    self._cached_results.popitem(last=False)
+            return {"job_id": job_id, "cached": True}, b""
 
         # tenant identity + quotas ride on the session config (plus any
         # per-request overrides already merged into session_config)
@@ -432,11 +437,16 @@ class SchedulerNetService:
             request = AdmissionRequest.from_config(session_config)
         self.server.submit_job(job_id, plan_fn, admission=request,
                                trace=payload.get("trace"),
-                               config=session_config)
+                               config=session_config, serving=serving)
         return {"job_id": job_id}, b""
 
     def _get_job_status(self, payload: dict, _bin: bytes):
         job_id = payload["job_id"]
+        with self._lock:
+            cached = self._cached_results.get(job_id)
+        if cached is not None:
+            return {"state": "successful", "cached": True,
+                    "schema": serde.schema_to_obj(cached["schema"])}, b""
         status = self.server.get_job_status(job_id)
         if status is None:
             return {"state": "not_found"}, b""
@@ -451,6 +461,26 @@ class SchedulerNetService:
             if schema is not None:
                 out["schema"] = serde.schema_to_obj(schema)
         return out, b""
+
+    def _fetch_result(self, payload: dict, _bin: bytes):
+        """One-shot pull of a parked result-cache hit: the reply payload
+        lists ``[partition, [blob_len, ...]]`` per partition and the binary
+        channel carries the Arrow IPC file blobs concatenated in that
+        order (same bytes the executors wrote, so decode is bit-identical
+        to the uncached fetch path)."""
+        job_id = payload["job_id"]
+        with self._lock:
+            cached = self._cached_results.pop(job_id, None)
+        if cached is None:
+            raise PlanningError(f"no cached result parked for job {job_id}")
+        parts = []
+        blob = bytearray()
+        for part, blobs in cached["partitions"]:
+            parts.append([part, [len(b) for b in blobs]])
+            for b in blobs:
+                blob.extend(b)
+        return {"partitions": parts,
+                "schema": serde.schema_to_obj(cached["schema"])}, bytes(blob)
 
     def _cancel_job(self, payload: dict, _bin: bytes):
         self.server.cancel_job(payload["job_id"])

@@ -23,6 +23,9 @@ Beyond the reference surface:
                                operator tree (obs/stats.py)
     GET  /api/cluster/history  ring-buffer time series of cluster samples
                                (utilization, queue depths, event-loop lag)
+    GET  /api/plan-cache       prepared-plan cache: hit/miss/eviction
+                               counters, budgets, recent templates
+    GET  /api/result-cache     result/subplan cache counters + budgets
 """
 from __future__ import annotations
 
@@ -147,6 +150,10 @@ class RestApi:
             h._send(200, self.server.metrics.gather(), ctype="text/plain")
         elif rest == ["admission"]:
             h._send(200, json.dumps(self.server.admission.snapshot()))
+        elif rest == ["plan-cache"]:
+            h._send(200, json.dumps(self.server.plan_cache.snapshot()))
+        elif rest == ["result-cache"]:
+            h._send(200, json.dumps(self.server.result_cache.snapshot()))
         elif rest == ["quarantine"]:
             h._send(200, json.dumps(self.server.quarantine.snapshot()))
         elif rest == ["scaler"]:

@@ -94,7 +94,10 @@ class JobPlanned:
 @dataclasses.dataclass
 class TaskUpdating:
     executor_id: str
-    statuses: List[TaskStatus]
+    # None = drain the executor's status inbox (coalesced intake: many
+    # update_task_status calls fold into one event); a non-None list is
+    # processed verbatim (direct posts from tests/chaos harnesses)
+    statuses: Optional[List[TaskStatus]]
 
 
 @dataclasses.dataclass
@@ -250,6 +253,22 @@ class SchedulerServer:
         # job_id -> submitting session's BallistaConfig (popped at planning
         # or terminal shed/cancel; entries are only written before JobQueued)
         self._job_configs: Dict[str, object] = {}
+        # serving caches (scheduler/serving_cache.py): plan templates +
+        # result/subplan entries, shared by every session; per-session
+        # enable knobs are honoured at submit by the serving entry points
+        from ..utils.config import BallistaConfig
+        from .serving_cache import caches_from_config
+
+        self.plan_cache, self.result_cache = caches_from_config(
+            BallistaConfig(), metrics=self.metrics)
+        # job_id -> ServingJobInfo for SQL jobs on the serving path (popped
+        # at capture on success, or by the terminal-status backstop)
+        self._serving_info: Dict[str, object] = {}
+        # status-report coalescing: executors append under the lock; the
+        # event loop drains an executor's whole inbox in ONE TaskUpdating,
+        # so a flood of single-status reports costs one event, not N
+        self._status_lock = threading.Lock()
+        self._status_inbox: Dict[str, List[TaskStatus]] = {}
         self._event_loop = EventLoop("scheduler-events", self._on_event,
                                      self.config.event_buffer_size,
                                      on_error=self._on_event_error)
@@ -315,6 +334,7 @@ class SchedulerServer:
         self._event_loop.stop()
         self._launch_pool.shutdown(wait=False)
         self.launcher.stop()
+        self.result_cache.close()
 
     def _submit_work(self, fn, *args) -> None:
         """Submit to the launch pool, tolerating shutdown races."""
@@ -353,15 +373,20 @@ class SchedulerServer:
                    plan_fn: Callable[[], Tuple[object, Dict[str, object]]],
                    admission: Optional[AdmissionRequest] = None,
                    trace: Optional[Dict[str, str]] = None,
-                   config: Optional[object] = None) -> None:
+                   config: Optional[object] = None,
+                   serving: Optional[object] = None) -> None:
         """``config``: the submitting session's BallistaConfig — consulted
         at planning time for ``ballista.analysis.plan_checks`` (None = all
         defaults).  Stashed here because the admission queue only carries
-        (job_id, plan_fn)."""
+        (job_id, plan_fn).  ``serving``: ServingJobInfo for SQL jobs going
+        through the serving caches (scheduler/serving.py) — drives template
+        storage, validation skipping, subplan preload and result capture."""
         self.jobs.accept_job(job_id)
         self.obs.on_submitted(job_id, trace)
         if config is not None:
             self._job_configs[job_id] = config
+        if serving is not None:
+            self._serving_info[job_id] = serving
         self._queued_at_ms[job_id] = int(time.time() * 1000)
         self.admission.submit(job_id, plan_fn, admission)
 
@@ -385,6 +410,9 @@ class SchedulerServer:
     def _on_job_terminal(self, status: JobStatus) -> None:
         if status.state in ("successful", "failed", "cancelled"):
             self.admission.release(status.job_id)
+            # backstop: success pops this at capture time; failed/cancelled
+            # (and crashed-handler) paths release the serving info here
+            self._serving_info.pop(status.job_id, None)
             # finalize the job's trace/profile off the retained graph —
             # one hook covers success, failure, cancel and admission shed
             try:
@@ -396,7 +424,15 @@ class SchedulerServer:
 
     def update_task_status(self, executor_id: str,
                            statuses: List[TaskStatus]) -> None:
-        self._event_loop.post(TaskUpdating(executor_id, statuses))
+        # coalesce: append to the executor's inbox, and post a drain event
+        # only when the inbox was empty — N reports landing while one event
+        # is in flight are absorbed together by that single event
+        with self._status_lock:
+            box = self._status_inbox.setdefault(executor_id, [])
+            was_empty = not box
+            box.extend(statuses)
+        if was_empty:
+            self._event_loop.post(TaskUpdating(executor_id, None))
 
     def cancel_job(self, job_id: str) -> None:
         self._event_loop.post(JobCancel(job_id))
@@ -420,8 +456,14 @@ class SchedulerServer:
         if jid:
             job_ids.add(jid)
         # TaskUpdating has no job_id field; its affected jobs ride in the
-        # statuses' task ids
-        for st in getattr(event, "statuses", None) or []:
+        # statuses' task ids.  A drain event (statuses=None) crashed before
+        # emptying its inbox — pull the unprocessed reports out now, or the
+        # jobs they belong to hang until the job deadline
+        statuses = getattr(event, "statuses", None)
+        if statuses is None and isinstance(event, TaskUpdating):
+            with self._status_lock:
+                statuses = self._status_inbox.pop(event.executor_id, [])
+        for st in statuses or []:
             task = getattr(st, "task", None)
             if task is not None and getattr(task, "job_id", None):
                 job_ids.add(task.job_id)
@@ -470,17 +512,30 @@ class SchedulerServer:
         def plan():
             try:
                 cfg = self._job_configs.pop(ev.job_id, None)
+                serving = self._serving_info.get(ev.job_id)
                 plan, scalars = ev.plan_fn()
                 graph = ExecutionGraph.build(ev.job_id, plan)
-                if cfg is None or cfg.get(ANALYSIS_PLAN_CHECKS):
+                if serving is not None and serving.prevalidated:
+                    # template hit: the plan validated at template creation
+                    # and any scan-layout change would have invalidated the
+                    # template (table-version fingerprint), so skip
+                    pass
+                elif cfg is None or cfg.get(ANALYSIS_PLAN_CHECKS):
                     # pre-launch sanity validation (analysis/plan_checks.py):
                     # reject broken stage wiring before any task runs
                     validate_graph(graph)
+                if serving is not None and serving.pending_template is not None:
+                    # only a plan whose graph built (and validated) above
+                    # may become a reusable template
+                    self.plan_cache.store(serving.pending_template)
+                    serving.pending_template = None
                 # runtime re-optimization knobs for this job's lifetime
                 # (ballista.aqe.*, defaults apply when no session config)
                 graph.aqe = AqePolicy.from_config(cfg)
                 graph.scalars = scalars
                 graph.addr_resolver = self._resolve_addr
+                if serving is not None and serving.subplan:
+                    self._preload_subplans(graph, serving)
                 self._event_loop.post(JobPlanned(ev.job_id, graph))
             except Exception as e:  # noqa: BLE001 — planning failure fails the job
                 log.exception("planning failed for job %s", ev.job_id)
@@ -488,6 +543,92 @@ class SchedulerServer:
                                                  f"planning error: {e}"))
 
         self._submit_work(plan)
+
+    def _preload_subplans(self, graph: ExecutionGraph, serving) -> None:
+        """Fingerprint every non-final stage and complete those whose
+        shuffle output is already cached (serving subplan cache).  Runs on
+        the planning worker BEFORE the graph is published to the event
+        loop, so graph access is single-threaded; cached bytes are spooled
+        to scheduler-local files that port-0 locations point at."""
+        from ..ops.shuffle import ShuffleWritePartition
+        from .serving_cache import stage_fingerprint, subplan_cache_key
+
+        for sid, stage in graph.stages.items():
+            if not stage.output_links:
+                continue  # final stage: the result cache's domain
+            if stage.producer_ids:
+                # only LEAF stages: a leaf's fingerprint fully determines
+                # its computation, while a downstream stage's plan sees its
+                # inputs only as UnresolvedShuffleExec stubs — two queries
+                # with different upstream filters would fingerprint alike
+                continue
+            try:
+                serving.stage_fps[sid] = stage_fingerprint(stage.plan)
+            except Exception:  # noqa: BLE001 — unfingerprintable plan shape
+                log.warning("stage fingerprint failed for job %s stage %d",
+                            graph.job_id, sid, exc_info=True)
+        # ascending stage ids are topological (the planner numbers stages
+        # bottom-up), so producers complete before consumers resolve
+        for sid in sorted(serving.stage_fps):
+            key = subplan_cache_key(serving.stage_fps[sid],
+                                    serving.config_fp, serving.table_fp)
+            payload = self.result_cache.get(key)
+            if payload is None:
+                continue
+            outputs = {}
+            for map_part, _executor_id, rows in payload["outputs"]:
+                writes = []
+                for i, (out_part, num_rows, num_bytes, crc, data) in \
+                        enumerate(rows):
+                    path = self.result_cache.spool(
+                        graph.job_id, sid, f"{map_part}-{i}.arrow", data)
+                    writes.append(ShuffleWritePartition(
+                        out_part, path, num_rows, num_bytes, crc))
+                outputs[map_part] = ("subplan-cache", writes)
+            if graph.preload_stage(sid, outputs):
+                serving.preloaded.add(sid)
+
+    def _capture_serving(self, graph: ExecutionGraph, locations,
+                         serving) -> None:
+        """Copy a successful job's result (and completed non-preloaded
+        stage outputs) into the result cache.  Runs on a worker thread
+        right after the terminal status — well inside the
+        job-data-cleanup delay, after which the source files vanish."""
+        from .serving_cache import (
+            capture_result_payload,
+            capture_stage_payload,
+            subplan_cache_key,
+        )
+
+        try:
+            if serving.capture_result and serving.result_key is not None \
+                    and serving.schema is not None:
+                cap = capture_result_payload(
+                    locations, serving.schema,
+                    self.result_cache.max_entry_bytes)
+                if cap is not None:
+                    self.result_cache.put(serving.result_key, cap[0], cap[1])
+                    if serving.tables:
+                        # key[1:4] = (norm_text, params, config_fp)
+                        self.result_cache.remember_tables(
+                            tuple(serving.result_key[1:4]), serving.tables)
+            if serving.subplan:
+                for sid, fp in serving.stage_fps.items():
+                    if sid in serving.preloaded:
+                        continue
+                    stage = graph.stages.get(sid)
+                    if stage is None or stage.state != "successful":
+                        continue
+                    cap = capture_stage_payload(
+                        stage, self.result_cache.max_entry_bytes)
+                    if cap is not None:
+                        self.result_cache.put(
+                            subplan_cache_key(fp, serving.config_fp,
+                                              serving.table_fp),
+                            cap[0], cap[1], kind="subplan")
+        except Exception:  # noqa: BLE001 — capture is best-effort
+            log.exception("serving-cache capture failed for job %s",
+                          graph.job_id)
 
     def _on_job_planned(self, ev: JobPlanned) -> None:
         if ev.graph is None:
@@ -539,8 +680,15 @@ class SchedulerServer:
         return adopted
 
     def _on_task_updating(self, ev: TaskUpdating) -> None:
-        self.cluster.free_slots(ev.executor_id, len(ev.statuses))
-        self._absorb_statuses(ev.executor_id, ev.statuses)
+        statuses = ev.statuses
+        if statuses is None:
+            with self._status_lock:
+                statuses = self._status_inbox.pop(ev.executor_id, [])
+        if not statuses:
+            # a sibling event already drained this inbox
+            return
+        self.cluster.free_slots(ev.executor_id, len(statuses))
+        self._absorb_statuses(ev.executor_id, statuses)
         self._offer()
 
     def _on_executor_lost(self, ev: ExecutorLost) -> None:
@@ -592,6 +740,8 @@ class SchedulerServer:
                 self._cleanup_timers.pop(job_id, None)
             if self._stopped.is_set():
                 return
+            # subplan spool files rehydrated for this job die with it
+            self.result_cache.cleanup_job(job_id)
             for eid in executors:
                 try:
                     self.launcher.clean_job_data(eid, job_id)
@@ -759,6 +909,11 @@ class SchedulerServer:
                 # scheduler must never see a completed job as running
                 self._checkpoint(graph)
                 checkpointed = True
+                serving = self._serving_info.pop(job_id, None)
+                if serving is not None and (serving.capture_result
+                                            or serving.subplan):
+                    self._submit_work(self._capture_serving, graph, payload,
+                                      serving)
                 self.jobs.set_status(
                     JobStatus(job_id, "successful", locations=payload))
                 self.metrics.record_completed(

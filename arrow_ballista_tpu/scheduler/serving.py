@@ -1,0 +1,141 @@
+"""Serving front half of a SQL submission, shared by the standalone
+cluster (`StandaloneCluster.execute_sql`) and the network service
+(`SchedulerNetService._execute_query`) so their cache behaviour cannot
+drift.
+
+``prepare_sql_submission`` consults the scheduler's serving caches
+(scheduler/serving_cache.py) and returns one of two outcomes:
+
+- a **cached result payload** — the query's bytes are already in the
+  result cache for the current table versions and session config; nothing
+  is submitted, planned, or executed;
+- a **plan closure + ServingJobInfo** for ``SchedulerServer.submit_job``.
+  On a plan-template hit the closure merely clones the validated template
+  (parse/plan/validate/scalar-subqueries all skipped); on a miss it runs
+  the full pipeline and arms template/result capture for next time.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+from .serving_cache import (
+    PlanTemplate,
+    RecordingCatalog,
+    ServingJobInfo,
+    clone_plan,
+    config_fingerprint,
+    normalize_sql,
+    plan_cache_enabled,
+    result_cache_enabled,
+    result_cache_key,
+    subplan_cache_enabled,
+    table_versions_fp,
+)
+
+
+def prepare_sql_submission(server, sql_text: str, catalog, config,
+                           job_id: str, subplan_ok: bool = False,
+                           work_dir: Optional[str] = None,
+                           statement=None,
+                           schema_cb: Optional[Callable] = None
+                           ) -> Tuple[Optional[dict], Optional[Callable],
+                                      ServingJobInfo]:
+    """Returns ``(cached_payload, plan_fn, serving)``; exactly one of
+    ``cached_payload`` / ``plan_fn`` is non-None.
+
+    ``subplan_ok`` gates shuffle-stage preload/capture: spooled stage
+    files are read via filesystem paths (port-0 locations), which only
+    works when executors share the scheduler's filesystem — true
+    in-process (standalone), not guaranteed for networked executors.
+    ``statement`` optionally carries an already-parsed AST (the client's
+    per-session parse memo); ``schema_cb`` is invoked with the final
+    Schema as soon as it is known (template hit: inside the returned
+    closure before any task runs)."""
+    plan_on = plan_cache_enabled(config)
+    result_on = result_cache_enabled(config)
+    track = plan_on or result_on
+    norm_text, params = normalize_sql(sql_text) if track else (sql_text, ())
+    config_fp = config_fingerprint(config) if track else ""
+    serving = ServingJobInfo(
+        config_fp=config_fp,
+        subplan=subplan_ok and subplan_cache_enabled(config),
+        capture_result=result_on)
+
+    template = server.plan_cache.lookup(norm_text, params, config_fp,
+                                        catalog) if plan_on else None
+    if template is None and result_on and not plan_on:
+        # no template to learn the referenced tables from: fall back to the
+        # result cache's capture-time hint so the result cache works with
+        # the plan cache disabled
+        tables = server.result_cache.tables_for((norm_text, params,
+                                                 config_fp))
+        if tables:
+            table_fp = table_versions_fp(catalog, tables)
+            payload = server.result_cache.get(
+                result_cache_key(norm_text, params, config_fp, table_fp))
+            if payload is not None:
+                return payload, None, serving
+
+    if template is not None:
+        serving.table_fp = template.table_fp
+        serving.prevalidated = True
+        serving.schema = template.schema
+        serving.tables = template.tables
+        if result_on:
+            rkey = result_cache_key(norm_text, params, config_fp,
+                                    template.table_fp)
+            payload = server.result_cache.get(rkey)
+            if payload is not None:
+                return payload, None, serving
+            serving.result_key = rkey
+
+        def plan_fn():
+            if schema_cb is not None:
+                schema_cb(template.schema)
+            # fresh clone per run: stage splitting / shuffle resolution /
+            # AQE mutate the plan in place, and AQE re-optimizes THIS run
+            # from its own shuffle stats (the template is pre-AQE)
+            return template.bind(), dict(template.scalars)
+
+        return None, plan_fn, serving
+
+    def plan_fn():
+        from ..client.context import extract_scalar
+        from ..ops.physical import TaskContext
+        from ..sql.optimizer import optimize
+        from ..sql.parser import parse_sql
+        from ..sql.planner import SqlToRel
+        from .physical_planner import PhysicalPlanner
+
+        rec = RecordingCatalog(catalog)
+        stmt = statement if statement is not None else parse_sql(sql_text)
+        logical = optimize(SqlToRel(rec).plan(stmt))
+        planned = PhysicalPlanner(rec, config).plan_query(logical)
+        ctx = TaskContext(config=config, job_id=f"{job_id}-scalars",
+                          **({"work_dir": work_dir} if work_dir else {}))
+        scalars = {}
+        for sid, splan in planned.scalars:
+            ctx.scalars = scalars
+            scalars[sid] = extract_scalar(splan, ctx)
+        serving.schema = planned.plan.schema
+        if schema_cb is not None:
+            schema_cb(planned.plan.schema)
+        if track:
+            tables = tuple(sorted(rec.used))
+            serving.tables = tables
+            table_fp = table_versions_fp(catalog, tables)
+            serving.table_fp = table_fp
+            if result_on:
+                serving.result_key = result_cache_key(
+                    norm_text, params, config_fp, table_fp)
+            if plan_on:
+                # pristine clone BEFORE the graph build mutates the plan;
+                # stored by the scheduler only after validation passes
+                serving.pending_template = PlanTemplate(
+                    norm_text, params, config_fp,
+                    master_plan=clone_plan(planned.plan),
+                    scalars=dict(scalars), schema=planned.plan.schema,
+                    tables=tables, table_fp=table_fp)
+        return planned.plan, scalars
+
+    return None, plan_fn, serving

@@ -1,0 +1,757 @@
+"""Serving-path caches: prepared-plan templates and result/subplan reuse.
+
+The serving workload ("millions of users") is thousands of small repeated
+queries, not one long scan — and before this module every submission paid
+SQL parse -> logical plan -> physical plan -> ExecutionGraph construction
+-> plan validation, even for the query it just ran.  Flare (PAPERS.md) is
+the precedent: reuse specialized query artifacts across executions instead
+of re-deriving them per submission.  The process-wide compiled-program
+cache (ops/physical.py shared_program) already applies that lever at the
+kernel level; this module applies it at the plan and result level.
+
+Three layers, all owned by the SchedulerServer and shared by every session:
+
+- :class:`PlanCache` — normalized SQL text (literals extracted as bound
+  parameters, see :func:`normalize_sql`) -> a validated, *pre-AQE* physical
+  plan template.  A hit skips parse/plan/validate/scalar-subquery execution
+  and only stamps a fresh job id and clones the template plan
+  (:func:`clone_plan`; plans are mutated in place during stage resolution
+  and AQE, so live plan objects are never shared across jobs).  Entries are
+  keyed on the referenced tables' versions (resolved file list + mtimes,
+  or a registration generation for in-memory tables — recomputed at every
+  lookup, which is what re-resolves scan file lists) and on the session
+  config fingerprint, so DDL, data changes, or config changes invalidate.
+- :class:`ResultCache` — completed-query result bytes keyed on
+  (plan fingerprint, table versions), served straight from the scheduler:
+  a repeat query never plans, launches, or executes anything.
+- subplan entries in the same :class:`ResultCache` — completed
+  shuffle-stage outputs keyed on the stage's structural fingerprint
+  (:func:`stage_fingerprint`), rehydrated into later jobs by
+  pre-completing the matching stage from the cached bytes.
+
+AQE cooperation: templates capture the plan BEFORE any stage resolves, so
+every run re-optimizes from its own fresh shuffle statistics.  Validator
+cooperation: a template is validated once at creation; rebinding skips
+re-validation because any scan-layout change flips the table-version
+fingerprint and forces a full replan instead.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import os
+import shutil
+import tempfile
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..sql.lexer import tokenize
+from ..utils.config import (
+    PLAN_CACHE_ENABLED,
+    PLAN_CACHE_MAX_BYTES,
+    PLAN_CACHE_MAX_ENTRIES,
+    RESULT_CACHE_ENABLED,
+    RESULT_CACHE_MAX_BYTES,
+    RESULT_CACHE_MAX_ENTRIES,
+    RESULT_CACHE_MAX_ENTRY_BYTES,
+    RESULT_CACHE_SUBPLAN,
+    BallistaConfig,
+)
+
+# --------------------------------------------------------------------------
+# SQL normalization: literals -> bound parameters
+# --------------------------------------------------------------------------
+
+#: keywords whose following number literal is plan STRUCTURE, not data — a
+#: LIMIT shapes the physical plan (fetch counts baked into operators), so
+#: it stays in the template text rather than becoming a parameter
+_STRUCTURAL_NUMBER_AFTER = {"LIMIT", "OFFSET"}
+
+
+def normalize_sql(sql: str) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    """Token-level canonical form of a statement: whitespace, comments and
+    literal spellings stop mattering; number/string literals are replaced
+    by ``?`` slots and returned as the bound-parameter vector.
+
+    Returns ``(normalized_text, params)`` where params is a tuple of
+    ``(kind, value)`` in slot order.  Two submissions with the same
+    normalized text share one template family; each distinct parameter
+    vector binds its own validated plan under that family (planning
+    decisions may inspect literal values, so a bound plan is only reused
+    for the exact vector it was planned with)."""
+    parts: List[str] = []
+    params: List[Tuple[str, str]] = []
+    keep_next_number = False
+    for tok in tokenize(sql):
+        if tok.kind == "eof":
+            break
+        if tok.kind == "number" and not keep_next_number:
+            parts.append("?")
+            params.append(("number", tok.value))
+        elif tok.kind == "string":
+            parts.append("?")
+            params.append(("string", tok.value))
+        else:
+            parts.append(tok.value)
+        keep_next_number = (tok.kind == "ident"
+                            and tok.upper in _STRUCTURAL_NUMBER_AFTER)
+    return " ".join(parts), tuple(params)
+
+
+# --------------------------------------------------------------------------
+# version fingerprints
+# --------------------------------------------------------------------------
+
+#: file suffixes any provider's paths may resolve to; a fingerprint lists
+#: whatever matches so appends (new file) and rewrites (new mtime) both flip
+_DATA_SUFFIXES = (".parquet", ".csv", ".tbl", ".json", ".jsonl", ".ndjson",
+                  ".avro", ".arrow")
+
+#: registration generation for providers: a re-registered table is a new
+#: provider object and draws a fresh generation, so DROP+CREATE (or a
+#: MemoryTable replace) invalidates even when the data looks identical
+_provider_gen = itertools.count(1)
+_provider_gen_lock = threading.Lock()
+
+
+def _digest(obj: object) -> str:
+    return hashlib.sha1(repr(obj).encode()).hexdigest()
+
+
+def _file_version(path: str) -> Tuple[str, int, int]:
+    from ..utils import object_store as obs
+
+    try:
+        fs, p = obs.resolve(path)
+        info = fs.get_file_info(p)
+        mtime = getattr(info, "mtime_ns", None)
+        if mtime is None:
+            mtime = hash(str(getattr(info, "mtime", "")))
+        size = info.size if info.size is not None else -1
+        return (path, int(size), int(mtime))
+    except Exception:  # ballista: allow=recovery-path-logging — unreachable
+        # store: version as (-1, -1) 'unknown', which never equals a real
+        # stat and therefore invalidates rather than falsely matching
+        return (path, -1, -1)
+
+
+def provider_version(provider) -> tuple:
+    """Version token for one table provider.  Path-backed tables version as
+    their resolved file list + per-file (size, mtime); in-memory tables as
+    their row count; every provider also carries a registration generation
+    (see ``_provider_gen``)."""
+    from ..utils import object_store as obs
+
+    gen = getattr(provider, "_serving_gen", None)
+    if gen is None:
+        with _provider_gen_lock:
+            gen = getattr(provider, "_serving_gen", None)
+            if gen is None:
+                provider._serving_gen = gen = next(_provider_gen)
+    paths = getattr(provider, "paths", None)
+    if paths is not None:
+        files: List[Tuple[str, int, int]] = []
+        for p in paths:
+            try:
+                names = obs.list_files(p, _DATA_SUFFIXES)
+            except Exception:  # ballista: allow=recovery-path-logging —
+                # unlistable prefix: version the raw path; _file_version's
+                # own fallback then yields the 'unknown' token
+                names = [p]
+            files.extend(_file_version(f) for f in names)
+        return (type(provider).__name__, gen, tuple(files))
+    table = getattr(provider, "table", None)
+    if table is not None:
+        return (type(provider).__name__, gen, int(table.num_rows))
+    return (type(provider).__name__, gen)
+
+
+def table_versions_fp(catalog, tables) -> str:
+    """Digest of the current versions of ``tables`` as resolved through
+    ``catalog`` (session overlays resolve to their overriding provider, so
+    sessions with private same-named tables never share entries).  A
+    dropped table versions as 'missing' — which never matches the
+    fingerprint taken when it existed."""
+    versions = []
+    for name in sorted(set(tables)):
+        try:
+            versions.append((name, provider_version(catalog.provider(name))))
+        except Exception:  # ballista: allow=recovery-path-logging — dropped
+            # table: 'missing' is a distinct version that can never match a
+            # fingerprint taken while the table existed
+            versions.append((name, "missing"))
+    return _digest(tuple(versions))
+
+
+def config_fingerprint(config: BallistaConfig) -> str:
+    """Digest of every effective config value except the cache knobs
+    themselves (resizing a cache must not invalidate its contents)."""
+    items = [(k, v) for k, v in sorted(config.to_dict().items())
+             if not k.startswith("ballista.plan.cache.")
+             and not k.startswith("ballista.result.cache.")]
+    return _digest(tuple(items))
+
+
+class RecordingCatalog:
+    """Catalog wrapper that records which tables a planning pass touched —
+    the template's invalidation scope.  Wraps any Catalog/OverlayCatalog."""
+
+    def __init__(self, parent):
+        self.parent = parent
+        self.used = set()
+
+    def table_schema(self, name: str):
+        self.used.add(name)
+        return self.parent.table_schema(name)
+
+    def table_names(self):
+        return self.parent.table_names()
+
+    def provider(self, name: str):
+        self.used.add(name)
+        return self.parent.provider(name)
+
+
+# --------------------------------------------------------------------------
+# plan template cloning
+# --------------------------------------------------------------------------
+
+
+def _shared_leaf(v) -> bool:
+    """Values a plan clone SHARES with its template instead of copying:
+    immutable heavyweight data (arrow tables) and lazily-created runtime
+    state that must never be duplicated (locks, metrics, compiled
+    closures).  Templates are pristine — cloned before any execution — so
+    the runtime cases are defensive."""
+    import pyarrow as pa
+
+    from ..ops.physical import MetricsSet
+
+    if isinstance(v, pa.Table):
+        return True
+    if isinstance(v, type(threading.Lock())):
+        return True
+    if isinstance(v, MetricsSet):
+        return True
+    return callable(v) and not isinstance(v, type)
+
+
+def clone_plan(plan):
+    """Deep-copy a physical plan tree into a fresh, independently mutable
+    instance (stage splitting, shuffle resolution and AQE all rewrite plans
+    in place), sharing immutable heavy leaves with the original."""
+    import copy
+
+    memo: Dict[int, object] = {}
+    seen = set()
+
+    def seed(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for v in vars(node).values():
+            if _shared_leaf(v):
+                memo[id(v)] = v
+        for c in node.children():
+            seed(c)
+
+    seed(plan)
+    return copy.deepcopy(plan, memo)
+
+
+def estimate_plan_bytes(plan, norm_text: str = "") -> int:
+    """Rough resident-size estimate for the LRU byte budget: shared table
+    data is excluded (the template does not own it); every plan node and
+    its expression baggage is charged a flat 2 KiB."""
+    count = 0
+    stack = [plan]
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        count += 1
+        stack.extend(node.children())
+    return count * 2048 + 2 * len(norm_text)
+
+
+# --------------------------------------------------------------------------
+# stage structural fingerprint (subplan entries)
+# --------------------------------------------------------------------------
+
+
+def _fp_value(v, out: List[str]) -> None:
+    import numpy as np
+    import pyarrow as pa
+
+    from ..ops.physical import ExecutionPlan
+
+    if v is None or isinstance(v, (bool, int, float, str)):
+        out.append(repr(v))
+    elif isinstance(v, pa.Table):
+        out.append(f"patable({v.num_rows},{v.schema})")
+    elif isinstance(v, np.ndarray):
+        out.append("ndarray(" + hashlib.sha1(
+            np.ascontiguousarray(v).tobytes()).hexdigest() + ")")
+    elif isinstance(v, ExecutionPlan):
+        _fp_node(v, out)
+    elif isinstance(v, (list, tuple)):
+        out.append("[")
+        for item in v:
+            _fp_value(item, out)
+        out.append("]")
+    elif isinstance(v, dict):
+        out.append("{")
+        for k in sorted(v, key=repr):
+            out.append(repr(k))
+            _fp_value(v[k], out)
+        out.append("}")
+    elif dataclasses.is_dataclass(v):
+        out.append(repr(v))
+    else:
+        # Schema, Partitioning and expressions are dataclasses (stable
+        # repr); anything else contributes its type only — two plans that
+        # differ in such a field MAY collide, but subplan entries are
+        # additionally keyed on table versions + config, and the engine's
+        # plan state is dataclass/primitive throughout
+        out.append(type(v).__name__)
+
+
+def _fp_node(node, out: List[str]) -> None:
+    out.append(type(node).__name__)
+    for k in sorted(vars(node)):
+        if k.startswith("_"):
+            continue  # lazy runtime state (compiled closures, caches)
+        out.append(k)
+        _fp_value(vars(node)[k], out)
+
+
+def stage_fingerprint(stage_plan) -> str:
+    """Structural digest of an UNRESOLVED stage plan (taken at graph build,
+    before shuffle resolution installs job-specific locations).  Identical
+    subtrees of DIFFERENT queries fingerprint identically, so a shared
+    scan+partial-aggregate stage can be served across templates."""
+    out: List[str] = []
+    _fp_node(stage_plan, out)
+    return hashlib.sha1("\x1f".join(out).encode()).hexdigest()
+
+
+# --------------------------------------------------------------------------
+# prepared-plan cache
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PlanTemplate:
+    """One bound, validated plan template (see module docstring)."""
+
+    norm_text: str
+    params: tuple
+    config_fp: str
+    master_plan: object          # pristine pre-AQE physical plan (never run)
+    scalars: Dict[str, object]   # executed scalar-subquery values
+    schema: object               # final output Schema
+    tables: Tuple[str, ...]      # invalidation scope
+    table_fp: str
+    nbytes: int = 0
+    hits: int = 0
+
+    def key(self) -> tuple:
+        return (self.norm_text, self.params, self.config_fp)
+
+    def bind(self):
+        """A fresh plan instance for one submission."""
+        return clone_plan(self.master_plan)
+
+
+class PlanCache:
+    """LRU over bound plan templates with entry and estimated-byte budgets.
+    Thread-safe: lookups run on scheduler launch-pool threads and client
+    threads concurrently."""
+
+    def __init__(self, max_entries: int = 256, max_bytes: int = 64 << 20,
+                 metrics=None):
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, PlanTemplate]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def lookup(self, norm_text: str, params: tuple, config_fp: str,
+               catalog) -> Optional[PlanTemplate]:
+        """Template for (text, params, config) IF the referenced tables
+        still carry the fingerprint the template was planned against.
+        Recomputing that fingerprint re-resolves the scan file lists; any
+        drift invalidates the entry and the caller replans."""
+        key = (norm_text, params, config_fp)
+        with self._lock:
+            entry = self._entries.get(key)
+        if entry is None:
+            self._miss()
+            return None
+        current_fp = table_versions_fp(catalog, entry.tables)
+        if current_fp != entry.table_fp:
+            with self._lock:
+                if self._entries.get(key) is entry:
+                    del self._entries[key]
+                    self._bytes -= entry.nbytes
+                self.invalidations += 1
+            self._miss()
+            return None
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+            entry.hits += 1
+            self.hits += 1
+        if self.metrics is not None:
+            self.metrics.record_plan_cache_hit()
+        return entry
+
+    def _miss(self) -> None:
+        with self._lock:
+            self.misses += 1
+        if self.metrics is not None:
+            self.metrics.record_plan_cache_miss()
+
+    def store(self, template: PlanTemplate) -> None:
+        if template.nbytes <= 0:
+            template.nbytes = estimate_plan_bytes(template.master_plan,
+                                                  template.norm_text)
+        evicted = 0
+        with self._lock:
+            key = template.key()
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = template
+            self._bytes += template.nbytes
+            while self._entries and (
+                    len(self._entries) > self.max_entries
+                    or self._bytes > self.max_bytes):
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= dropped.nbytes
+                self.evictions += 1
+                evicted += 1
+        for _ in range(evicted):
+            if self.metrics is not None:
+                self.metrics.record_cache_eviction()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "resident_bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "templates": [
+                    {"text": k[0][:200], "params": len(k[1]),
+                     "hits": e.hits, "bytes": e.nbytes}
+                    for k, e in list(self._entries.items())[-16:]
+                ],
+            }
+
+
+# --------------------------------------------------------------------------
+# result / subplan cache
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _CacheEntry:
+    kind: str            # 'result' | 'subplan'
+    payload: object
+    nbytes: int
+    hits: int = 0
+
+
+def result_cache_key(norm_text: str, params: tuple, config_fp: str,
+                     table_fp: str) -> tuple:
+    return ("result", norm_text, params, config_fp, table_fp)
+
+
+def subplan_cache_key(stage_fp: str, config_fp: str, table_fp: str) -> tuple:
+    return ("subplan", stage_fp, config_fp, table_fp)
+
+
+class ResultCache:
+    """Byte-bounded LRU of completed results and shuffle-stage outputs.
+
+    Result payloads are ``{"partitions": [(part, [file_bytes, ...]), ...],
+    "schema": Schema}`` — the exact on-disk IPC bytes of the final stage,
+    copied into memory at completion (the executor files themselves are
+    deleted by the job-data cleanup timer, so paths cannot be cached).
+    Subplan payloads are ``{"outputs": [(map_part, executor_id,
+    [(output_partition, num_rows, num_bytes, checksum, file_bytes),
+    ...]), ...]}``.  Entries are spooled back to disk on rehydration via
+    :meth:`spool` (readers treat a ``port == 0`` location's path as
+    authoritative, which only holds in-process / shared-filesystem — the
+    caller gates on that)."""
+
+    def __init__(self, max_entries: int = 512, max_bytes: int = 256 << 20,
+                 max_entry_bytes: int = 32 << 20, metrics=None):
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.max_entry_bytes = int(max_entry_bytes)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _CacheEntry]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.subplan_hits = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.rejected_oversize = 0
+        self._spool_dir: Optional[str] = None
+        # (norm_text, params, config_fp) -> referenced table names, learned
+        # at capture: lets a later submission compute the table-version
+        # fingerprint (and so probe the result cache) WITHOUT a plan-cache
+        # template — the two caches stay independently toggleable
+        self._tables_hint: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+    def get(self, key: tuple):
+        """Payload for ``key`` or None.  Table versions are part of the key
+        (recomputed by the caller per submission), so staleness manifests
+        as a plain miss — stale entries age out by LRU."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            entry.hits += 1
+            if entry.kind == "subplan":
+                self.subplan_hits += 1
+            else:
+                self.hits += 1
+        if self.metrics is not None:
+            self.metrics.record_result_cache_hit()
+        return entry.payload
+
+    def put(self, key: tuple, payload, nbytes: int, kind: str = "result") -> None:
+        if nbytes > self.max_entry_bytes:
+            with self._lock:
+                self.rejected_oversize += 1
+            return
+        evicted = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = _CacheEntry(kind, payload, int(nbytes))
+            self._bytes += int(nbytes)
+            while self._entries and (
+                    len(self._entries) > self.max_entries
+                    or self._bytes > self.max_bytes):
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= dropped.nbytes
+                self.evictions += 1
+                evicted += 1
+        for _ in range(evicted):
+            if self.metrics is not None:
+                self.metrics.record_cache_eviction()
+
+    def remember_tables(self, text_key: tuple, tables) -> None:
+        with self._lock:
+            self._tables_hint[text_key] = tuple(tables)
+            self._tables_hint.move_to_end(text_key)
+            while len(self._tables_hint) > 4 * self.max_entries:
+                self._tables_hint.popitem(last=False)
+
+    def tables_for(self, text_key: tuple):
+        with self._lock:
+            return self._tables_hint.get(text_key)
+
+    def invalidate_where(self, pred) -> int:
+        """Drop entries whose key matches ``pred`` (used on DDL to purge a
+        table's results eagerly rather than waiting for LRU age-out)."""
+        with self._lock:
+            doomed = [k for k in self._entries if pred(k)]
+            for k in doomed:
+                self._bytes -= self._entries.pop(k).nbytes
+            self.invalidations += len(doomed)
+            return len(doomed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    # --- rehydration spool -----------------------------------------------
+    def spool(self, job_id: str, stage_id: int, name: str, data: bytes) -> str:
+        """Write cached stage bytes to a scheduler-local file a ``port==0``
+        PartitionLocation can point at; files live under a per-job dir so
+        :meth:`cleanup_job` (wired into the scheduler's job-data cleanup)
+        removes them with the job."""
+        with self._lock:
+            if self._spool_dir is None:
+                self._spool_dir = tempfile.mkdtemp(prefix="ballista-subplan-")
+            root = self._spool_dir
+        d = os.path.join(root, job_id, str(stage_id))
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, name)
+        with open(path, "wb") as fh:
+            fh.write(data)
+        return path
+
+    def cleanup_job(self, job_id: str) -> None:
+        with self._lock:
+            root = self._spool_dir
+        if root is None:
+            return
+        shutil.rmtree(os.path.join(root, job_id), ignore_errors=True)
+
+    def close(self) -> None:
+        with self._lock:
+            root, self._spool_dir = self._spool_dir, None
+        if root is not None:
+            shutil.rmtree(root, ignore_errors=True)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            kinds: Dict[str, int] = {}
+            for e in self._entries.values():
+                kinds[e.kind] = kinds.get(e.kind, 0) + 1
+            return {
+                "entries": len(self._entries),
+                "by_kind": kinds,
+                "resident_bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "max_entry_bytes": self.max_entry_bytes,
+                "hits": self.hits,
+                "subplan_hits": self.subplan_hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "rejected_oversize": self.rejected_oversize,
+            }
+
+
+def caches_from_config(config: BallistaConfig, metrics=None
+                       ) -> Tuple[PlanCache, ResultCache]:
+    """Build the scheduler's cache pair from its startup config.  The
+    enable knobs stay per-session (checked at submit), so one scheduler
+    serves cache-on and cache-off sessions simultaneously; the budgets are
+    fixed at scheduler startup."""
+    plan = PlanCache(config.get(PLAN_CACHE_MAX_ENTRIES),
+                     config.get(PLAN_CACHE_MAX_BYTES), metrics=metrics)
+    result = ResultCache(config.get(RESULT_CACHE_MAX_ENTRIES),
+                         config.get(RESULT_CACHE_MAX_BYTES),
+                         config.get(RESULT_CACHE_MAX_ENTRY_BYTES),
+                         metrics=metrics)
+    return plan, result
+
+
+def plan_cache_enabled(config: BallistaConfig) -> bool:
+    return bool(config.get(PLAN_CACHE_ENABLED))
+
+
+def result_cache_enabled(config: BallistaConfig) -> bool:
+    return bool(config.get(RESULT_CACHE_ENABLED))
+
+
+def subplan_cache_enabled(config: BallistaConfig) -> bool:
+    return bool(config.get(RESULT_CACHE_ENABLED)) \
+        and bool(config.get(RESULT_CACHE_SUBPLAN))
+
+
+# --------------------------------------------------------------------------
+# per-job serving info (threaded through SchedulerServer.submit_job)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ServingJobInfo:
+    """What the serving path knows about a submitted SQL job: the cache key
+    material for capture at completion, whether the graph was built from an
+    already-validated template (skip re-validation), and whether subplan
+    preload/capture applies (local-files deployments only)."""
+
+    result_key: Optional[tuple] = None
+    table_fp: str = ""
+    config_fp: str = ""
+    prevalidated: bool = False
+    subplan: bool = False
+    capture_result: bool = False
+    # final result Schema, needed to decode the captured IPC bytes later;
+    # set by the planning closure (or from the template on a hit)
+    schema: object = None
+    # referenced table names (for the result cache's tables hint)
+    tables: Tuple[str, ...] = ()
+    # stage_id -> structural fingerprint for every non-final stage, computed
+    # at graph build; stages preloaded from cache are excluded from capture
+    stage_fps: Dict[int, str] = dataclasses.field(default_factory=dict)
+    preloaded: set = dataclasses.field(default_factory=set)
+    # template created by this job's planning pass: stored into the plan
+    # cache by the scheduler only after the graph VALIDATES, so a broken
+    # plan can never become a reusable template
+    pending_template: Optional[PlanTemplate] = None
+
+
+def capture_result_payload(locations, schema,
+                           max_entry_bytes: int) -> Optional[Tuple[dict, int]]:
+    """Copy a completed job's final-stage IPC files into a result payload.
+    Returns ``(payload, nbytes)`` or None when any file is unreadable on
+    this host (remote executors without a shared filesystem) or the total
+    exceeds the per-entry cap.  Row-empty locations are skipped exactly as
+    the client-side readers skip them, so a cache hit decodes the same
+    byte set the uncached path would have read."""
+    partitions: List[Tuple[int, List[bytes]]] = []
+    total = 0
+    for part in sorted(locations):
+        blobs: List[bytes] = []
+        for loc in locations[part]:
+            if not loc.num_rows:
+                continue
+            try:
+                with open(loc.path, "rb") as fh:
+                    data = fh.read()
+            except OSError:
+                return None
+            total += len(data)
+            if total > max_entry_bytes:
+                return None
+            blobs.append(data)
+        partitions.append((part, blobs))
+    return {"partitions": partitions, "schema": schema}, total
+
+
+def capture_stage_payload(stage, max_entry_bytes: int
+                          ) -> Optional[Tuple[dict, int]]:
+    """Copy one completed shuffle stage's output files into a subplan
+    payload (see :class:`ResultCache` docstring for the shape)."""
+    outputs = []
+    total = 0
+    for map_part, (executor_id, writes) in sorted(stage.outputs.items()):
+        rows = []
+        for w in writes:
+            try:
+                with open(w.path, "rb") as fh:
+                    data = fh.read()
+            except OSError:
+                return None
+            total += len(data)
+            if total > max_entry_bytes:
+                return None
+            rows.append((w.output_partition, w.num_rows, w.num_bytes,
+                         w.checksum, data))
+        outputs.append((map_part, executor_id, rows))
+    return {"outputs": outputs}, total

@@ -129,6 +129,53 @@ class StandaloneCluster:
                     ExecutorHeartbeat(ex.metadata.executor_id))
 
     # --- query execution -------------------------------------------------
+    def execute_sql(self, sql_text: str, catalog,
+                    config: Optional[BallistaConfig] = None,
+                    statement=None) -> List[ColumnBatch]:
+        """Serving path: SQL text in, batches out, through the scheduler's
+        prepared-plan / result / subplan caches (scheduler/serving.py).  A
+        result-cache hit returns decoded bytes without planning or running
+        anything; ``execute`` below stays cache-free for pre-planned
+        queries (EXPLAIN ANALYZE, chaos/fault harnesses)."""
+        from ..models.ipc import read_ipc_buffers
+        from .serving import prepare_sql_submission
+
+        config = config or self.config
+        job_id = random_job_id()
+        cached, plan_fn, serving = prepare_sql_submission(
+            self.scheduler, sql_text, catalog, config, job_id,
+            subplan_ok=True, work_dir=self.work_dir, statement=statement)
+        if cached is not None:
+            batches: List[ColumnBatch] = []
+            for _part, blobs in cached["partitions"]:
+                batches.extend(read_ipc_buffers(blobs, cached["schema"],
+                                                capacity=config.batch_size))
+            return batches
+        self.last_job_id = job_id
+        from ..admission import AdmissionRequest
+        from ..obs import new_trace_context
+
+        self.scheduler.submit_job(
+            job_id, plan_fn,
+            admission=AdmissionRequest.from_config(config),
+            trace=new_trace_context(), config=config, serving=serving)
+        status = self.scheduler.wait_for_job(
+            job_id, timeout=float(config.job_timeout_s))
+        if status.state == "failed":
+            if status.retriable:
+                from ..utils.errors import ResourceExhausted
+
+                raise ResourceExhausted(f"job {job_id} shed: {status.error}")
+            raise ExecutionError(f"job {job_id} failed: {status.error}")
+        if status.state != "successful":
+            raise ExecutionError(f"job {job_id} ended as {status.state}")
+        batches = []
+        for part in sorted(status.locations):
+            paths = [loc.path for loc in status.locations[part] if loc.num_rows]
+            batches.extend(read_ipc_files(paths, serving.schema,
+                                          capacity=config.batch_size))
+        return batches
+
     def execute(self, planned) -> List[ColumnBatch]:
         """Run a PlannedQuery through the distributed machinery and fetch
         the final-stage output files (the client side of

@@ -93,6 +93,16 @@ SHUFFLE_WIRE_COMPRESSION = "ballista.shuffle.wire.compression"
 # runtime statistics observatory (obs/stats.py + scheduler sampler)
 STATS_HISTORY_CAPACITY = "ballista.stats.history.capacity"
 STATS_HISTORY_INTERVAL_S = "ballista.stats.history.interval.seconds"
+# serving caches (scheduler/serving_cache.py): prepared-plan templates and
+# completed results/subplans keyed on catalog + config versions
+PLAN_CACHE_ENABLED = "ballista.plan.cache.enabled"
+PLAN_CACHE_MAX_ENTRIES = "ballista.plan.cache.max.entries"
+PLAN_CACHE_MAX_BYTES = "ballista.plan.cache.max.bytes"
+RESULT_CACHE_ENABLED = "ballista.result.cache.enabled"
+RESULT_CACHE_MAX_ENTRIES = "ballista.result.cache.max.entries"
+RESULT_CACHE_MAX_BYTES = "ballista.result.cache.max.bytes"
+RESULT_CACHE_MAX_ENTRY_BYTES = "ballista.result.cache.max.entry.bytes"
+RESULT_CACHE_SUBPLAN = "ballista.result.cache.subplan.enabled"
 
 
 @dataclasses.dataclass
@@ -391,6 +401,49 @@ _ENTRIES: Dict[str, ConfigEntry] = {
         ConfigEntry(STATS_HISTORY_INTERVAL_S, 5.0, float,
                     "seconds between cluster-history samples (executor "
                     "utilization, admission queue depth, event-loop lag)"),
+        ConfigEntry(PLAN_CACHE_ENABLED, True, _parse_bool,
+                    "prepared-plan cache: normalized SQL text (literals "
+                    "extracted as bound parameters) -> validated "
+                    "ExecutionGraph template.  A hit skips parse, logical "
+                    "and physical planning, scalar-subquery execution and "
+                    "plan validation; entries are keyed on the referenced "
+                    "tables' versions (resolved file list + mtimes, or "
+                    "registration generation for in-memory tables) and the "
+                    "session-config fingerprint, so DDL, data changes or "
+                    "config changes invalidate correctly (see "
+                    "docs/user-guide/serving.md)"),
+        ConfigEntry(PLAN_CACHE_MAX_ENTRIES, 256, int,
+                    "max bound plan templates resident in the prepared-plan "
+                    "cache (LRU beyond this)"),
+        ConfigEntry(PLAN_CACHE_MAX_BYTES, 64 << 20, int,
+                    "estimated-byte budget of the prepared-plan cache; "
+                    "shared table data is not counted (LRU beyond this)"),
+        ConfigEntry(RESULT_CACHE_ENABLED, False, _parse_bool,
+                    "result/subplan cache: completed-query result bytes "
+                    "(and completed shuffle-stage outputs as subplan "
+                    "entries) keyed on (plan fingerprint, table versions), "
+                    "served straight from the scheduler for repeat "
+                    "queries.  Off by default because a hit skips "
+                    "execution entirely — turn it on for serving "
+                    "workloads.  Capture only happens when the result "
+                    "files are readable on the scheduler host (always "
+                    "true in-process); see docs/user-guide/serving.md"),
+        ConfigEntry(RESULT_CACHE_MAX_ENTRIES, 512, int,
+                    "max entries (results + subplans) resident in the "
+                    "result cache (LRU beyond this)"),
+        ConfigEntry(RESULT_CACHE_MAX_BYTES, 256 << 20, int,
+                    "byte budget of the result/subplan cache (LRU beyond "
+                    "this)"),
+        ConfigEntry(RESULT_CACHE_MAX_ENTRY_BYTES, 32 << 20, int,
+                    "results or stage outputs larger than this are never "
+                    "cached (one giant answer must not wipe the working "
+                    "set)"),
+        ConfigEntry(RESULT_CACHE_SUBPLAN, True, _parse_bool,
+                    "also cache completed shuffle-stage outputs keyed on "
+                    "the stage's structural fingerprint, and pre-complete "
+                    "matching stages of later submissions from the cached "
+                    "bytes (in-process/shared-filesystem deployments only; "
+                    "budget shared with the result cache)"),
     ]
 }
 

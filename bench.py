@@ -568,6 +568,31 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
             result["engine_transport"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[worker] transport bench failed: {e}", file=sys.stderr)
 
+    # --- serving leg: concurrent sessions, caches on vs off -------------
+    # SF0.01 on purpose: per-query work is tiny so scheduler+planning
+    # overhead — what the serving caches attack — dominates the off leg.
+    # BENCH_SERVING=0 skips it; sessions/queries are env-tunable.
+    if (os.environ.get("BENCH_SERVING", "1") != "0"
+            and time.time() < deadline - 150):
+        try:
+            from benchmarks.serving import run_serving_benchmark
+
+            result["serving"] = run_serving_benchmark(
+                sessions=int(os.environ.get("BENCH_SERVING_SESSIONS", "32")),
+                queries_per_session=int(
+                    os.environ.get("BENCH_SERVING_QUERIES", "8")))
+            sv = result["serving"]
+            print(f"[worker] serving: {sv['on']['qps']} qps on vs "
+                  f"{sv['off']['qps']} off "
+                  f"({sv.get('qps_on_over_off', 0)}x), "
+                  f"p99 q2l on={sv['on']['queue_to_launch_p99_ms']} ms "
+                  f"off={sv['off']['queue_to_launch_p99_ms']} ms",
+                  file=sys.stderr)
+            emit("serving")
+        except Exception as e:  # noqa: BLE001 — A/B leg must not kill the run
+            result["serving"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[worker] serving bench failed: {e}", file=sys.stderr)
+
     # --- mesh path: same queries, ICI all_to_all shuffle ----------------
     # guarded end to end: a mesh-path failure must never discard the file
     # numbers already measured above

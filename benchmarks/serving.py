@@ -1,0 +1,320 @@
+"""High-concurrency serving benchmark: N client sessions hammering one
+scheduler with small repeated queries, caches on vs caches off.
+
+What it measures (the serving story of docs/user-guide/serving.md):
+
+- **QPS** per leg — the headline; the acceptance bar is >= 2x with the
+  prepared-plan + result caches on vs both explicitly disabled, same box,
+  same run.
+- **e2e latency** p50/p99 per query, measured client-side.
+- **queue-to-launch** p50/p99 — queued_at -> record_submitted on the
+  scheduler, i.e. admission wait + parse/plan/validate/graph build; the
+  slice the plan cache is built to collapse.  A result-cache hit never
+  submits a job, so only planned submissions contribute samples.
+- **event-loop lag** — max enqueue->dequeue lag of the scheduler's
+  single-consumer loop over the leg (EventLoop.stats()), the saturation
+  signal for the batched status-ingestion work.
+- **cache hit rates** from the serving caches' own snapshots.
+
+Topology: one ``SchedulerNetService`` + in-proc TCP executors per leg, one
+``BallistaContext.remote`` per session (its own server-side session, so
+session creation, per-session config fingerprinting and the shared-catalog
+overlay are all on the measured path).  Tables are registered on the
+scheduler's SHARED catalog so sessions share plan templates, as a serving
+deployment would.
+
+Each leg warms every distinct query once before the timer starts: the
+comparison is steady-state serving throughput, not first-compile walls
+(XLA compile alone would otherwise dominate both legs identically).
+
+CLI:
+    python -m benchmarks.serving                 # full A/B, JSON on stdout
+    python -m benchmarks.serving --smoke         # 8 sessions x q6: asserts
+                                                 # zero errors + plan-cache
+                                                 # hits > 0, exit 1 on fail
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# q6-shaped (filter + global agg, 1 stage) and q1-shaped (group-by agg,
+# 2 stages) templates; literals vary per variant so the plan cache sees
+# ONE normalized text per shape while the result cache sees each variant
+# as its own entry — both tiers are exercised.
+_Q6 = ("select sum(l_extendedprice * l_discount) as revenue "
+       "from lineitem where l_discount between {lo} and {hi} "
+       "and l_quantity < {q}")
+_Q1 = ("select l_returnflag, count(*) as n, sum(l_quantity) as sum_qty, "
+       "avg(l_extendedprice) as avg_price from lineitem "
+       "where l_quantity < {q} group by l_returnflag order by l_returnflag")
+
+_Q6_PARAMS = [(0.02, 0.04, 20), (0.03, 0.05, 24), (0.04, 0.06, 28),
+              (0.05, 0.07, 32)]
+_Q1_PARAMS = [18, 24, 30, 36]
+
+
+def build_workload(shapes: Tuple[str, ...] = ("q6", "q1")) -> List[str]:
+    """The distinct query pool; sessions cycle through it round-robin."""
+    pool: List[str] = []
+    if "q6" in shapes:
+        pool.extend(_Q6.format(lo=lo, hi=hi, q=q) for lo, hi, q in _Q6_PARAMS)
+    if "q1" in shapes:
+        pool.extend(_Q1.format(q=q) for q in _Q1_PARAMS)
+    return pool
+
+
+def ensure_data(scale: float = 0.01, data_dir: Optional[str] = None) -> str:
+    """Generate (once) and return a tiny TPC-H directory for the serving
+    workload; SF0.01 keeps per-query work small so scheduling and planning
+    overheads — the thing the caches attack — dominate the uncached leg."""
+    data_dir = data_dir or os.path.join(REPO, ".bench_data",
+                                        f"tpch-sf{scale:g}")
+    # two layouts exist: bench.py's <name>.parquet dirs and datagen's bare
+    # <name> dirs — accept either, generate the latter when absent
+    if not (os.path.exists(os.path.join(data_dir, "lineitem"))
+            or os.path.exists(os.path.join(data_dir, "lineitem.parquet"))):
+        from benchmarks.datagen import generate_to_dir
+
+        os.makedirs(data_dir, exist_ok=True)
+        generate_to_dir(scale, data_dir, files_per_table=2)
+    return data_dir
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _run_leg(label: str, data_dir: str, sessions: int,
+             queries_per_session: int, pool: List[str],
+             overrides: Dict[str, str], executors: int = 2,
+             concurrent_tasks: int = 4) -> Dict:
+    from arrow_ballista_tpu.catalog import ParquetTable
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+    from benchmarks.schema import TABLES
+
+    conf = {"ballista.shuffle.partitions": "2", **overrides}
+    tmp = tempfile.mkdtemp(prefix=f"serving-{label}-")
+    svc = SchedulerNetService("127.0.0.1", 0, config=BallistaConfig(dict(conf)))
+    svc.start()
+    sched = svc.server
+
+    # raw queue-to-launch samples: shadow record_submitted on the metrics
+    # instance (queued_at -> graph submitted, ms); appends are atomic
+    q2l_ms: List[float] = []
+    _orig_submitted = sched.metrics.record_submitted
+
+    def _rec_submitted(job_id, queued_at_ms, submitted_at_ms):
+        q2l_ms.append(max(0.0, submitted_at_ms - queued_at_ms))
+        _orig_submitted(job_id, queued_at_ms, submitted_at_ms)
+
+    sched.metrics.record_submitted = _rec_submitted
+
+    exs = []
+    result: Dict = {"label": label, "sessions": sessions,
+                    "queries_per_session": queries_per_session}
+    try:
+        for i in range(executors):
+            work = os.path.join(tmp, f"exec{i}")
+            os.makedirs(work)
+            ex = ExecutorServer("127.0.0.1", svc.port, "127.0.0.1", 0,
+                                work_dir=work,
+                                concurrent_tasks=concurrent_tasks,
+                                executor_id=f"serving-{label}-{i}",
+                                config=BallistaConfig(dict(conf)))
+            ex.start()
+            exs.append(ex)
+
+        # shared catalog: register once, sessions resolve the same
+        # providers (and therefore share plan templates on the on-leg)
+        for name in TABLES:
+            path = os.path.join(data_dir, f"{name}.parquet")
+            if not os.path.exists(path):
+                path = os.path.join(data_dir, name)
+            svc.catalog.register(ParquetTable(name, path))
+
+        # warmup: every distinct query once (XLA compiles, scan caches;
+        # on the on-leg this also seeds the plan/result caches — the
+        # timed phase measures the steady serving state)
+        warm = BallistaContext.remote("127.0.0.1", svc.port,
+                                      BallistaConfig(dict(conf)))
+        try:
+            for sql in pool:
+                warm.sql(sql).collect()
+        finally:
+            warm.shutdown()
+
+        ctxs = [BallistaContext.remote("127.0.0.1", svc.port,
+                                       BallistaConfig(dict(conf)))
+                for _ in range(sessions)]
+        e2e_ms: List[float] = []
+        errors: List[str] = []
+        lock = threading.Lock()
+        q2l_before = len(q2l_ms)
+        start_gate = threading.Event()
+
+        def session_worker(si: int, ctx) -> None:
+            start_gate.wait()
+            for k in range(queries_per_session):
+                if k % 4 == 3:
+                    # fresh literal: normalizes to the same template (plan
+                    # cache hit) but is a new result key (result miss) —
+                    # keeps planned submissions, and therefore
+                    # queue-to-launch samples, on BOTH legs
+                    sql = _Q6.format(lo=0.01, hi=0.09,
+                                     q=40 + (si * queries_per_session + k)
+                                     % 50)
+                else:
+                    sql = pool[(si + k) % len(pool)]
+                t0 = time.perf_counter()
+                try:
+                    ctx.sql(sql).collect()
+                    dt = (time.perf_counter() - t0) * 1000
+                    with lock:
+                        e2e_ms.append(dt)
+                except Exception as e:  # noqa: BLE001 — counted + reported
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=session_worker, args=(i, c),
+                                    name=f"serving-sess-{i}", daemon=True)
+                   for i, c in enumerate(ctxs)]
+        for t in threads:
+            t.start()
+        t_wall = time.perf_counter()
+        start_gate.set()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_wall
+        for c in ctxs:
+            c.shutdown()
+
+        total = sessions * queries_per_session
+        e2e = sorted(e2e_ms)
+        q2l = sorted(q2l_ms[q2l_before:])
+        loop = sched._event_loop.stats()
+        pc = sched.plan_cache.snapshot()
+        rc = sched.result_cache.snapshot()
+        result.update({
+            "queries": total,
+            "ok": len(e2e_ms),
+            "errors": len(errors),
+            "error_sample": errors[:3],
+            "wall_s": round(wall, 3),
+            "qps": round(len(e2e_ms) / wall, 1) if wall > 0 else 0.0,
+            "e2e_p50_ms": round(_quantile(e2e, 0.50), 2),
+            "e2e_p99_ms": round(_quantile(e2e, 0.99), 2),
+            "queue_to_launch_p50_ms": round(_quantile(q2l, 0.50), 2),
+            "queue_to_launch_p99_ms": round(_quantile(q2l, 0.99), 2),
+            "planned_submissions": len(q2l),
+            "event_loop_max_lag_s": loop.get("max_lag_s", 0.0),
+            "plan_cache": {"hits": pc["hits"], "misses": pc["misses"],
+                           "hit_rate": round(
+                               pc["hits"] / max(1, pc["hits"] + pc["misses"]),
+                               3)},
+            "result_cache": {"hits": rc["hits"],
+                             "subplan_hits": rc["subplan_hits"],
+                             "misses": rc["misses"],
+                             "entries": rc["entries"]},
+        })
+        return result
+    finally:
+        for ex in exs:
+            ex.stop(notify=False)
+        svc.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_serving_benchmark(data_dir: Optional[str] = None, scale: float = 0.01,
+                          sessions: int = 64, queries_per_session: int = 8,
+                          shapes: Tuple[str, ...] = ("q6", "q1"),
+                          executors: int = 2, concurrent_tasks: int = 4
+                          ) -> Dict:
+    """Both legs, off first (any residual process-level warmth — XLA
+    caches, page cache — then favors the BASELINE, never the caches)."""
+    data_dir = ensure_data(scale, data_dir)
+    pool = build_workload(shapes)
+    off = _run_leg(
+        "caches-off", data_dir, sessions, queries_per_session, pool,
+        {"ballista.plan.cache.enabled": "false",
+         "ballista.result.cache.enabled": "false"},
+        executors=executors, concurrent_tasks=concurrent_tasks)
+    on = _run_leg(
+        "caches-on", data_dir, sessions, queries_per_session, pool,
+        {"ballista.plan.cache.enabled": "true",
+         "ballista.result.cache.enabled": "true"},
+        executors=executors, concurrent_tasks=concurrent_tasks)
+    out = {"scale": scale, "sessions": sessions,
+           "queries_per_session": queries_per_session,
+           "distinct_queries": len(pool), "on": on, "off": off}
+    if off.get("qps"):
+        out["qps_on_over_off"] = round(on["qps"] / off["qps"], 2)
+    return out
+
+
+def run_smoke(sessions: int = 8, queries_per_session: int = 6) -> Dict:
+    """The run_checks.sh gate: N sessions of repeated q6 variants with the
+    caches on; zero errors and a nonzero plan-cache hit rate required."""
+    data_dir = ensure_data(0.01)
+    pool = build_workload(("q6",))
+    leg = _run_leg(
+        "smoke", data_dir, sessions, queries_per_session, pool,
+        {"ballista.plan.cache.enabled": "true",
+         "ballista.result.cache.enabled": "true"},
+        executors=1, concurrent_tasks=4)
+    ok = (leg["errors"] == 0 and leg["ok"] == leg["queries"]
+          and leg["plan_cache"]["hits"] > 0)
+    leg["smoke_pass"] = ok
+    return leg
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sessions", type=int, default=None,
+                    help="concurrent client sessions (default 64; smoke 8)")
+    ap.add_argument("--queries", type=int, default=None,
+                    help="queries per session (default 8; smoke 6)")
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--data", default=None, help="TPC-H data dir "
+                    "(default .bench_data/tpch-sf<scale>, generated)")
+    ap.add_argument("--executors", type=int, default=2)
+    ap.add_argument("--smoke", action="store_true",
+                    help="run_checks gate: q6-only, assert zero errors + "
+                    "plan-cache hits, exit 1 on failure")
+    args = ap.parse_args()
+
+    if args.smoke:
+        leg = run_smoke(sessions=args.sessions or 8,
+                        queries_per_session=args.queries or 6)
+        print(json.dumps(leg, indent=2))
+        if not leg["smoke_pass"]:
+            print("serving smoke FAILED", file=sys.stderr)
+            sys.exit(1)
+        print("serving smoke passed", file=sys.stderr)
+        return
+
+    out = run_serving_benchmark(
+        data_dir=args.data, scale=args.scale,
+        sessions=args.sessions or 64,
+        queries_per_session=args.queries or 8,
+        executors=args.executors)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,424 @@
+"""High-concurrency serving: prepared-plan cache, result/subplan cache,
+and batched status ingestion (scheduler/serving_cache.py, serving.py).
+
+Covers the acceptance matrix of the serving work:
+
+- plan/result cache hits on repeated SQL, bit-identical to uncached runs
+  and to a caches-disabled session;
+- invalidation on data change (file append to a path-backed table),
+  table replacement, config change, and DDL (drop/re-register);
+- >= 32 concurrent sessions against one scheduler with zero errors and a
+  nonzero hit rate;
+- batched status-report ingestion equivalent to per-event delivery;
+- template reuse with AQE enabled (the template is pre-AQE; every run
+  re-optimizes from its own shuffle stats).
+"""
+import threading
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+CACHES_ON = {"ballista.plan.cache.enabled": "true",
+             "ballista.result.cache.enabled": "true",
+             "ballista.shuffle.partitions": "2"}
+CACHES_OFF = {"ballista.plan.cache.enabled": "false",
+              "ballista.result.cache.enabled": "false",
+              "ballista.shuffle.partitions": "2"}
+
+Q6ISH = ("select sum(b * c) as revenue from t "
+         "where b > 0.02 and a < 30")
+Q1ISH = ("select a % 4 as g, count(*) as n, sum(b) as s from t "
+         "group by a % 4 order by g")
+
+
+def _table(n=400, seed=7):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "a": pa.array(rng.integers(0, 40, n).astype(np.int64)),
+        "b": pa.array(rng.uniform(0.0, 0.1, n)),
+        "c": pa.array(rng.uniform(1.0, 100.0, n)),
+    })
+
+
+def _ctx(settings=CACHES_ON):
+    ctx = BallistaContext.standalone(BallistaConfig(dict(settings)))
+    ctx.register_table("t", _table())
+    return ctx
+
+
+def _caches(ctx):
+    sched = ctx._standalone.scheduler
+    return sched.plan_cache, sched.result_cache
+
+
+# --------------------------------------------------------------------------
+# hits + bit-identical results
+# --------------------------------------------------------------------------
+
+
+def test_repeat_query_hits_both_caches():
+    ctx = _ctx()
+    try:
+        df1 = ctx.sql(Q6ISH).to_pandas()
+        df2 = ctx.sql(Q6ISH).to_pandas()
+        assert df1.equals(df2)
+        pc, rc = _caches(ctx)
+        assert pc.snapshot()["hits"] >= 1
+        assert rc.snapshot()["hits"] >= 1
+    finally:
+        ctx.shutdown()
+
+
+def test_cached_results_bit_identical_to_uncached():
+    """q1/q6-shaped pair: the cached replay must byte-match both the first
+    (uncached) run in the same session and a caches-disabled session."""
+    on = _ctx(CACHES_ON)
+    off = _ctx(CACHES_OFF)
+    try:
+        for sql in (Q6ISH, Q1ISH):
+            first = on.sql(sql).to_pandas()   # planned + executed, captured
+            cached = on.sql(sql).to_pandas()  # served from the result cache
+            plain = off.sql(sql).to_pandas()
+            assert first.equals(cached), sql
+            assert plain.equals(cached), sql
+            assert list(first.dtypes) == list(cached.dtypes), sql
+        pc_off, rc_off = _caches(off)
+        assert pc_off.snapshot()["hits"] == 0
+        assert rc_off.snapshot()["hits"] == 0
+    finally:
+        on.shutdown()
+        off.shutdown()
+
+
+def test_different_literals_share_no_result_entry():
+    ctx = _ctx()
+    try:
+        df1 = ctx.sql("select count(*) as n from t where a < 10").to_pandas()
+        df2 = ctx.sql("select count(*) as n from t where a < 20").to_pandas()
+        assert int(df1.n[0]) < int(df2.n[0])
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# invalidation matrix
+# --------------------------------------------------------------------------
+
+
+def test_invalidate_on_data_append(tmp_path):
+    """Path-backed table: appending a file changes the resolved file list,
+    so the table fingerprint rotates and both caches invalidate."""
+    d = tmp_path / "pt"
+    d.mkdir()
+    pq.write_table(pa.table({"x": [1, 2, 3]}), d / "part-0.parquet")
+    ctx = BallistaContext.standalone(BallistaConfig(dict(CACHES_ON)))
+    try:
+        ctx.register_parquet("pt", str(d))
+        q = "select sum(x) as s from pt"
+        assert int(ctx.sql(q).to_pandas().s[0]) == 6
+        assert int(ctx.sql(q).to_pandas().s[0]) == 6  # cached
+        pq.write_table(pa.table({"x": [10]}), d / "part-1.parquet")
+        assert int(ctx.sql(q).to_pandas().s[0]) == 16
+        pc, _rc = _caches(ctx)
+        assert pc.snapshot()["invalidations"] >= 1
+    finally:
+        ctx.shutdown()
+
+
+def test_invalidate_on_table_replace():
+    ctx = _ctx()
+    try:
+        q = "select count(*) as n, sum(a) as s from t"
+        before = ctx.sql(q).to_pandas()
+        ctx.sql(q).to_pandas()  # populate the result cache
+        ctx.register_table("t", pa.table({"a": [100, 200],
+                                          "b": [0.5, 0.6],
+                                          "c": [1.0, 2.0]}))
+        after = ctx.sql(q).to_pandas()
+        assert int(after.n[0]) == 2 and int(after.s[0]) == 300
+        assert not before.equals(after)
+    finally:
+        ctx.shutdown()
+
+
+def test_config_change_uses_separate_entry():
+    """Templates embed physical-planning decisions, so a changed session
+    config must plan its own template — never reuse the old one."""
+    ctx = _ctx()
+    try:
+        df1 = ctx.sql(Q1ISH).to_pandas()
+        ctx.sql("set ballista.shuffle.partitions = 3")
+        df2 = ctx.sql(Q1ISH).to_pandas()
+        # partition count changes float-summation order; values match to ulps
+        assert df1.g.tolist() == df2.g.tolist()
+        assert df1.n.tolist() == df2.n.tolist()
+        assert df1.s.tolist() == pytest.approx(df2.s.tolist())
+        pc, _ = _caches(ctx)
+        snap = pc.snapshot()
+        # one template per config fingerprint for the same text
+        assert snap["misses"] >= 2
+    finally:
+        ctx.shutdown()
+
+
+def test_invalidate_on_ddl_drop_and_reregister():
+    ctx = _ctx()
+    try:
+        q = "select count(*) as n from t"
+        n0 = int(ctx.sql(q).to_pandas().n[0])
+        ctx.sql(q).to_pandas()
+        ctx.deregister_table("t")
+        with pytest.raises(Exception):
+            ctx.sql(q).to_pandas()
+        # re-register: a NEW provider generation — the stale entries keyed
+        # on the dropped provider must not serve
+        ctx.register_table("t", _table(n=123))
+        assert int(ctx.sql(q).to_pandas().n[0]) == 123
+        assert n0 != 123
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# subplan cache (leaf shuffle stages, standalone/shared-fs only)
+# --------------------------------------------------------------------------
+
+
+def test_subplan_reuse_across_different_final_stages():
+    """Two queries with the same leaf group-by stage but different final
+    shapes: the second pre-completes the leaf stage from cached bytes."""
+    ctx = _ctx()
+    try:
+        a = ctx.sql("select a % 4 as g, sum(b) as s from t group by a % 4 "
+                    "order by g").to_pandas()
+        b = ctx.sql("select a % 4 as g, sum(b) as s from t group by a % 4 "
+                    "order by s desc").to_pandas()
+        assert sorted(a.s.tolist()) == pytest.approx(sorted(b.s.tolist()))
+        _pc, rc = _caches(ctx)
+        assert rc.snapshot()["subplan_hits"] >= 1
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# batched status ingestion
+# --------------------------------------------------------------------------
+
+
+def test_status_inbox_drained_after_jobs():
+    ctx = _ctx()
+    try:
+        for _ in range(3):
+            ctx.sql(Q1ISH).to_pandas()
+        sched = ctx._standalone.scheduler
+        with sched._status_lock:
+            assert all(not v for v in sched._status_inbox.values())
+    finally:
+        ctx.shutdown()
+
+
+def test_batched_status_equivalent_to_per_event_delivery():
+    """Coalesced inbox (default) vs one TaskUpdating event per status (the
+    legacy path, still used by tests/chaos): identical results."""
+    from arrow_ballista_tpu.scheduler.scheduler import TaskUpdating
+
+    default_ctx = _ctx()
+    legacy_ctx = _ctx()
+    try:
+        sched = legacy_ctx._standalone.scheduler
+
+        def per_event(executor_id, statuses):
+            for st in statuses:
+                sched._event_loop.post(TaskUpdating(executor_id, [st]))
+
+        sched.update_task_status = per_event
+        got_default = default_ctx.sql(Q1ISH).to_pandas()
+        got_legacy = legacy_ctx.sql(Q1ISH).to_pandas()
+        assert got_default.equals(got_legacy)
+    finally:
+        default_ctx.shutdown()
+        legacy_ctx.shutdown()
+
+
+def test_batched_launch_equivalent_to_per_task_launch():
+    """One launch_tasks call per offer round (default) vs one call per
+    task: identical results — batching is transport-only."""
+    batched_ctx = _ctx()
+    single_ctx = _ctx()
+    try:
+        sched = single_ctx._standalone.scheduler
+        orig = sched.launcher
+
+        class PerTaskLauncher:
+            def launch_tasks(self, executor_id, tasks):
+                for t in tasks:
+                    orig.launch_tasks(executor_id, [t])
+
+            def stop(self):
+                orig.stop()
+
+        sched.launcher = PerTaskLauncher()
+        got_batched = batched_ctx.sql(Q1ISH).to_pandas()
+        got_single = single_ctx.sql(Q1ISH).to_pandas()
+        assert got_batched.equals(got_single)
+    finally:
+        batched_ctx.shutdown()
+        single_ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# AQE + template reuse
+# --------------------------------------------------------------------------
+
+
+def test_template_reuse_with_aqe_enabled():
+    """The template captures the PRE-AQE plan; each bound run re-optimizes
+    at stage boundaries from its own runtime stats."""
+    ctx = BallistaContext.standalone(BallistaConfig(
+        {**CACHES_ON, "ballista.aqe.enabled": "true",
+         "ballista.result.cache.enabled": "false",
+         "ballista.shuffle.partitions": "4"}))
+    try:
+        ctx.register_table("t", _table(n=2000))
+        df1 = ctx.sql(Q1ISH).to_pandas()
+        df2 = ctx.sql(Q1ISH).to_pandas()  # template hit, full re-execution
+        assert df1.equals(df2)
+        pc, _ = _caches(ctx)
+        snap = pc.snapshot()
+        assert snap["hits"] >= 1
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# concurrency stress: >= 32 sessions, one shared scheduler
+# --------------------------------------------------------------------------
+
+
+def test_32_session_stress_zero_errors():
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    sched = SchedulerNetService("127.0.0.1", 0,
+                                config=BallistaConfig(dict(CACHES_ON)))
+    sched.start()
+    ex = None
+    try:
+        import tempfile
+
+        ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                            work_dir=tempfile.mkdtemp(prefix="serving-test-"),
+                            concurrent_tasks=4,
+                            executor_id="serving-stress-0")
+        ex.start()
+        # shared catalog: all sessions resolve one provider, sharing
+        # templates and result entries
+        from arrow_ballista_tpu.catalog import MemoryTable
+
+        sched.catalog.register(MemoryTable("t", _table(n=500)))
+
+        queries = [Q6ISH, Q1ISH,
+                   "select count(*) as n from t where a < 25"]
+        errors = []
+        results = {}
+        lock = threading.Lock()
+
+        def session(si):
+            try:
+                c = BallistaContext.remote("127.0.0.1", sched.port,
+                                           BallistaConfig(dict(CACHES_ON)))
+                try:
+                    for k in range(3):
+                        sql = queries[(si + k) % len(queries)]
+                        df = c.sql(sql).to_pandas()
+                        with lock:
+                            prev = results.setdefault(sql, df)
+                        assert prev.equals(df), f"divergent result for {sql}"
+                finally:
+                    c.shutdown()
+            except Exception as e:  # noqa: BLE001 — collected + asserted
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=session, args=(i,), daemon=True)
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:5]
+        pc = sched.server.plan_cache.snapshot()
+        rc = sched.server.result_cache.snapshot()
+        assert pc["hits"] > 0
+        assert rc["hits"] > 0
+    finally:
+        if ex is not None:
+            ex.stop(notify=False)
+        sched.stop()
+
+
+# --------------------------------------------------------------------------
+# observability surface
+# --------------------------------------------------------------------------
+
+
+def test_rest_and_prometheus_expose_cache_counters():
+    import json
+    import urllib.request
+
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    svc = SchedulerNetService("127.0.0.1", 0, rest_port=0)
+    svc.start()
+    try:
+        rp = svc.rest.port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rp}/api/plan-cache") as r:
+            snap = json.loads(r.read())
+        assert {"hits", "misses", "evictions", "invalidations"} <= set(snap)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rp}/api/result-cache") as r:
+            snap = json.loads(r.read())
+        assert "subplan_hits" in snap
+        text = svc.server.metrics.gather()
+        for fam in ("plan_cache_hits_total", "plan_cache_misses_total",
+                    "result_cache_hits_total", "cache_evictions_total"):
+            assert fam in text
+    finally:
+        svc.stop()
+
+
+# --------------------------------------------------------------------------
+# SQL normalization unit coverage
+# --------------------------------------------------------------------------
+
+
+def test_normalize_sql_binds_literals_keeps_limits():
+    from arrow_ballista_tpu.scheduler.serving_cache import normalize_sql
+
+    t1, p1 = normalize_sql("select * from t where a < 10 and s = 'x'")
+    t2, p2 = normalize_sql("select * from t where a < 99 and s = 'y'")
+    assert t1 == t2
+    assert p1 != p2
+    # LIMIT/OFFSET are structural: different limits are different plans
+    l1, _ = normalize_sql("select a from t limit 5")
+    l2, _ = normalize_sql("select a from t limit 6")
+    assert l1 != l2
+
+
+def test_parse_memo_reused_per_session():
+    ctx = _ctx()
+    try:
+        ctx.sql(Q6ISH).to_pandas()
+        memo_size = len(ctx._ast_memo)
+        ctx.sql(Q6ISH).to_pandas()
+        assert len(ctx._ast_memo) == memo_size
+        assert memo_size >= 1
+    finally:
+        ctx.shutdown()

@@ -10,7 +10,10 @@
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
 #      quarantine, straggler speculation, corrupt-shuffle checksums) —
-#      proves the fault-tolerance paths still recover.
+#      proves the fault-tolerance paths still recover,
+#   5. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
+#      sessions of repeated q6 variants through the prepared-plan +
+#      result caches — zero errors and a nonzero plan-cache hit rate.
 # tests/test_static_analysis.py also runs the lint suite inside tier-1, so
 # pytest alone still gates new violations; this script is the fast
 # standalone form for CI and pre-push hooks.
@@ -32,5 +35,8 @@ python -m pytest tests/test_static_analysis.py tests/test_serde_wire.py \
 
 echo "== chaos recovery suite (-m chaos) =="
 python -m pytest tests/test_chaos.py -q -m chaos -p no:cacheprovider
+
+echo "== serving smoke (8 sessions x q6, caches on) =="
+python -m benchmarks.serving --smoke
 
 echo "all checks passed"
