@@ -157,8 +157,11 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._seq = 0
-        # sorted by (-priority, seq): highest priority first, FIFO within
-        self._queue: List[Tuple[Tuple[int, int], _QueuedJob]] = []
+        # sorted by (-priority, seq): highest priority first, FIFO within.
+        # Rebound in _remove(), which the '(call with self._lock held)'
+        # helper section documents — the analyzer cannot see that contract
+        # through non-_locked helper names, hence the annotation
+        self._queue: List[Tuple[Tuple[int, int], _QueuedJob]] = []  # ballista: guarded-by=_lock
         self._queued: Dict[str, _QueuedJob] = {}
         self._running: Dict[str, AdmissionRequest] = {}
         self._tenant_running: Dict[str, int] = {}
@@ -166,7 +169,9 @@ class AdmissionController:
         self.shed_total = 0
         self.timed_out_total = 0
         self._sweeper: Optional[threading.Thread] = None
-        self._stopped = False
+        # written under _lock in stop(); _ensure_sweeper's unlocked read is
+        # inside the documented caller-holds-_lock helper section
+        self._stopped = False  # ballista: guarded-by=_lock
 
     # --- submission ------------------------------------------------------
     def submit(self, job_id: str, plan_fn: Callable,
@@ -291,6 +296,11 @@ class AdmissionController:
         with self._lock:
             self._stopped = True
             self._cond.notify_all()
+        # join OUTSIDE the lock: the sweeper needs _lock to observe
+        # _stopped and exit.  Bounded so a wedged callback can't hang
+        # scheduler shutdown (the sweeper is a daemon regardless).
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=5.0)
 
     # --- internals (call with self._lock held) ---------------------------
     def _mark_running(self, job_id: str, req: AdmissionRequest) -> None:

@@ -1,0 +1,964 @@
+"""Whole-program concurrency analysis: guarded-by, lock order, handoffs.
+
+The Rust reference gets data-race freedom from the borrow checker; this
+module rebuilds the useful fraction of that guarantee as an
+interprocedural AST pass.  It builds one package-wide model —
+
+  * a **lock inventory**: every ``threading.Lock``/``RLock``/``Condition``
+    attribute per class (a ``Condition(self._lock)`` aliases the lock it
+    wraps) plus module-level locks,
+  * a **held-set map**: for every read/write of a ``self.*`` attribute,
+    the set of locks statically held at that point (``with self._lock:``
+    scopes, propagated one level through ``*_locked`` helper calls — the
+    repo's 'caller holds the lock' convention),
+  * a **thread-entry classification**: methods that run on a thread other
+    than their caller's — ``Thread(target=self.m)`` targets, ``self.m``
+    escaping as a callback argument or container element (the EventLoop
+    handler, RPC/REST route tables), ``do_*`` HTTP handlers, and nested
+    ``def`` closures (launch-pool / timer bodies),
+  * a **lock-acquisition graph**: edges ``A -> B`` when ``B`` can be
+    acquired while ``A`` is held, including interprocedural acquisitions
+    reached through ``self.m()`` and typed-attribute calls
+    (``self.cluster.register(...)`` resolving to ``ClusterState``).
+
+Four rules read the model (rule names in brackets):
+
+``guarded-by``        an attribute of a lock-holding class written outside
+                      ``__init__``, touched from a thread entry point and
+                      from at least one other method, with no single lock
+                      common to all access sites.  Exemptions: a
+                      ``# ballista: guarded-by=<lock>`` annotation on any
+                      assignment to the attribute (documents the guard the
+                      analyzer cannot prove; the named lock must exist),
+                      ``guarded-by=none`` (documented single-writer or
+                      benign-race field), and the ``ATOMIC_SWAP`` allowlist
+                      (fields replaced wholesale with immutable snapshots,
+                      e.g. ``ExecutionGraph.stats`` — readers see either
+                      the old or the new object, never a torn one).
+``lock-order``        any cycle in the acquisition graph (potential
+                      deadlock), including one-lock self-cycles for
+                      non-reentrant ``Lock``s.
+``event-loop-handoff``a mutable object posted into an EventLoop and then
+                      mutated by the posting thread after the post — the
+                      consumer may observe the mutation mid-read.
+``thread-lifecycle``  every ``threading.Thread(...)`` carries an explicit
+                      ``daemon=`` decision, and a thread stored on
+                      ``self`` has a bounded ``join(timeout=...)``
+                      somewhere in its class (shutdown must not hang).
+
+The same model feeds the runtime validator (``analysis/lock_order.py``):
+``build_model()`` exposes lock declaration sites keyed by (path, line), so
+locks observed at runtime (keyed by their creation frame) map back to
+static identities and the observed acquisition order can be checked
+against the static graph.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .framework import (
+    Project,
+    Rule,
+    SourceFile,
+    Violation,
+    dotted_name,
+    import_aliases,
+    register,
+)
+
+PKG = "arrow_ballista_tpu"
+
+#: fields replaced wholesale with a freshly built (effectively immutable)
+#: object — the atomic-swap pattern.  Readers racing the swap see either
+#: the old or the new snapshot; no lock is needed.  Keyed "Class.attr".
+ATOMIC_SWAP: Set[str] = {
+    # RuntimeStatsStore: fold_stage() builds a new per-stage summary and
+    # binds it in one dict.__setitem__; readers only traverse snapshots.
+    "ExecutionGraph.stats",
+}
+
+_GUARD_RE = re.compile(r"#\s*ballista:\s*guarded-by=([A-Za-z0-9_]+)")
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock"}
+_COND_CTOR = "threading.Condition"
+_MUTATORS = {"append", "pop", "clear", "update", "setdefault", "add",
+             "remove", "extend", "popitem", "insert", "discard",
+             "appendleft", "popleft"}
+
+#: (path, class, attr) — '' class means module scope
+LockId = Tuple[str, str, str]
+#: (path, class, method) — '' class means module-level function
+MethodKey = Tuple[str, str, str]
+
+
+class _Access:
+    __slots__ = ("attr", "write", "held", "line")
+
+    def __init__(self, attr: str, write: bool, held: FrozenSet[str], line: int):
+        self.attr, self.write, self.held, self.line = attr, write, held, line
+
+
+class _Method:
+    """Per-method facts: accesses, calls, and lock acquisitions, each with
+    the set of class-local lock tokens held at that point."""
+
+    def __init__(self, name: str, line: int, closure: bool = False):
+        self.name = name
+        self.line = line
+        self.closure = closure  # nested def: runs later, often on another thread
+        self.accesses: List[_Access] = []
+        # (callee method name, held, line) for self.m(...)
+        self.self_calls: List[Tuple[str, FrozenSet[str], int]] = []
+        # (self attr, callee method, held, line) for self.attr.m(...)
+        self.attr_calls: List[Tuple[str, str, FrozenSet[str], int]] = []
+        # (module-level function name, held, line)
+        self.fn_calls: List[Tuple[str, FrozenSet[str], int]] = []
+        # (lock token, held-before, line)
+        self.acquisitions: List[Tuple[str, FrozenSet[str], int]] = []
+        # extra locks callers provably hold (``*_locked`` convention)
+        self.assumed_held: FrozenSet[str] = frozenset()
+
+
+class _ClassModel:
+    def __init__(self, path: str, name: str):
+        self.path = path
+        self.name = name
+        self.locks: Dict[str, int] = {}        # lock attr -> decl line
+        self.rlocks: Set[str] = set()          # subset of locks: reentrant
+        self.cond_alias: Dict[str, str] = {}   # condition attr -> wrapped lock
+        self.guards: Dict[str, Tuple[str, int]] = {}  # attr -> (decl, line)
+        self.attr_types: Dict[str, str] = {}   # self.attr -> class simple name
+        self.containers: Set[str] = set()      # attrs holding dict/list/set/deque
+        self.methods: Dict[str, _Method] = {}
+        self.entries: Set[str] = set()         # thread-entry method names
+
+    def lock_token(self, attr: str) -> Optional[str]:
+        """Normalize an attribute to its lock token (conditions alias the
+        lock they wrap)."""
+        if attr in self.locks:
+            return attr
+        if attr in self.cond_alias:
+            return self.cond_alias[attr]
+        return None
+
+    def all_lock_names(self) -> Set[str]:
+        return set(self.locks) | set(self.cond_alias)
+
+
+class _ModuleModel:
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.locks: Dict[str, int] = {}        # module-level NAME -> line
+        self.rlocks: Set[str] = set()
+        self.classes: Dict[str, _ClassModel] = {}
+        self.functions: Dict[str, _Method] = {}
+
+
+class ConcurrencyModel:
+    """The package-wide model all concurrency rules (and the runtime
+    lock-order validator) read."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, _ModuleModel] = {}
+        # class simple name -> (path, class name); ambiguous names dropped
+        self.class_index: Dict[str, Tuple[str, str]] = {}
+        # (path, line of the lock-creating assignment) -> LockId
+        self.decl_sites: Dict[Tuple[str, int], LockId] = {}
+        # acquisition-order edges with first-seen provenance
+        self.edges: Dict[Tuple[LockId, LockId], Tuple[str, int]] = {}
+        # lock ids that are reentrant (RLock) — self-cycles are fine
+        self.reentrant: Set[LockId] = set()
+
+    # --- graph helpers ---------------------------------------------------
+    def add_edge(self, a: LockId, b: LockId, path: str, line: int) -> None:
+        if (a, b) not in self.edges:
+            self.edges[(a, b)] = (path, line)
+
+    def successors(self, a: LockId) -> List[LockId]:
+        return [b for (x, b) in self.edges if x == a]
+
+    def has_path(self, a: LockId, b: LockId) -> bool:
+        """True when ``b`` is reachable from ``a`` (including a == b via a
+        cycle edge; trivially True when a == b and a self-edge exists)."""
+        seen = {a}
+        stack = [a]
+        while stack:
+            for nxt in self.successors(stack.pop()):
+                if nxt == b:
+                    return True
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False
+
+
+def fmt_lock(lock: LockId) -> str:
+    path, cls, attr = lock
+    return f"{path}:{cls + '.' if cls else ''}{attr}"
+
+
+# --------------------------------------------------------------------------
+# model construction
+# --------------------------------------------------------------------------
+
+def build_model(project: Project) -> ConcurrencyModel:
+    model = ConcurrencyModel()
+    ambiguous: Set[str] = set()
+    for sf in project.source_files():
+        if sf.tree is None:
+            continue
+        mm = _build_module(sf)
+        model.modules[sf.path] = mm
+        for cname in mm.classes:
+            if cname in model.class_index or cname in ambiguous:
+                model.class_index.pop(cname, None)
+                ambiguous.add(cname)
+            else:
+                model.class_index[cname] = (sf.path, cname)
+    _collect_locks(model)
+    _apply_locked_convention(model)
+    _propagate_entries(model)
+    _build_edges(model)
+    return model
+
+
+def _infer_ctor_class(value: ast.expr) -> Optional[str]:
+    """Class simple name when ``value`` constructs one: ``Foo()``,
+    ``mod.Foo()``, ``arg or Foo()``, ``Foo() if c else Bar()`` (first
+    constructed operand wins)."""
+    if isinstance(value, ast.Call):
+        d = dotted_name(value.func)
+        if d is None:
+            return None
+        if "." not in d and d[:1].isupper():
+            return d
+        last = d.split(".")[-1]
+        if d[:1].islower() and last[:1].isupper():
+            return last
+        return None
+    if isinstance(value, ast.BoolOp):
+        for operand in value.values:
+            typ = _infer_ctor_class(operand)
+            if typ is not None:
+                return typ
+        return None
+    if isinstance(value, ast.IfExp):
+        return (_infer_ctor_class(value.body)
+                or _infer_ctor_class(value.orelse))
+    return None
+
+
+def _resolve_ctor(aliases: Dict[str, str], call: ast.Call) -> Optional[str]:
+    d = dotted_name(call.func)
+    if d is None:
+        return None
+    root = d.split(".")[0]
+    return d.replace(root, aliases.get(root, root), 1)
+
+
+def _build_module(sf: SourceFile) -> _ModuleModel:
+    mm = _ModuleModel(sf)
+    aliases = import_aliases(sf.tree)
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            full = _resolve_ctor(aliases, node.value)
+            if full in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        mm.locks[t.id] = node.lineno
+                        if full.endswith("RLock"):
+                            mm.rlocks.add(t.id)
+        if isinstance(node, ast.ClassDef):
+            mm.classes[node.name] = _build_class(sf, node, aliases)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            meth = _Method(node.name, node.lineno)
+            _Walker(sf, None, mm, aliases, meth).walk(node.body, frozenset())
+            mm.functions[node.name] = meth
+    # module-level singleton (``STATS = DataPlaneStats()``): the instance
+    # is importable from any thread, so every public method is an entry
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            d = dotted_name(node.value.func)
+            if d is not None and d in mm.classes:
+                cm = mm.classes[d]
+                cm.entries |= {m for m in cm.methods
+                               if not m.startswith("_")}
+    return mm
+
+
+def _build_class(sf: SourceFile, cls: ast.ClassDef,
+                 aliases: Dict[str, str]) -> _ClassModel:
+    cm = _ClassModel(sf.path, cls.name)
+    # pass 1: lock inventory, guard annotations, attribute types, entries
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                or isinstance(node, ast.AnnAssign):
+            t = node.targets[0] if isinstance(node, ast.Assign) \
+                else node.target
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self" and node.value is not None):
+                m = _GUARD_RE.search(sf.lines[node.lineno - 1]) \
+                    if node.lineno - 1 < len(sf.lines) else None
+                if m:
+                    cm.guards[t.attr] = (m.group(1), node.lineno)
+                if isinstance(node.value, (ast.Dict, ast.List, ast.Set,
+                                           ast.ListComp, ast.DictComp,
+                                           ast.SetComp)):
+                    cm.containers.add(t.attr)
+                if isinstance(node.value, ast.Call):
+                    ctor = dotted_name(node.value.func)
+                    if ctor is not None and ctor.split(".")[-1] in (
+                            "dict", "list", "set", "deque", "defaultdict",
+                            "OrderedDict", "Counter"):
+                        cm.containers.add(t.attr)
+                    full = _resolve_ctor(aliases, node.value)
+                    if full in _LOCK_CTORS:
+                        cm.locks[t.attr] = node.lineno
+                        if full.endswith("RLock"):
+                            cm.rlocks.add(t.attr)
+                    elif full == _COND_CTOR:
+                        arg = node.value.args[0] if node.value.args else None
+                        if (isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "self"):
+                            cm.cond_alias[t.attr] = arg.attr
+                        else:
+                            cm.locks[t.attr] = node.lineno
+                    else:
+                        typ = _infer_ctor_class(node.value)
+                        if typ is not None:
+                            cm.attr_types[t.attr] = typ
+                elif isinstance(node.value, (ast.BoolOp, ast.IfExp)):
+                    # `self.store = store or MemoryKv()` and conditional
+                    # defaults: any constructed operand names the type
+                    typ = _infer_ctor_class(node.value)
+                    if typ is not None:
+                        cm.attr_types[t.attr] = typ
+    # conditions wrapping an attr created later (or never) fall back to
+    # being their own lock token
+    for cond, wrapped in list(cm.cond_alias.items()):
+        if wrapped not in cm.locks:
+            del cm.cond_alias[cond]
+            cm.locks[cond] = cm.locks.get(cond, 0)
+    method_names = {n.name for n in cls.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    _classify_entries(cls, method_names, aliases, cm)
+    # pass 2: per-method walk
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            meth = _Method(node.name, node.lineno)
+            cm.methods[node.name] = meth
+            mm_stub = _ModuleModel(sf)  # module locks resolved later via name
+            _Walker(sf, cm, mm_stub, aliases, meth).walk(node.body, frozenset())
+            cm.methods.update(mm_stub.functions)  # closures registered here
+    return cm
+
+
+def _classify_entries(cls: ast.ClassDef, method_names: Set[str],
+                      aliases: Dict[str, str], cm: _ClassModel) -> None:
+    """Thread-entry methods: Thread targets, escaped ``self.m`` references
+    (callbacks / route tables), ``do_*`` HTTP handlers."""
+    for name in method_names:
+        if name.startswith("do_"):
+            cm.entries.add(name)
+    call_funcs = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            call_funcs.add(id(node.func))
+            full = _resolve_ctor(aliases, node)
+            if full == "threading.Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        d = dotted_name(kw.value)
+                        if d is not None and d.startswith("self."):
+                            cm.entries.add(d.split(".", 1)[1])
+    for node in ast.walk(cls):
+        if (isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and node.attr in method_names
+                and id(node) not in call_funcs):
+            # a bare ``self.m`` escaping the class: some other component
+            # will call it, usually from its own thread
+            cm.entries.add(node.attr)
+
+
+class _Walker:
+    """Statement walker tracking the held-lock set through ``with`` scopes.
+
+    Records attribute accesses, lock acquisitions, and call sites into the
+    given ``_Method``.  Nested ``def``s become pseudo-methods named
+    ``outer.inner`` marked as closures (potentially another thread)."""
+
+    def __init__(self, sf: SourceFile, cm: Optional[_ClassModel],
+                 mm: _ModuleModel, aliases: Dict[str, str], meth: _Method):
+        self.sf = sf
+        self.cm = cm
+        self.mm = mm
+        self.aliases = aliases
+        self.meth = meth
+
+    def _token(self, expr: ast.expr) -> Optional[str]:
+        """Lock token for a with-item / call receiver, or None."""
+        if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and self.cm is not None):
+            return self.cm.lock_token(expr.attr)
+        if isinstance(expr, ast.Name) and expr.id in self.mm.locks:
+            return f"::{expr.id}"  # module-lock marker
+        return None
+
+    def walk(self, stmts: List[ast.stmt], held: FrozenSet[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = _Method(f"{self.meth.name}.{stmt.name}", stmt.lineno,
+                                closure=True)
+                self.mm.functions[inner.name] = inner
+                _Walker(self.sf, self.cm, self.mm, self.aliases, inner) \
+                    .walk(stmt.body, frozenset())
+                continue
+            if isinstance(stmt, ast.With):
+                new_held = held
+                for item in stmt.items:
+                    tok = self._token(item.context_expr)
+                    if tok is not None:
+                        self.meth.acquisitions.append(
+                            (tok, new_held, stmt.lineno))
+                        new_held = new_held | {tok}
+                    else:
+                        self._scan_expr(item.context_expr, held)
+                self.walk(stmt.body, new_held)
+                continue
+            self._writes(stmt, held)
+            for field in ("test", "iter", "value", "exc", "msg"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, ast.expr):
+                    self._scan_expr(sub, held)
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                 ast.Expr, ast.Return, ast.Delete)):
+                self._scan_expr(stmt, held, skip_value=True)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    self.walk(sub, held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self.walk(handler.body, held)
+
+    # --- writes -----------------------------------------------------------
+    def _writes(self, stmt: ast.stmt, held: FrozenSet[str]) -> None:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        for t in self._flatten(targets):
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                self.meth.accesses.append(
+                    _Access(t.attr, True, held, stmt.lineno))
+
+    @staticmethod
+    def _flatten(targets: List[ast.AST]) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                out.extend(t.elts)
+            else:
+                out.append(t)
+        return out
+
+    # --- reads and calls --------------------------------------------------
+    def _scan_expr(self, root: ast.AST, held: FrozenSet[str],
+                   skip_value: bool = False) -> None:
+        if skip_value:
+            nodes: List[ast.AST] = []
+            for field, value in ast.iter_fields(root):
+                if field in ("targets", "target"):
+                    # write targets already recorded; but their Subscript
+                    # slices are reads
+                    for t in (value if isinstance(value, list) else [value]):
+                        if isinstance(t, ast.Subscript):
+                            nodes.append(t.slice)
+                elif isinstance(value, ast.AST):
+                    nodes.append(value)
+                elif isinstance(value, list):
+                    nodes.extend(v for v in value if isinstance(v, ast.AST))
+        else:
+            nodes = [root]
+        for n in nodes:
+            for node in ast.walk(n):
+                if isinstance(node, ast.Call):
+                    self._record_call(node, held)
+                elif (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    self.meth.accesses.append(
+                        _Access(node.attr, False, held, node.lineno))
+
+    def _record_call(self, node: ast.Call, held: FrozenSet[str]) -> None:
+        f = node.func
+        d = dotted_name(f)
+        if d is None:
+            return
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            self.meth.self_calls.append((parts[1], held, node.lineno))
+        elif parts[0] == "self" and len(parts) == 3:
+            attr, m = parts[1], parts[2]
+            # a mutator-method call writes the attr only when the attr is a
+            # known container — ``self.quarantine.remove(id)`` on a helper
+            # object is that object's business, not a dict mutation here
+            if m in _MUTATORS and self.cm is not None \
+                    and attr in self.cm.containers:
+                self.meth.accesses.append(
+                    _Access(attr, True, held, node.lineno))
+            self.meth.attr_calls.append((attr, m, held, node.lineno))
+        elif len(parts) == 1:
+            self.meth.fn_calls.append((parts[0], held, node.lineno))
+        elif len(parts) == 2 and parts[1] in _MUTATORS:
+            pass  # local-variable mutation: out of scope for self-attrs
+
+
+# --------------------------------------------------------------------------
+# post-passes
+# --------------------------------------------------------------------------
+
+def _collect_locks(model: ConcurrencyModel) -> None:
+    for path, mm in model.modules.items():
+        for name, line in mm.locks.items():
+            lid: LockId = (path, "", name)
+            model.decl_sites[(path, line)] = lid
+            if name in mm.rlocks:
+                model.reentrant.add(lid)
+        for cname, cm in mm.classes.items():
+            for attr, line in cm.locks.items():
+                lid = (path, cname, attr)
+                if line:
+                    model.decl_sites[(path, line)] = lid
+                if attr in cm.rlocks:
+                    model.reentrant.add(lid)
+
+
+def _apply_locked_convention(model: ConcurrencyModel) -> None:
+    """``*_locked`` helpers run with whatever locks every intra-class call
+    site holds (intersection); with no visible call site, assume all class
+    locks — the convention says the caller is responsible."""
+    for mm in model.modules.values():
+        for cm in mm.classes.values():
+            for name, meth in cm.methods.items():
+                base = name.rsplit(".", 1)[-1]
+                if not base.endswith("_locked"):
+                    continue
+                sites = [held for other in cm.methods.values()
+                         for (callee, held, _ln) in other.self_calls
+                         if callee == base and other is not meth]
+                if sites:
+                    common = frozenset.intersection(*map(frozenset, sites))
+                else:
+                    common = frozenset(cm.locks)
+                meth.assumed_held = common
+
+
+def _propagate_entries(model: ConcurrencyModel) -> None:
+    """One level: a method called via ``self.m()`` from a thread-entry
+    method also runs on that thread."""
+    for mm in model.modules.values():
+        for cm in mm.classes.values():
+            extra: Set[str] = set()
+            for name in cm.entries:
+                meth = cm.methods.get(name)
+                if meth is None:
+                    continue
+                for callee, _held, _ln in meth.self_calls:
+                    if callee in cm.methods:
+                        extra.add(callee)
+            cm.entries |= extra
+
+
+def _method_key_iter(model: ConcurrencyModel):
+    for path, mm in model.modules.items():
+        for fname, meth in mm.functions.items():
+            yield (path, "", fname), meth, None, mm
+        for cname, cm in mm.classes.items():
+            for mname, meth in cm.methods.items():
+                yield (path, cname, mname), meth, cm, mm
+
+
+def _build_edges(model: ConcurrencyModel) -> None:
+    """Acquisition-order edges: direct nesting plus interprocedural
+    acquisitions (fixpoint over the self/typed-attr/module call graph), so
+    the static graph predicts every order the runtime shim can observe."""
+    methods: Dict[MethodKey, _Method] = {}
+    owner: Dict[MethodKey, Tuple[Optional[_ClassModel], _ModuleModel]] = {}
+    for key, meth, cm, mm in _method_key_iter(model):
+        methods[key] = meth
+        owner[key] = (cm, mm)
+
+    def norm(tok: str, path: str, cm: Optional[_ClassModel]) -> LockId:
+        if tok.startswith("::"):
+            return (path, "", tok[2:])
+        return (path, cm.name if cm else "", tok)
+
+    def callees(key: MethodKey) -> List[Tuple[MethodKey, FrozenSet[str], int]]:
+        path, cname, _ = key
+        cm, mm = owner[key]
+        meth = methods[key]
+        out = []
+        for callee, held, ln in meth.self_calls:
+            k = (path, cname, callee)
+            if k in methods:
+                out.append((k, held, ln))
+        for attr, m, held, ln in meth.attr_calls:
+            if cm is None or attr not in cm.attr_types:
+                continue
+            target = model.class_index.get(cm.attr_types[attr])
+            if target is None:
+                continue
+            k = (target[0], target[1], m)
+            if k in methods:
+                out.append((k, held, ln))
+        for fname, held, ln in meth.fn_calls:
+            k = (path, "", fname)
+            if k in methods:
+                out.append((k, held, ln))
+        return out
+
+    # fixpoint: full set of locks a call into `key` may acquire
+    acq: Dict[MethodKey, Set[LockId]] = {}
+    for key, meth in methods.items():
+        cm, _mm = owner[key]
+        acq[key] = {norm(t, key[0], cm) for (t, _h, _ln) in meth.acquisitions}
+    changed = True
+    while changed:
+        changed = False
+        for key in methods:
+            for k, _held, _ln in callees(key):
+                before = len(acq[key])
+                acq[key] |= acq[k]
+                if len(acq[key]) != before:
+                    changed = True
+
+    for key, meth in methods.items():
+        cm, _mm = owner[key]
+        path = key[0]
+        base_held = meth.assumed_held
+        for tok, held, ln in meth.acquisitions:
+            t = norm(tok, path, cm)
+            for h in held | base_held:
+                model.add_edge(norm(h, path, cm), t, path, ln)
+        for k, held, ln in callees(key):
+            eff = held | base_held
+            if not eff:
+                continue
+            for t in acq[k]:
+                for h in eff:
+                    model.add_edge(norm(h, path, cm), t, path, ln)
+
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+
+_MODEL_CACHE: Dict[int, ConcurrencyModel] = {}
+
+
+def _model_for(project: Project) -> ConcurrencyModel:
+    key = id(project)
+    if key not in _MODEL_CACHE:
+        _MODEL_CACHE.clear()  # one project at a time; avoid unbounded growth
+        _MODEL_CACHE[key] = build_model(project)
+    return _MODEL_CACHE[key]
+
+
+@register
+class GuardedByRule(Rule):
+    """Attributes of lock-holding classes reached from thread entry points
+    must have one lock common to every access site, a ``guarded-by=``
+    annotation naming the external guard, a ``guarded-by=none``
+    single-writer justification, or an ATOMIC_SWAP listing."""
+
+    name = "guarded-by"
+    description = ("shared attributes of lock-holding classes accessed "
+                   "from thread entries under a consistent lock")
+
+    def check(self, project: Project) -> Iterable[Violation]:
+        model = _model_for(project)
+        for path, mm in sorted(model.modules.items()):
+            for cname, cm in sorted(mm.classes.items()):
+                if not cm.locks and not cm.cond_alias:
+                    continue
+                yield from self._check_class(cm)
+
+    def _check_class(self, cm: _ClassModel) -> Iterable[Violation]:
+        lock_names = cm.all_lock_names()
+        # attr -> list of (method, access)
+        sites: Dict[str, List[Tuple[_Method, _Access]]] = {}
+        for mname, meth in cm.methods.items():
+            if mname == "__init__" or mname.startswith("__init__."):
+                continue
+            for acc in meth.accesses:
+                if acc.attr in lock_names or acc.attr.startswith("__"):
+                    continue
+                sites.setdefault(acc.attr, []).append((meth, acc))
+        for attr in sorted(sites):
+            guard = cm.guards.get(attr)
+            if guard is not None:
+                decl, line = guard
+                if decl != "none" and cm.lock_token(decl) is None:
+                    yield Violation(
+                        self.name, cm.path, line,
+                        f"{cm.name}.{attr} is annotated guarded-by={decl} "
+                        f"but {cm.name} has no lock attribute {decl!r}")
+                continue
+            if f"{cm.name}.{attr}" in ATOMIC_SWAP:
+                continue
+            accs = sites[attr]
+            writes = [(m, a) for (m, a) in accs if a.write]
+            if not writes:
+                continue
+            methods_touching = {m.name for (m, a) in accs}
+            if len(methods_touching) < 2:
+                continue
+            if not any(self._on_other_thread(cm, m) for (m, _a) in accs):
+                continue
+            held_sets = [a.held | m.assumed_held for (m, a) in accs]
+            common = frozenset.intersection(*held_sets)
+            if common:
+                continue
+            first = min(writes, key=lambda p: p[1].line)
+            entry_names = sorted({m.name for (m, _a) in accs
+                                  if self._on_other_thread(cm, m)})
+            yield Violation(
+                self.name, cm.path, first[1].line,
+                f"{cm.name}.{attr} is accessed from thread entry point(s) "
+                f"{', '.join(entry_names)} and from "
+                f"{len(methods_touching)} methods with no lock common to "
+                f"all sites — guard it with a class lock, or annotate the "
+                f"assignment with '# ballista: guarded-by=<lock>' (or "
+                f"'guarded-by=none' for a documented single-writer field)")
+
+    @staticmethod
+    def _on_other_thread(cm: _ClassModel, meth: _Method) -> bool:
+        if meth.closure:
+            return True
+        base = meth.name.split(".", 1)[0]
+        return base in cm.entries
+
+
+@register
+class LockOrderRule(Rule):
+    """Cycles in the static lock-acquisition graph are potential
+    deadlocks; one-lock self-cycles on non-reentrant Locks are certain
+    ones."""
+
+    name = "lock-order"
+    description = "no cycles in the static lock-acquisition graph"
+
+    def check(self, project: Project) -> Iterable[Violation]:
+        model = _model_for(project)
+        reported: Set[FrozenSet[LockId]] = set()
+        for (a, b), (path, line) in sorted(model.edges.items(),
+                                           key=lambda kv: kv[1]):
+            if a == b:
+                if a in model.reentrant:
+                    continue
+                yield Violation(
+                    self.name, path, line,
+                    f"non-reentrant lock {fmt_lock(a)} can be re-acquired "
+                    f"while already held (self-deadlock)")
+                continue
+            if not model.has_path(b, a):
+                continue
+            cyc = frozenset((a, b))
+            if cyc in reported:
+                continue
+            reported.add(cyc)
+            yield Violation(
+                self.name, path, line,
+                f"lock-order inversion: {fmt_lock(b)} can be held while "
+                f"acquiring {fmt_lock(a)}, but this site acquires "
+                f"{fmt_lock(b)} while holding {fmt_lock(a)} — a concurrent "
+                f"pair deadlocks")
+
+
+@register
+class EventLoopHandoffRule(Rule):
+    """An object posted into an EventLoop belongs to the consumer; the
+    poster mutating it afterwards races the handler."""
+
+    name = "event-loop-handoff"
+    description = "no mutation of objects after posting them to an EventLoop"
+
+    def check(self, project: Project) -> Iterable[Violation]:
+        model = _model_for(project)
+        for path, mm in sorted(model.modules.items()):
+            if mm.sf.tree is None:
+                continue
+            for fn in self._functions(mm.sf.tree):
+                yield from self._check_fn(model, mm, path, fn)
+
+    def _functions(self, tree: ast.Module):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _is_loop_recv(self, model: ConcurrencyModel, mm: _ModuleModel,
+                      recv: ast.expr) -> bool:
+        d = dotted_name(recv)
+        if d is None:
+            return False
+        last = d.split(".")[-1]
+        if "loop" in last.lower():
+            return True
+        if d.startswith("self.") and d.count(".") == 1:
+            for cm in mm.classes.values():
+                t = cm.attr_types.get(last)
+                if t == "EventLoop":
+                    return True
+        return False
+
+    def _check_fn(self, model: ConcurrencyModel, mm: _ModuleModel, path: str,
+                  fn: ast.FunctionDef) -> Iterable[Violation]:
+        stmts = self._linear(fn)
+        posted: Dict[str, int] = {}  # name -> post line
+        for stmt in stmts:
+            # rebinding forgets the old object
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        posted.pop(t.id, None)
+            for name, line in list(posted.items()):
+                mline = self._mutates(stmt, name)
+                if mline is not None:
+                    yield Violation(
+                        self.name, path, mline,
+                        f"{name!r} was posted to an event loop at line "
+                        f"{line} but is mutated afterwards — the consumer "
+                        f"thread may observe a half-updated object; build "
+                        f"the object fully before posting")
+                    posted.pop(name, None)
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "post" and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and self._is_loop_recv(model, mm, node.func.value)):
+                    posted[node.args[0].id] = node.lineno
+
+    @staticmethod
+    def _linear(fn: ast.FunctionDef) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+
+        def rec(body: List[ast.stmt]) -> None:
+            for s in body:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                out.append(s)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, field, None)
+                    if isinstance(sub, list):
+                        rec(sub)
+                for h in getattr(s, "handlers", []) or []:
+                    rec(h.body)
+
+        rec(fn.body)
+        return out
+
+    @staticmethod
+    def _mutates(stmt: ast.stmt, name: str) -> Optional[int]:
+        def hits(t: ast.AST) -> bool:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if isinstance(t, ast.Attribute):
+                t = t.value
+            return isinstance(t, ast.Name) and t.id == name
+
+        if isinstance(stmt, ast.Assign) and any(map(hits, stmt.targets)):
+            return stmt.lineno
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) \
+                and hits(stmt.target):
+            return stmt.lineno
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            f = stmt.value.func
+            if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                    and isinstance(f.value, ast.Name) and f.value.id == name):
+                return stmt.lineno
+        return None
+
+
+@register
+class ThreadLifecycleRule(Rule):
+    """Every ``threading.Thread(...)`` carries an explicit ``daemon=``
+    decision; a thread stored on ``self`` must have a bounded
+    ``join(timeout=...)`` somewhere in its class so shutdown neither
+    leaks the thread nor hangs on it."""
+
+    name = "thread-lifecycle"
+    description = ("explicit daemon= on every Thread; bounded join for "
+                   "self-stored threads")
+
+    def check(self, project: Project) -> Iterable[Violation]:
+        for sf in project.source_files():
+            if sf.tree is None:
+                continue
+            aliases = import_aliases(sf.tree)
+            for cls in ast.walk(sf.tree):
+                if isinstance(cls, ast.ClassDef):
+                    yield from self._check_scope(sf, aliases, cls,
+                                                 cls.name)
+            yield from self._check_scope(sf, aliases, sf.tree, None,
+                                         toplevel_only=True)
+
+    def _check_scope(self, sf: SourceFile, aliases: Dict[str, str],
+                     scope: ast.AST, cls_name: Optional[str],
+                     toplevel_only: bool = False) -> Iterable[Violation]:
+        joined: Dict[str, bool] = {}  # self attr -> has bounded join
+        thread_attrs: List[Tuple[str, int]] = []
+        skip: Set[int] = set()
+        if toplevel_only:
+            # module scope: ignore statements inside classes (handled above)
+            for node in ast.iter_child_nodes(scope):
+                if isinstance(node, ast.ClassDef):
+                    skip |= set(map(id, ast.walk(node)))
+        for node in ast.walk(scope):
+            if id(node) in skip or not isinstance(node, ast.Call):
+                continue
+            full = _resolve_ctor(aliases, node)
+            if full == "threading.Thread":
+                if not any(kw.arg == "daemon" for kw in node.keywords):
+                    yield Violation(
+                        self.name, sf.path, node.lineno,
+                        "threading.Thread(...) without an explicit daemon= "
+                        "decision — state whether this thread may outlive "
+                        "shutdown")
+            d = dotted_name(node.func)
+            if (d is not None and d.startswith("self.")
+                    and d.endswith(".join") and d.count(".") == 2):
+                attr = d.split(".")[1]
+                bounded = bool(node.args) or any(kw.arg == "timeout"
+                                                 for kw in node.keywords)
+                joined[attr] = joined.get(attr, False) or bounded
+        if cls_name is None:
+            return
+        for node in ast.walk(scope):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)
+                    and _resolve_ctor(aliases, node.value)
+                    == "threading.Thread"):
+                t = node.targets[0]
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    thread_attrs.append((t.attr, node.lineno))
+        for attr, line in thread_attrs:
+            if not joined.get(attr, False):
+                yield Violation(
+                    self.name, sf.path, line,
+                    f"{cls_name}.{attr} stores a Thread but the class never "
+                    f"calls self.{attr}.join(timeout=...) — shutdown leaks "
+                    f"the thread (or an unbounded join could hang)")

@@ -160,7 +160,7 @@ def register(cls: Type[Rule]) -> Type[Rule]:
 
 
 def all_rules() -> Dict[str, Type[Rule]]:
-    from . import rules  # noqa: F401 — importing registers the built-ins
+    from . import concurrency, rules  # noqa: F401 — importing registers
 
     return dict(_REGISTRY)
 

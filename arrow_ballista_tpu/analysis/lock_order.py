@@ -1,0 +1,335 @@
+"""Runtime lock-order validator: instrument real lock acquisitions and
+check them against the static concurrency model.
+
+The static side (``analysis/concurrency.py``) predicts every lock
+acquisition order the package can exhibit; this module observes what a
+live run ACTUALLY does and asserts the two agree:
+
+- no **contradicted** edge: the run never acquires B-then-A when the
+  static graph only allows A-then-B (a would-be inversion the static
+  pass missed or a fix regressed),
+- no **unpredicted** edge: every observed A-then-B is reachable in the
+  static graph — otherwise the model has a blind spot (a call path the
+  interprocedural pass cannot see) and the lock-order rule's 'clean'
+  verdict is weaker than it claims.
+
+Mechanics: :func:`install` replaces ``threading.Lock`` / ``RLock`` /
+``Condition`` with factories that wrap locks created *by package code*
+(decided by the creator's stack frame) in a recording proxy.  Each proxy
+remembers its creation site ``(file, line)``; because the package
+convention is single-line ``self._x = threading.Lock()`` assignments,
+that site equals the declaration line the static model indexes in
+``ConcurrencyModel.decl_sites``, which is how runtime locks map back to
+static identities.  A thread-local stack tracks held proxies; each
+successful acquire records edges ``held -> acquired``.
+
+The shim is debug-only and **zero-cost when off**: nothing is patched
+unless :func:`install` runs, which the wiring (tests/conftest.py,
+benchmarks/serving.py) only does when the
+``ballista.analysis.lock_order.runtime`` config / the
+``BALLISTA_LOCK_ORDER_RUNTIME`` env var enables it.
+
+Condition notes: a ``Condition(wrapped_lock)`` routes its acquire /
+release / wait through the proxy because the proxy deliberately refuses
+to expose ``_release_save`` / ``_acquire_restore`` — ``threading.
+Condition`` then falls back to its pure-Python paths, which call
+``proxy.acquire()`` / ``proxy.release()``.  ``wait()`` therefore
+correctly pops the lock from the held stack while blocked and re-records
+it on wakeup.  ``_is_owned`` IS exposed (delegating to the raw lock)
+because the Condition fallback mis-reports ownership for reentrant
+locks.  A bare ``Condition()`` gets a wrapped RLock attributed to the
+Condition's own creation site, matching the static model's
+own-lock-token fallback for unwrapped conditions.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+#: runtime creation site: (abs file, line)
+Site = Tuple[str, int]
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+# captured BEFORE patching, used for shim-internal state — these must
+# never be proxies or acquire-recording would recurse
+_RAW_LOCK = threading.Lock
+_RAW_RLOCK = threading.RLock
+_RAW_CONDITION = threading.Condition
+
+
+class _Recorder:
+    """Global edge log: (site held, site acquired) -> count."""
+
+    def __init__(self) -> None:
+        self._lock = _RAW_LOCK()
+        self.edges: Dict[Tuple[Site, Site], int] = {}
+        self.sites: Set[Site] = set()
+        self._tls = threading.local()
+
+    def _stack(self) -> List[Site]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_create(self, site: Site) -> None:
+        with self._lock:
+            self.sites.add(site)
+
+    def on_acquire(self, site: Site) -> None:
+        stack = self._stack()
+        new_edges = [(h, site) for h in stack if h != site]
+        stack.append(site)
+        if new_edges:
+            with self._lock:
+                for e in new_edges:
+                    self.edges[e] = self.edges.get(e, 0) + 1
+
+    def on_release(self, site: Site) -> None:
+        stack = self._stack()
+        # remove the most recent occurrence (re-entrant RLocks may hold
+        # the same site multiple times)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == site:
+                del stack[i]
+                return
+
+    def snapshot(self) -> Dict[Tuple[Site, Site], int]:
+        with self._lock:
+            return dict(self.edges)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.edges.clear()
+            self.sites.clear()
+
+
+_recorder = _Recorder()
+
+
+class _LockProxy:
+    """Recording wrapper around a real Lock/RLock.
+
+    Exposes acquire/release/__enter__/__exit__/locked plus a delegating
+    ``_is_owned`` — but NOT ``_release_save``/``_acquire_restore`` — so
+    ``threading.Condition`` uses its pure-Python wait paths (see module
+    docstring) and every transition goes through the recorder.
+    """
+
+    __slots__ = ("_raw", "_site")
+
+    def __init__(self, raw, site: Site):
+        self._raw = raw
+        self._site = site
+        _recorder.on_create(site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            _recorder.on_acquire(self._site)
+        return ok
+
+    def release(self) -> None:
+        _recorder.on_release(self._site)
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def _is_owned(self) -> bool:
+        # Condition.notify/wait need ownership checks.  RLock tracks its
+        # owner — delegate (no recording: this is a query, not a
+        # transition).  A plain Lock has no owner concept; fall back to
+        # Condition's own heuristic, also without recording.
+        raw_owned = getattr(self._raw, "_is_owned", None)
+        if raw_owned is not None:
+            return bool(raw_owned())
+        if self._raw.acquire(False):
+            self._raw.release()
+            return False
+        return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<_LockProxy {self._site[0]}:{self._site[1]} {self._raw!r}>"
+
+
+def _creation_site() -> Optional[Site]:
+    """(file, line) of the immediate caller, or None when that caller is
+    not package code (the lock stays raw).  Only the DIRECT caller counts:
+    locks that stdlib helpers (queue.Queue, ThreadPoolExecutor, Event)
+    create on the package's behalf belong to those helpers' own
+    well-audited discipline and would only add unmappable noise.  The
+    shim's own module is excluded so registry-internal locks never
+    self-instrument."""
+    f = sys._getframe(2)
+    if f is None:
+        return None
+    fn = f.f_code.co_filename
+    if fn.startswith(_PKG_DIR) and not fn.startswith(_ANALYSIS_DIR):
+        return (fn, f.f_lineno)
+    return None
+
+
+def _make_lock_factory(raw_ctor):
+    def factory(*args, **kwargs):
+        raw = raw_ctor(*args, **kwargs)
+        site = _creation_site()
+        if site is None:
+            return raw
+        return _LockProxy(raw, site)
+
+    return factory
+
+
+def _condition_factory(lock=None):
+    if lock is None:
+        site = _creation_site()
+        if site is None:
+            return _RAW_CONDITION()
+        # bare Condition(): the static model treats it as its own lock
+        # token at the Condition's declaration line
+        lock = _LockProxy(_RAW_RLOCK(), site)
+    return _RAW_CONDITION(lock)
+
+
+_installed = False
+
+
+def enabled() -> bool:
+    """True when the shim should run: BALLISTA_LOCK_ORDER_RUNTIME env var
+    (shared truthiness rule) or the config default for
+    ``ballista.analysis.lock_order.runtime``."""
+    from ..utils.config import ANALYSIS_LOCK_ORDER_RUNTIME, BallistaConfig, env_flag
+
+    flag = env_flag("BALLISTA_LOCK_ORDER_RUNTIME")
+    if flag is not None:
+        return flag
+    return bool(BallistaConfig().get(ANALYSIS_LOCK_ORDER_RUNTIME))
+
+
+def install() -> None:
+    """Patch the threading lock constructors.  Idempotent.  Must run
+    before the package modules under test create their locks (i.e. before
+    importing them) for full coverage; later is safe but records less."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _make_lock_factory(_RAW_LOCK)
+    threading.RLock = _make_lock_factory(_RAW_RLOCK)
+    threading.Condition = _condition_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _RAW_LOCK
+    threading.RLock = _RAW_RLOCK
+    threading.Condition = _RAW_CONDITION
+    _installed = False
+
+
+# --------------------------------------------------------------------------
+# validation against the static model
+# --------------------------------------------------------------------------
+
+
+class ValidationReport:
+    def __init__(self) -> None:
+        self.checked = 0          # runtime edges with both ends mapped
+        self.unknown = 0          # runtime edges with an unmapped end
+        self.contradicted: List[str] = []
+        self.unpredicted: List[str] = []
+
+    @property
+    def ok(self) -> bool:
+        return not self.contradicted and not self.unpredicted
+
+    def summary(self) -> str:
+        return (f"lock-order runtime validation: {self.checked} edge(s) "
+                f"checked, {self.unknown} unmapped, "
+                f"{len(self.contradicted)} contradicted, "
+                f"{len(self.unpredicted)} unpredicted")
+
+    def details(self) -> str:
+        lines = [self.summary()]
+        for s in self.contradicted:
+            lines.append(f"  CONTRADICTED {s}")
+        for s in self.unpredicted:
+            lines.append(f"  UNPREDICTED {s}")
+        return "\n".join(lines)
+
+
+def validate(root: Optional[str] = None) -> ValidationReport:
+    """Check every recorded runtime edge against the static model built
+    from ``root`` (default: the repo containing this package).
+
+    - runtime edge (a, b) with static ``has_path(b, a)`` but not
+      ``has_path(a, b)``: **contradicted** — the run proved an inversion
+      of the static order.
+    - runtime edge (a, b) with neither path: **unpredicted** — the static
+      model missed a reachable nesting; its 'no cycles' verdict does not
+      cover this pair.
+
+    Edges whose creation sites don't map to a static declaration (locks
+    made by tests, fixtures, or multi-line declarations) are counted as
+    unmapped, not failed: the validator checks consistency where the two
+    views overlap, and reports the overlap size so a silent mapping
+    regression is visible.
+    """
+    from .concurrency import build_model, fmt_lock
+    from .framework import Project
+
+    if root is None:
+        root = os.path.dirname(_PKG_DIR)
+    model = build_model(Project(root))
+    # (abs file, line) -> LockId via repo-relative path
+    site_to_lock = {}
+    for (rel, line), lid in model.decl_sites.items():
+        site_to_lock[(os.path.join(root, *rel.split("/")), line)] = lid
+
+    rep = ValidationReport()
+    for (sa, sb), count in sorted(_recorder.snapshot().items()):
+        a = site_to_lock.get(sa)
+        b = site_to_lock.get(sb)
+        if a is None or b is None:
+            rep.unknown += 1
+            continue
+        if a == b:
+            # same static lock nested at runtime: either a reentrant
+            # RLock (fine) or a bug LockOrderRule reports statically
+            continue
+        rep.checked += 1
+        desc = (f"{fmt_lock(a)} -> {fmt_lock(b)} (observed {count}x, "
+                f"from {os.path.relpath(sa[0], root)}:{sa[1]} -> "
+                f"{os.path.relpath(sb[0], root)}:{sb[1]})")
+        if model.has_path(a, b):
+            continue
+        if model.has_path(b, a):
+            rep.contradicted.append(desc)
+        else:
+            rep.unpredicted.append(desc)
+    return rep
+
+
+def assert_consistent(root: Optional[str] = None) -> ValidationReport:
+    """validate() + raise AssertionError on any disagreement."""
+    rep = validate(root)
+    if not rep.ok:
+        raise AssertionError(rep.details())
+    return rep
+
+
+def reset() -> None:
+    """Drop all recorded edges/sites (between validation phases)."""
+    _recorder.reset()

@@ -62,6 +62,8 @@ class ExecutorFlightServer:
             self._server.shutdown()
         except Exception:  # noqa: BLE001 — shutdown is best-effort
             log.debug("executor flight shutdown", exc_info=True)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
 
     # --- serving ---------------------------------------------------------
     def _resolve(self, raw: bytes) -> str:

@@ -183,8 +183,9 @@ class ExecutorServer:
         # move kernel->socket via sendfile with no GIL involvement
         # (reference analog: the Flight service next to the gRPC port).
         # One native server per process; extra in-proc executors fall back
-        # to the Python RPC handler.
-        self._native_dp = None
+        # to the Python RPC handler.  Claimed-and-nulled under
+        # _teardown_lock in stop()/kill()
+        self._native_dp = None  # ballista: guarded-by=_teardown_lock
         data_port = self.rpc.port
         # shared-secret auth + bounded fan-in (reference issues bearer tokens
         # at Flight handshake, flight_service.rs:136-157, and bounds fetch
@@ -213,7 +214,14 @@ class ExecutorServer:
         self.policy = policy
         self.heartbeat_interval_s = heartbeat_interval_s
         self._stop = threading.Event()
-        self._draining = False
+        # monotonic False->True flip written by drain_and_stop() (RPC/main
+        # thread) and read by the poll loop + /health route; CPython bool
+        # loads are atomic and readers tolerate one stale iteration
+        self._draining = False  # ballista: guarded-by=none
+        # _teardown_lock serializes stop() vs kill(): chaos fault injection
+        # kills from a pool thread while a fixture teardown stops — without
+        # it both pass the None-checks and double-stop obs_http/_native_dp
+        self._teardown_lock = threading.Lock()
         self._killed = False
         # satellite: bounded/throttled retry loops.  One transition log when
         # the scheduler becomes unreachable (a call blew its give-up
@@ -224,13 +232,16 @@ class ExecutorServer:
         self._log_throttle = ThrottledLogger(log,
                                              interval_s=RETRY_LOG_INTERVAL_S)
         faults.register_kill_target(self.metadata.executor_id, self.kill)
-        self._hb_thread: Optional[threading.Thread] = None
-        self._poll_thread: Optional[threading.Thread] = None
-        self._reporter_thread: Optional[threading.Thread] = None
+        # loop threads: written once by start() before any of them runs,
+        # read only by _join_threads() during shutdown (start happens-before
+        # stop), so no lock is needed
+        self._hb_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        self._poll_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        self._reporter_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
         self._status_queue: "queue.Queue[TaskStatus]" = queue.Queue()
         self.job_data_ttl_s = job_data_ttl_s
         self.janitor_interval_s = janitor_interval_s
-        self._janitor_thread: Optional[threading.Thread] = None
+        self._janitor_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
         self._plan_cache = StagePlanCache()
 
         # optional standard Arrow Flight door (reference
@@ -244,8 +255,10 @@ class ExecutorServer:
                                                host, flight_port)
 
         # observability listener mirroring the scheduler's exposition:
-        # prometheus /metrics + /health (-1 = disabled, 0 = ephemeral port)
-        self.obs_http = None
+        # prometheus /metrics + /health (-1 = disabled, 0 = ephemeral port).
+        # Claimed-and-nulled under _teardown_lock in stop()/kill(); start()
+        # reads it before any other thread exists
+        self.obs_http = None  # ballista: guarded-by=_teardown_lock
         if metrics_port >= 0:
             import json as jsonmod
 
@@ -387,12 +400,17 @@ class ExecutorServer:
         self.stop(notify=True)
 
     def stop(self, notify: bool = True) -> None:
-        if self._killed:
-            # kill() already tore the sockets down abruptly; a later fixture
-            # teardown must not double-stop or notify
+        with self._teardown_lock:
+            if self._killed:
+                # kill() already tore the sockets down abruptly; a later
+                # fixture teardown must not double-stop or notify
+                self._stop.set()
+                return
             self._stop.set()
-            return
-        self._stop.set()
+            # claim the shared resources under the lock so a racing kill()
+            # cannot stop them a second time (or trip over the None)
+            obs_http, self.obs_http = self.obs_http, None
+            native_dp, self._native_dp = self._native_dp, None
         faults.unregister_kill_target(self.metadata.executor_id)
         if notify:
             try:
@@ -405,12 +423,27 @@ class ExecutorServer:
         self.rpc.stop()
         if self.flight is not None:
             self.flight.stop()
-        if self.obs_http is not None:
-            self.obs_http.stop()
-            self.obs_http = None
-        if self._native_dp is not None:
-            self._native_dp.dp_stop()
-            self._native_dp = None
+        if obs_http is not None:
+            obs_http.stop()
+        if native_dp is not None:
+            native_dp.dp_stop()
+        self._join_threads()
+
+    def _join_threads(self) -> None:
+        """Bounded join of the long-lived loops: _stop is already set, so
+        each exits within one poll interval; the timeout keeps a wedged
+        loop from hanging shutdown (the threads are daemons regardless).
+        Skip the current thread: the reporter's final flush can be the one
+        calling stop() via _stop_executor."""
+        cur = threading.current_thread()
+        if self._hb_thread is not None and self._hb_thread is not cur:
+            self._hb_thread.join(timeout=5.0)
+        if self._poll_thread is not None and self._poll_thread is not cur:
+            self._poll_thread.join(timeout=5.0)
+        if self._reporter_thread is not None and self._reporter_thread is not cur:
+            self._reporter_thread.join(timeout=5.0)
+        if self._janitor_thread is not None and self._janitor_thread is not cur:
+            self._janitor_thread.join(timeout=5.0)
 
     def kill(self) -> None:
         """Abrupt death for chaos tests (the ``faults`` kill action):
@@ -419,22 +452,23 @@ class ExecutorServer:
         no final status flush; in-flight tasks unwind as ``killed`` and are
         never reported.  The scheduler must discover the death the hard
         way: launch failures, fetch failures, heartbeat timeout."""
-        if self._killed:
-            return
-        self._killed = True
-        self._stop.set()
+        with self._teardown_lock:
+            if self._killed:
+                return
+            self._killed = True
+            self._stop.set()
+            obs_http, self.obs_http = self.obs_http, None
+            native_dp, self._native_dp = self._native_dp, None
         faults.unregister_kill_target(self.metadata.executor_id)
         log.warning("executor %s killed by fault injection",
                     self.metadata.executor_id)
         self.rpc.stop()
         if self.flight is not None:
             self.flight.stop()
-        if self.obs_http is not None:
-            self.obs_http.stop()
-            self.obs_http = None
-        if self._native_dp is not None:
-            self._native_dp.dp_stop()
-            self._native_dp = None
+        if obs_http is not None:
+            obs_http.stop()
+        if native_dp is not None:
+            native_dp.dp_stop()
         # wait=False: this may run on a pool thread (the task that tripped
         # the failpoint); a joining shutdown would deadlock on itself
         self.executor.pool.shutdown(wait=False)
@@ -530,7 +564,9 @@ class ExecutorServer:
                 self._stop.wait(1.0)
         # final best-effort flush on shutdown — but NOT after kill():
         # a SIGKILLed executor reports nothing
-        if pending and not self._killed:
+        with self._teardown_lock:
+            killed = self._killed
+        if pending and not killed:
             try:
                 self.scheduler.update_task_status(self.metadata.executor_id,
                                                   list(pending))

@@ -69,6 +69,9 @@ class RpcServer:
         # stop-before-start hang deadlocked the whole test suite)
         if self._thread.is_alive():
             self._server.shutdown()
+            # bounded: serve_forever returns once shutdown() is seen; the
+            # timeout keeps a wedged accept loop from hanging teardown
+            self._thread.join(timeout=5.0)
         self._server.server_close()
 
     def _dispatch(self, sock, req: dict, binary: bytes) -> None:

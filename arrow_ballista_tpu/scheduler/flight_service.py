@@ -208,6 +208,8 @@ class BallistaFlightServer:
             self._server.shutdown()
         except Exception:  # noqa: BLE001 — shutdown is best-effort
             log.debug("flight server shutdown", exc_info=True)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
 
     # --- metadata commands (the JDBC/ADBC connect sequence) --------------
     # Every Flight SQL driver issues these on connect, before any query

@@ -206,6 +206,7 @@ class _PollingWatch(_QueueWatch):
 
     def close(self) -> None:
         self._stop.set()
+        self._thread.join(timeout=5.0)
         super().close()
 
 

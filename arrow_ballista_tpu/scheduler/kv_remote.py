@@ -91,7 +91,12 @@ class KvServer:
                     self._log.append((self._seq, op, space, key,
                                       value if op == "put" else None))
                 self._log_lock.notify_all()
-            return {"ok": True, "seq": self._seq}, b""
+                # capture under the lock: reading self._seq after the with
+                # block could return a CONCURRENT txn's seq, and a client
+                # using it as a watch cursor would skip the events between
+                # its own txn and that later one
+                head = self._seq
+            return {"ok": True, "seq": head}, b""
         except TxnGuardFailed as e:
             return {"ok": False, "guard_failed": str(e)}, b""
 
@@ -228,6 +233,9 @@ class _RemoteWatch(_QueueWatch):
 
     def close(self):
         self._stop.set()
+        # bounded: the poll loop re-checks _stop at most one long-poll
+        # (5 s) later; don't hang a caller on a slow server
+        self._thread.join(timeout=6.0)
         super().close()
 
 

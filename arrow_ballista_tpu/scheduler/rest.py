@@ -84,7 +84,9 @@ class RestApi:
         self._thread.start()
 
     def stop(self):
-        self._httpd.shutdown()
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
         self._httpd.server_close()
 
     # --- routing ---------------------------------------------------------

@@ -249,6 +249,12 @@ class SchedulerServer:
         # backends + try_acquire_job)
         self.job_backend = job_backend
         self.scheduler_id = scheduler_id or f"scheduler-{uuid.uuid4().hex[:8]}"
+        # _meta_lock guards the per-job bookkeeping dicts below
+        # (_queued_at_ms, _job_configs, _serving_info): they are touched
+        # from submit threads, admission callbacks (sweeper thread), event
+        # -loop handlers and planning closures.  Scope is always one dict
+        # op — never held across a call that takes another lock
+        self._meta_lock = threading.Lock()
         self._queued_at_ms: Dict[str, int] = {}
         # job_id -> submitting session's BallistaConfig (popped at planning
         # or terminal shed/cancel; entries are only written before JobQueued)
@@ -274,9 +280,11 @@ class SchedulerServer:
                                      on_error=self._on_event_error)
         self._launch_pool = ThreadPoolExecutor(max_workers=8,
                                                thread_name_prefix="launch")
-        self._reaper: Optional[threading.Thread] = None
-        self._spec_monitor: Optional[threading.Thread] = None
-        self._history_sampler: Optional[threading.Thread] = None
+        # loop threads: written once by init() before any concurrency on
+        # them, read only by shutdown() (init happens-before shutdown)
+        self._reaper: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        self._spec_monitor: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        self._history_sampler: Optional[threading.Thread] = None  # ballista: guarded-by=none
         # cluster time series behind GET /api/cluster/history: periodic
         # utilization / queue-depth / event-loop-lag samples in a bounded
         # ring buffer (obs/stats.py)
@@ -326,6 +334,15 @@ class SchedulerServer:
         # after shutdown" killed the event loop mid-run)
         self._stopped.set()
         self.admission.stop()
+        # bounded joins: every loop waits on _stopped (already set), so
+        # each returns within one in-flight iteration; the timeout keeps a
+        # wedged iteration from hanging shutdown (daemons regardless)
+        if self._reaper is not None:
+            self._reaper.join(timeout=5.0)
+        if self._spec_monitor is not None:
+            self._spec_monitor.join(timeout=5.0)
+        if self._history_sampler is not None:
+            self._history_sampler.join(timeout=5.0)
         with self._cleanup_lock:
             timers = list(self._cleanup_timers.values())
             self._cleanup_timers.clear()
@@ -383,11 +400,12 @@ class SchedulerServer:
         storage, validation skipping, subplan preload and result capture."""
         self.jobs.accept_job(job_id)
         self.obs.on_submitted(job_id, trace)
-        if config is not None:
-            self._job_configs[job_id] = config
-        if serving is not None:
-            self._serving_info[job_id] = serving
-        self._queued_at_ms[job_id] = int(time.time() * 1000)
+        with self._meta_lock:
+            if config is not None:
+                self._job_configs[job_id] = config
+            if serving is not None:
+                self._serving_info[job_id] = serving
+            self._queued_at_ms[job_id] = int(time.time() * 1000)
         self.admission.submit(job_id, plan_fn, admission)
 
     # --- admission callbacks (see arrow_ballista_tpu/admission/) ---------
@@ -401,8 +419,9 @@ class SchedulerServer:
         """Shed (queue full / queue timeout): a *retriable* failure — the
         client should back off and resubmit, not treat it as a query
         error."""
-        self._queued_at_ms.pop(job_id, None)
-        self._job_configs.pop(job_id, None)
+        with self._meta_lock:
+            self._queued_at_ms.pop(job_id, None)
+            self._job_configs.pop(job_id, None)
         self.jobs.set_status(JobStatus(job_id, "failed", error=message,
                                        retriable=True))
         self.metrics.record_failed(job_id)
@@ -412,7 +431,8 @@ class SchedulerServer:
             self.admission.release(status.job_id)
             # backstop: success pops this at capture time; failed/cancelled
             # (and crashed-handler) paths release the serving info here
-            self._serving_info.pop(status.job_id, None)
+            with self._meta_lock:
+                self._serving_info.pop(status.job_id, None)
             # finalize the job's trace/profile off the retained graph —
             # one hook covers success, failure, cancel and admission shed
             try:
@@ -478,7 +498,8 @@ class SchedulerServer:
             graph = self.jobs.get_graph(job_id)
             if graph is not None and graph.status == "running":
                 graph.status = "failed"
-            self._queued_at_ms.pop(job_id, None)
+            with self._meta_lock:
+                self._queued_at_ms.pop(job_id, None)
             self.jobs.set_status(JobStatus(
                 job_id, "failed",
                 error=f"scheduler event handler crashed: "
@@ -511,8 +532,9 @@ class SchedulerServer:
         # (reference spawns planning too, query_stage_scheduler.rs:106-148)
         def plan():
             try:
-                cfg = self._job_configs.pop(ev.job_id, None)
-                serving = self._serving_info.get(ev.job_id)
+                with self._meta_lock:
+                    cfg = self._job_configs.pop(ev.job_id, None)
+                    serving = self._serving_info.get(ev.job_id)
                 plan, scalars = ev.plan_fn()
                 graph = ExecutionGraph.build(ev.job_id, plan)
                 if serving is not None and serving.prevalidated:
@@ -634,14 +656,16 @@ class SchedulerServer:
         if ev.graph is None:
             self.jobs.set_status(JobStatus(ev.job_id, "failed", error=ev.error))
             self.metrics.record_failed(ev.job_id)
-            self._queued_at_ms.pop(ev.job_id, None)
+            with self._meta_lock:
+                self._queued_at_ms.pop(ev.job_id, None)
             return
         self.obs.on_planned(ev.job_id)
         # hand the execution span's context to every task of this job
         ev.graph.trace = self.obs.task_parent(ev.job_id)
         self.jobs.submit_job(ev.job_id, ev.graph)
-        self.metrics.record_submitted(ev.job_id,
-                                      self._queued_at_ms.get(ev.job_id, 0),
+        with self._meta_lock:
+            queued_at = self._queued_at_ms.get(ev.job_id, 0)
+        self.metrics.record_submitted(ev.job_id, queued_at,
                                       int(time.time() * 1000))
         self._checkpoint(ev.graph)
         self._offer()
@@ -708,15 +732,17 @@ class SchedulerServer:
             # the job may still be waiting in the admission queue: pull it
             # out so it never plans, and free its tenant's queue slot
             if self.admission.take_queued(ev.job_id):
-                self._queued_at_ms.pop(ev.job_id, None)
-                self._job_configs.pop(ev.job_id, None)
+                with self._meta_lock:
+                    self._queued_at_ms.pop(ev.job_id, None)
+                    self._job_configs.pop(ev.job_id, None)
                 self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
                 self.metrics.record_cancelled(ev.job_id)
             return
         graph.cancel()
         self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
         self.metrics.record_cancelled(ev.job_id)
-        self._queued_at_ms.pop(ev.job_id, None)
+        with self._meta_lock:
+            self._queued_at_ms.pop(ev.job_id, None)
         self._cancel_running(graph)
         self._schedule_job_data_cleanup(graph)
 
@@ -842,7 +868,8 @@ class SchedulerServer:
                     continue
                 if graph.status == "running":
                     graph.status = "failed"
-                self._queued_at_ms.pop(job_id, None)
+                with self._meta_lock:
+                    self._queued_at_ms.pop(job_id, None)
                 # durable before visible, same as the success path below
                 self._checkpoint(graph)
                 self.jobs.set_status(JobStatus(
@@ -909,16 +936,18 @@ class SchedulerServer:
                 # scheduler must never see a completed job as running
                 self._checkpoint(graph)
                 checkpointed = True
-                serving = self._serving_info.pop(job_id, None)
+                with self._meta_lock:
+                    serving = self._serving_info.pop(job_id, None)
                 if serving is not None and (serving.capture_result
                                             or serving.subplan):
                     self._submit_work(self._capture_serving, graph, payload,
                                       serving)
                 self.jobs.set_status(
                     JobStatus(job_id, "successful", locations=payload))
+                with self._meta_lock:
+                    queued_at = self._queued_at_ms.pop(job_id, 0)
                 self.metrics.record_completed(
-                    job_id, self._queued_at_ms.pop(job_id, 0),
-                    int(time.time() * 1000))
+                    job_id, queued_at, int(time.time() * 1000))
                 self._schedule_job_data_cleanup(graph)
             elif kind == "job_failed":
                 self._checkpoint(graph)
@@ -926,7 +955,8 @@ class SchedulerServer:
                 self.jobs.set_status(
                     JobStatus(job_id, "failed", error=str(payload)))
                 self.metrics.record_failed(job_id)
-                self._queued_at_ms.pop(job_id, None)
+                with self._meta_lock:
+                    self._queued_at_ms.pop(job_id, None)
                 self._cancel_running(graph)
                 self._schedule_job_data_cleanup(graph)
         self._drain_aqe_events(graph)

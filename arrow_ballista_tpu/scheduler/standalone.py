@@ -226,6 +226,7 @@ class StandaloneCluster:
 
     def shutdown(self) -> None:
         self._hb_stop.set()
+        self._hb_thread.join(timeout=5.0)
         self.scheduler.shutdown()
         if self._owns_work_dir:
             shutil.rmtree(self.work_dir, ignore_errors=True)

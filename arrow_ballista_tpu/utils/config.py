@@ -51,6 +51,7 @@ OBS_COLLECTOR = "ballista.observability.collector"
 OBS_OTLP_ENDPOINT = "ballista.observability.otlp.endpoint"
 # static analysis (arrow_ballista_tpu/analysis/)
 ANALYSIS_PLAN_CHECKS = "ballista.analysis.plan_checks"
+ANALYSIS_LOCK_ORDER_RUNTIME = "ballista.analysis.lock_order.runtime"
 # RPC hardening (net/retry.py): client-side deadlines + bounded backoff
 RPC_CONNECT_TIMEOUT_S = "ballista.rpc.connect.timeout.seconds"
 RPC_READ_TIMEOUT_S = "ballista.rpc.read.timeout.seconds"
@@ -258,6 +259,13 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "mismatches or orphan/cyclic stage dependencies before "
                     "any task launches (see "
                     "docs/developer-guide/static-analysis.md)"),
+        ConfigEntry(ANALYSIS_LOCK_ORDER_RUNTIME, False, _parse_bool,
+                    "debug lock-instrumentation shim: record the runtime "
+                    "lock-acquisition order of every package lock and "
+                    "validate it against the static concurrency model "
+                    "(analysis/concurrency.py). Zero-cost when off; also "
+                    "enabled by BALLISTA_LOCK_ORDER_RUNTIME=1. Intended "
+                    "for the chaos/serving CI legs, not production"),
         ConfigEntry(RPC_CONNECT_TIMEOUT_S, 5.0, float,
                     "TCP connect deadline for client-side control-plane "
                     "RPCs (net/retry.py)"),

@@ -298,6 +298,25 @@ def main() -> None:
                     "plan-cache hits, exit 1 on failure")
     args = ap.parse_args()
 
+    # BALLISTA_LOCK_ORDER_RUNTIME=1: record every package lock acquisition
+    # during the run and assert consistency with the static concurrency
+    # model afterwards (analysis/lock_order.py).  Installed before the
+    # cluster is built so scheduler/executor locks get recording proxies.
+    from arrow_ballista_tpu.analysis import lock_order
+
+    lock_order_on = lock_order.enabled()
+    if lock_order_on:
+        lock_order.install()
+
+    def _validate_lock_order() -> None:
+        if not lock_order_on:
+            return
+        rep = lock_order.validate()
+        print(rep.details(), file=sys.stderr)
+        if not rep.ok:
+            print("lock-order runtime validation FAILED", file=sys.stderr)
+            sys.exit(2)
+
     if args.smoke:
         leg = run_smoke(sessions=args.sessions or 8,
                         queries_per_session=args.queries or 6)
@@ -305,6 +324,7 @@ def main() -> None:
         if not leg["smoke_pass"]:
             print("serving smoke FAILED", file=sys.stderr)
             sys.exit(1)
+        _validate_lock_order()
         print("serving smoke passed", file=sys.stderr)
         return
 
@@ -314,6 +334,7 @@ def main() -> None:
         queries_per_session=args.queries or 8,
         executors=args.executors)
     print(json.dumps(out, indent=2))
+    _validate_lock_order()
 
 
 if __name__ == "__main__":

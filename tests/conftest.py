@@ -30,12 +30,35 @@ import sys  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Runtime lock-order validation (BALLISTA_LOCK_ORDER_RUNTIME=1): patch the
+# threading lock constructors NOW — conftest imports before any test module,
+# so package classes created during the run get recording proxies.  The
+# observed acquisition graph is checked against the static model at session
+# end (see pytest_sessionfinish below).  Zero-cost when the env var is off.
+from arrow_ballista_tpu.analysis import lock_order as _lock_order  # noqa: E402
+
+_LOCK_ORDER_ON = bool(_lock_order.enabled())
+if _LOCK_ORDER_ON:
+    _lock_order.install()
+
 # Suite-level watchdog (round-2 failure mode: one deadlocked test hung the
 # whole suite forever).  Each test re-arms a hard deadline; on expiry every
 # thread's stack is dumped and the process exits non-zero, so a hang can
 # never silently eat a run.  pytest-timeout is not in the image, hence
 # faulthandler.
 TEST_TIMEOUT_S = int(os.environ.get("BALLISTA_TEST_TIMEOUT", "600"))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _LOCK_ORDER_ON:
+        return
+    rep = _lock_order.validate(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    print("\n" + rep.details(), file=sys.__stderr__)
+    if not rep.ok:
+        # a disagreement between the static lock-order model and the run's
+        # observed acquisitions must fail CI even when every test passed
+        session.exitstatus = 3
 
 
 def pytest_configure(config):

@@ -1,0 +1,542 @@
+"""Seeded-interleaving stress tests + runtime lock-order shim tests.
+
+Part 1 — deterministic schedule exploration.  A cooperative scheduler
+hands a single execution token between registered threads; switch
+decisions are drawn from a seeded RNG at traced line events (sys.settrace
+inside package code) and at lock-acquire spin points, so each seed
+replays one exact interleaving and 50+ seeds sweep genuinely different
+schedules.  The threads drive the SchedulerServer's real concurrent
+entry points against each other:
+
+- two producers reporting task statuses through ``update_task_status``
+  (the inbox-append + coalesced-TaskUpdating-post protocol), one of them
+  completing a *speculative duplicate* attempt so the dedup/cancel path
+  runs,
+- ``cancel_job`` racing both,
+- one drainer playing the event loop: it pops posted events and
+  dispatches ``_on_event`` exactly as ``EventLoop._run`` would,
+  preserving the production single-consumer invariant while every
+  producer/drainer interleaving is explored.
+
+Invariants checked after every seed: no thread raised, the status inbox
+drained to empty, the posted-event queue drained to empty, the job ended
+in exactly one terminal state, per-partition attempt bookkeeping stayed
+consistent (one winner, the speculative loser cancelled at most once),
+and the attempt log holds no duplicate (partition, attempt) entries.
+
+Part 2 — unit tests for ``analysis/lock_order.py`` (proxy recording,
+Condition integration, validate() classification against a fixture repo,
+env gating) and regression tests for concurrency fixes shipped with the
+analyzer (KvServer txn seq capture, stop-before-start on socket servers).
+"""
+import os
+import queue
+import random
+import sys
+import threading
+import time
+
+import pytest
+
+from arrow_ballista_tpu.analysis import lock_order
+from arrow_ballista_tpu.scheduler.scheduler import (
+    SchedulerConfig,
+    SchedulerServer,
+    TaskLauncher,
+)
+from tests.test_scheduler import fake_success, physical_plan
+
+PKG_DIR = os.path.dirname(
+    os.path.abspath(lock_order.__file__.replace("analysis", "")))
+THIS_FILE = os.path.abspath(__file__)
+
+# raw primitives for the interleaver's own machinery — must never be the
+# yielding wrappers the tests install
+_RAW_LOCK = lock_order._RAW_LOCK
+_RAW_CONDITION = lock_order._RAW_CONDITION
+
+_tls = threading.local()
+
+
+# --------------------------------------------------------------------------
+# deterministic cooperative scheduler
+# --------------------------------------------------------------------------
+
+class Interleaver:
+    """One-token scheduler: exactly one registered thread runs at a time;
+    the seeded RNG decides every handoff, so a seed IS a schedule."""
+
+    def __init__(self, seed: int, switch_prob: float = 0.2):
+        self.rng = random.Random(seed)
+        self.switch_prob = switch_prob
+        self._cond = _RAW_CONDITION(_RAW_LOCK())
+        self._runnable = []
+        self._current = None
+        self._started = False
+        self.errors = []
+
+    # --- worker-side protocol -------------------------------------------
+    def _enter(self, idx: int) -> None:
+        with self._cond:
+            while not (self._started and self._current == idx):
+                if not self._cond.wait(timeout=30.0):
+                    raise RuntimeError("interleaver start stalled")
+
+    def _leave(self, idx: int) -> None:
+        with self._cond:
+            self._runnable.remove(idx)
+            if self._current == idx and self._runnable:
+                self._current = self.rng.choice(self._runnable)
+            self._cond.notify_all()
+
+    def maybe_switch(self, idx: int) -> None:
+        if self._current != idx:  # trace fired outside our token window
+            return
+        if getattr(_tls, "in_sched", False):
+            return
+        if self.rng.random() < self.switch_prob:
+            self.switch(idx)
+
+    def switch(self, idx: int, force: bool = False) -> None:
+        """Hand the token to a seeded choice of runnable thread and block
+        until it comes back.  ``force`` (lock spins, idle drains) demands
+        a DIFFERENT thread; with nobody else runnable it briefly sleeps
+        instead, letting unregistered background threads (pool workers)
+        make progress under the GIL.
+
+        The in_sched guard keeps the tracer from re-entering: this
+        method's own lines are in a traced file, and a nested switch
+        would self-deadlock on the non-reentrant condition."""
+        if getattr(_tls, "in_sched", False):
+            return
+        _tls.in_sched = True
+        try:
+            self._switch_locked(idx, force)
+        finally:
+            _tls.in_sched = False
+
+    def _switch_locked(self, idx: int, force: bool) -> None:
+        with self._cond:
+            others = [i for i in self._runnable if i != idx]
+            if not others:
+                if force:
+                    self._cond.release()
+                    try:
+                        time.sleep(0.001)
+                    finally:
+                        self._cond.acquire()
+                return
+            nxt = self.rng.choice(others if force else self._runnable)
+            if nxt == idx:
+                return
+            self._current = nxt
+            self._cond.notify_all()
+            while self._current != idx:
+                if not self._cond.wait(timeout=30.0):
+                    raise RuntimeError("interleaver stalled (deadlock?)")
+
+    def _tracer(self, idx: int):
+        def trace(frame, event, arg):
+            fn = frame.f_code.co_filename
+            if not (fn.startswith(PKG_DIR) or fn == THIS_FILE):
+                return None
+            if event == "line":
+                self.maybe_switch(idx)
+            return trace
+
+        return trace
+
+    # --- driver ----------------------------------------------------------
+    def run(self, fns, timeout: float = 60.0) -> None:
+        def make(idx, fn):
+            def worker():
+                try:
+                    self._enter(idx)
+                    _tls.idx = idx
+                    sys.settrace(self._tracer(idx))
+                    try:
+                        fn()
+                    finally:
+                        sys.settrace(None)
+                        _tls.idx = None
+                except BaseException as e:  # noqa: BLE001 — reported below
+                    self.errors.append((idx, e))
+                finally:
+                    self._leave(idx)
+
+            return worker
+
+        threads = [threading.Thread(target=make(i, fn),
+                                    name=f"interleave-{i}", daemon=True)
+                   for i, fn in enumerate(fns)]
+        with self._cond:
+            self._runnable = list(range(len(fns)))
+        for t in threads:
+            t.start()
+        with self._cond:
+            self._current = self.rng.choice(self._runnable)
+            self._started = True
+            self._cond.notify_all()
+        for t in threads:
+            t.join(timeout)
+        alive = [t.name for t in threads if t.is_alive()]
+        assert not alive, f"interleaved threads deadlocked: {alive}"
+
+
+class _YieldLock:
+    """Lock wrapper installed as ``threading.Lock``/``RLock`` during an
+    interleaved run: a *registered* thread never blocks while holding the
+    token — it spins try-acquire and force-yields between attempts, so a
+    parked lock holder always gets scheduled to release.  Unregistered
+    threads (pool workers, thread bootstrap) fall through to a normal
+    blocking acquire."""
+
+    def __init__(self, sched: Interleaver, raw):
+        self._sched = sched
+        self._raw = raw
+        # threading.Condition steals _is_owned at construction when the
+        # lock has one (RLock); without this, its try-acquire ownership
+        # probe misreports reentrant locks and notify() raises
+        if hasattr(raw, "_is_owned"):
+            self._is_owned = raw._is_owned
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        idx = getattr(_tls, "idx", None)
+        if idx is None or not blocking:
+            return self._raw.acquire(blocking, timeout)
+        spins = 0
+        while not self._raw.acquire(False):
+            self._sched.switch(idx, force=True)
+            spins += 1
+            if spins > 200_000:
+                raise RuntimeError("lock spin livelock")
+        return True
+
+    def release(self) -> None:
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class RecordingLauncher(TaskLauncher):
+    def __init__(self):
+        self.launched = []
+        self.cancelled_jobs = []
+        self.cancelled_tasks = []
+
+    def launch_tasks(self, executor_id, tasks):
+        self.launched.append((executor_id, tasks))
+
+    def cancel_tasks(self, executor_id, job_id):
+        self.cancelled_jobs.append((executor_id, job_id))
+
+    def cancel_task(self, executor_id, task):
+        self.cancelled_tasks.append((executor_id, task))
+
+    def clean_job_data(self, executor_id, job_id):
+        pass
+
+
+
+
+def _run_one_schedule(seed: int):
+    """Build a scheduler + a running 3-partition job with one speculative
+    duplicate in flight, then race producers/canceller/drainer under the
+    seeded schedule.  Returns (server, launcher, graph, trace)."""
+    sched = Interleaver(seed)
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    threading.Lock = lambda: _YieldLock(sched, orig_lock())
+    threading.RLock = lambda: _YieldLock(sched, orig_rlock())
+    try:
+        launcher = RecordingLauncher()
+        server = SchedulerServer(launcher, SchedulerConfig(
+            job_data_cleanup_delay_s=-1.0))
+        # no server.init(): the drainer thread IS the event loop here
+        from arrow_ballista_tpu.scheduler.execution_graph import ExecutionGraph
+
+        job_id = f"job-{seed}"
+        # a fresh plan per run: build() consumes the stage tree
+        graph = ExecutionGraph.build(job_id, physical_plan(partitions=3))
+        primaries = []
+        while True:
+            t = graph.pop_next_task("exec-A")
+            if t is None:
+                break
+            primaries.append(t)
+        assert len(primaries) == 3
+        spec = graph.launch_speculative(
+            1, primaries[0].task.partition, "exec-B")
+        assert spec is not None
+        server.jobs.accept_job(job_id)
+        server.jobs.submit_job(job_id, graph)
+
+        state = {"done": 0, "trace": []}
+
+        def producer_primary():
+            for t in primaries:
+                server.update_task_status(
+                    "exec-A", [fake_success(t, "exec-A")])
+            state["done"] += 1
+
+        def producer_speculative():
+            server.update_task_status("exec-B", [fake_success(spec, "exec-B")])
+            state["done"] += 1
+
+        def canceller():
+            server.cancel_job(job_id)
+            state["done"] += 1
+
+        def drainer():
+            q = server._event_loop._queue
+            while True:
+                try:
+                    _, ev = q.get_nowait()
+                except queue.Empty:
+                    if state["done"] == 3 and q.empty():
+                        return
+                    sched.switch(3, force=True)
+                    continue
+                state["trace"].append(type(ev).__name__)
+                try:
+                    server._on_event(ev)
+                except Exception as exc:  # noqa: BLE001 — mirror EventLoop
+                    server._on_event_error(ev, exc)
+
+        sched.run([producer_primary, producer_speculative, canceller,
+                   drainer])
+        assert not sched.errors, \
+            f"seed {seed}: thread(s) raised: {sched.errors}"
+        server._launch_pool.shutdown(wait=True)
+        return server, launcher, graph, tuple(state["trace"])
+    finally:
+        threading.Lock, threading.RLock = orig_lock, orig_rlock
+
+
+SEEDS = range(50)
+
+
+def test_seeded_interleavings_hold_invariants():
+    distinct_traces = set()
+    for seed in SEEDS:
+        server, launcher, graph, trace = _run_one_schedule(seed)
+        distinct_traces.add(trace)
+        ctx = f"seed {seed} (trace {trace})"
+        # inbox + event queue fully drained: the coalescing protocol never
+        # strands a posted-but-undrained report
+        assert server._status_inbox == {}, ctx
+        assert server._event_loop.queue_depth() == 0, ctx
+        # exactly one stable terminal state: stage 2 never ran (no
+        # executors registered), so the cancel always lands eventually
+        st = server.jobs.get_status(f"job-{seed}")
+        assert st is not None and st.state == "cancelled", \
+            f"{ctx}: state={getattr(st, 'state', None)}"
+        # attempt bookkeeping: the audit log never double-registers an
+        # attempt, and a finished partition has exactly one winner
+        stage = graph.stages[1]
+        keys = [(e["partition"], e["attempt"], e["stage_attempt"])
+                for e in stage.attempt_log]
+        assert len(keys) == len(set(keys)), ctx
+        for p, info in enumerate(stage.task_infos):
+            if info is not None and info.state == "success":
+                assert p not in stage.speculative_tasks, ctx
+        # speculative dedup: at most one loser-cancel for the duplicated
+        # partition, and exactly one authoritative winner — the audit log
+        # may record both attempts as succeeded (each did, on its own
+        # executor), but task_infos/outputs carry a single attempt's result
+        assert len(launcher.cancelled_tasks) <= 1, ctx
+        for p, (executor_id, writes) in stage.outputs.items():
+            info = stage.task_infos[p]
+            assert info is not None and info.state == "success", ctx
+            assert info.executor_id == executor_id, ctx
+            assert writes, ctx
+    # the sweep actually explored different schedules
+    assert len(distinct_traces) >= 2, distinct_traces
+
+
+def test_same_seed_replays_same_schedule():
+    _, launcher_a, _, trace_a = _run_one_schedule(7)
+    _, launcher_b, _, trace_b = _run_one_schedule(7)
+    assert trace_a == trace_b
+    assert len(launcher_a.cancelled_tasks) == len(launcher_b.cancelled_tasks)
+
+
+# --------------------------------------------------------------------------
+# lock_order runtime shim
+# --------------------------------------------------------------------------
+
+class TestLockOrderShim:
+    def test_install_uninstall_restores_constructors(self):
+        was_installed = lock_order._installed
+        try:
+            lock_order.install()
+            assert threading.Lock is not lock_order._RAW_LOCK
+            lock_order.install()  # idempotent
+            lock_order.uninstall()
+            assert threading.Lock is lock_order._RAW_LOCK
+            assert threading.RLock is lock_order._RAW_RLOCK
+            assert threading.Condition is lock_order._RAW_CONDITION
+        finally:
+            if was_installed:
+                lock_order.install()
+            else:
+                lock_order.uninstall()
+
+    def test_proxy_records_nested_edges_and_releases(self):
+        lock_order.reset()
+        try:
+            a = lock_order._LockProxy(lock_order._RAW_LOCK(), ("/x.py", 1))
+            b = lock_order._LockProxy(lock_order._RAW_LOCK(), ("/x.py", 2))
+            with a:
+                with b:
+                    pass
+            # release popped `a`'s stack entry, so this is a fresh edge in
+            # the other direction, not a nested re-acquire
+            with b:
+                with a:
+                    pass
+            snap = lock_order._recorder.snapshot()
+            assert snap == {((("/x.py", 1)), ("/x.py", 2)): 1,
+                            ((("/x.py", 2)), ("/x.py", 1)): 1}
+        finally:
+            lock_order.reset()
+
+    def test_condition_wait_notify_through_proxy(self):
+        lock_order.reset()
+        try:
+            proxy = lock_order._LockProxy(
+                lock_order._RAW_RLOCK(), ("/c.py", 1))
+            cond = lock_order._RAW_CONDITION(proxy)
+            hits = []
+
+            def waiter():
+                with cond:
+                    while not hits:
+                        if not cond.wait(timeout=10.0):
+                            return
+                    hits.append("woke")
+
+            t = threading.Thread(target=waiter, daemon=True)
+            t.start()
+            time.sleep(0.05)
+            with cond:
+                hits.append("set")
+                cond.notify_all()  # raises without the _is_owned delegate
+            t.join(timeout=10.0)
+            assert not t.is_alive() and hits == ["set", "woke"]
+            # wait() released the proxy while blocked: another thread's
+            # acquire during the wait window must not have deadlocked,
+            # and the recorder stack is balanced (next acquire = no edge)
+            with proxy:
+                pass
+            assert lock_order._recorder.snapshot() == {}
+        finally:
+            lock_order.reset()
+
+    def test_validate_classifies_edges(self, tmp_path):
+        pkg = tmp_path / "arrow_ballista_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "import threading\n\n\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"        # line 6
+            "        self._b = threading.Lock()\n"        # line 7
+            "        self._c = threading.Lock()\n"        # line 8
+            "\n"
+            "    def f(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n")
+        mod = str(tmp_path / "arrow_ballista_tpu" / "mod.py")
+        site_a, site_b, site_c = (mod, 6), (mod, 7), (mod, 8)
+        lock_order.reset()
+        try:
+            rec = lock_order._recorder
+            rec.edges[(site_a, site_b)] = 3          # predicted: a -> b
+            rec.edges[(site_b, site_a)] = 1          # inversion of a -> b
+            rec.edges[(site_a, site_c)] = 1          # no static path at all
+            rec.edges[(site_a, (mod, 999))] = 1      # unmapped end
+            rep = lock_order.validate(str(tmp_path))
+            assert rep.checked == 3 and rep.unknown == 1
+            assert len(rep.contradicted) == 1 and "_b" in rep.contradicted[0]
+            assert len(rep.unpredicted) == 1 and "_c" in rep.unpredicted[0]
+            assert not rep.ok
+            with pytest.raises(AssertionError):
+                lock_order.assert_consistent(str(tmp_path))
+        finally:
+            lock_order.reset()
+
+    def test_enabled_follows_env_flag(self, monkeypatch):
+        monkeypatch.setenv("BALLISTA_LOCK_ORDER_RUNTIME", "1")
+        assert lock_order.enabled() is True
+        monkeypatch.setenv("BALLISTA_LOCK_ORDER_RUNTIME", "0")
+        assert lock_order.enabled() is False
+        monkeypatch.delenv("BALLISTA_LOCK_ORDER_RUNTIME")
+        assert lock_order.enabled() is False  # config default
+
+
+# --------------------------------------------------------------------------
+# regression tests for fixes shipped with the analyzer
+# --------------------------------------------------------------------------
+
+def test_kv_txn_returns_its_own_seq_under_concurrency():
+    """KvServer._txn must hand each client the seq of ITS OWN last op —
+    reading self._seq after leaving _log_lock could return a concurrent
+    txn's later seq, making watch cursors skip events."""
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer
+
+    srv = KvServer()
+    try:
+        results = {}
+        barrier = threading.Barrier(8)
+
+        def client(i):
+            barrier.wait()
+            for j in range(25):
+                reply, _ = srv._txn(
+                    {"ops": [["put", "s", f"k-{i}-{j}", "v"]]}, b"")
+                assert reply["ok"]
+                results[(i, j)] = reply["seq"]
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        seqs = sorted(results.values())
+        # every single-op txn observed a distinct seq, with no gaps: each
+        # response carried the head as of ITS append, not a later one
+        assert seqs == list(range(1, 201))
+    finally:
+        srv.stop()
+
+
+def test_socket_servers_tolerate_stop_before_start():
+    """socketserver.shutdown() blocks forever unless serve_forever is
+    running; stop() on a constructed-but-never-started server must not
+    hang (it closes the socket and returns)."""
+    from arrow_ballista_tpu.net.rpc import RpcServer
+    from arrow_ballista_tpu.obs.http import ObsHttpServer
+
+    done = []
+
+    def exercise():
+        rpc = RpcServer("127.0.0.1", 0)
+        rpc.stop()
+        obs = ObsHttpServer("127.0.0.1", 0, {})
+        obs.stop()
+        done.append(True)
+
+    t = threading.Thread(target=exercise, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert done, "stop() before start() hung"

@@ -48,7 +48,8 @@ def test_all_rules_registered():
     assert {"hot-path-purity", "span-coverage", "serde-completeness",
             "config-registry", "lock-discipline",
             "no-blocking-in-event-loop", "metrics-docs",
-            "recovery-path-logging"} <= names
+            "recovery-path-logging", "guarded-by", "lock-order",
+            "event-loop-handoff", "thread-lifecycle"} <= names
 
 
 # --------------------------------------------------------------------------
@@ -395,6 +396,206 @@ def test_metrics_docs_rule_fires_on_missing_name(tmp_path):
     write_fixture(tmp_path, "docs/user-guide/metrics.md",
                   "\n".join(f"- `{n}`" for n in names) + "\n")
     assert lint(tmp_path, "metrics-docs") == []
+
+
+# --------------------------------------------------------------------------
+# concurrency rules (analysis/concurrency.py): guarded-by, lock-order,
+# event-loop-handoff, thread-lifecycle
+# --------------------------------------------------------------------------
+
+def test_guarded_by_fires_on_inconsistent_locking(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/svc.py", """\
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = {}
+                self._count = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    self._jobs["x"] = 1
+                self._count += 1       # entry-thread write, no lock
+
+            def submit(self):
+                with self._lock:
+                    self._jobs["y"] = 2
+                self._count += 1       # caller-thread write, no lock
+        """)
+    found = lint(tmp_path, "guarded-by")
+    assert [v.rule for v in found] == ["guarded-by"]
+    assert "_count" in found[0].message  # _jobs is consistently locked
+
+
+def test_guarded_by_honors_annotations_and_atomic_swap(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/svc.py", """\
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._flag = False  # ballista: guarded-by=none
+                self._state = {}  # ballista: guarded-by=_lock
+                self._ghost = 0  # ballista: guarded-by=_missing_lock
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                self._flag = True
+                self._state["k"] = 1
+                self._ghost += 1
+
+            def submit(self):
+                self._flag = False
+                self._state.pop("k", None)
+                self._ghost -= 1
+        """)
+    found = lint(tmp_path, "guarded-by")
+    # none/named annotations silence; naming a nonexistent lock is itself
+    # a violation (the annotation documents nothing)
+    assert [v.rule for v in found] == ["guarded-by"]
+    assert "_missing_lock" in found[0].message
+
+
+def test_lock_order_detects_two_lock_cycle(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/ab.py", """\
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    found = lint(tmp_path, "lock-order")
+    assert len(found) == 1
+    assert "inversion" in found[0].message
+
+
+def test_lock_order_interprocedural_and_rlock_reentry(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/ip.py", """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def put(self):
+                with self._lock:
+                    self.read()
+
+            def read(self):
+                with self._lock:   # RLock re-entry: fine
+                    pass
+
+        class Front:
+            def __init__(self):
+                self._gate = threading.Lock()
+                self.store = Store()
+
+            def handle(self):
+                with self._gate:
+                    self.store.put()
+
+            def drain(self):
+                with self.store._lock:
+                    pass
+        """)
+    # acyclic: Front._gate -> Store._lock only; RLock self-edge tolerated
+    assert lint(tmp_path, "lock-order") == []
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/ip2.py", """\
+        import threading
+
+        class Jam:
+            def __init__(self):
+                self._m = threading.Lock()
+
+            def outer(self):
+                with self._m:
+                    self.inner()
+
+            def inner(self):
+                with self._m:   # non-reentrant re-acquire
+                    pass
+        """)
+    found = lint(tmp_path, "lock-order")
+    assert any("self-deadlock" in v.message for v in found)
+
+
+def test_event_loop_handoff_fires_on_post_then_mutate(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/post.py", """\
+        class Producer:
+            def __init__(self, loop):
+                self.loop = loop
+
+            def bad(self):
+                ev = {"state": "new"}
+                self.loop.post(ev)
+                ev["state"] = "changed"
+
+            def good(self):
+                ev = {"state": "done"}
+                self.loop.post(ev)
+                ev = {"state": "next"}   # rebinding is a fresh object
+                self.loop.post(ev)
+        """)
+    found = lint(tmp_path, "event-loop-handoff")
+    assert len(found) == 1
+    assert "mutated afterwards" in found[0].message
+
+
+def test_thread_lifecycle_fires_and_accepts_bounded_join(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/threads.py", """\
+        import threading
+
+        class NoDaemonDecision:
+            def go(self):
+                threading.Thread(target=self.run).start()
+
+        class NeverJoined:
+            def start(self):
+                self._t = threading.Thread(target=self.run, daemon=True)
+                self._t.start()
+
+        class Bounded:
+            def start(self):
+                self._t = threading.Thread(target=self.run, daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._t.join(timeout=5.0)
+        """)
+    found = lint(tmp_path, "thread-lifecycle")
+    msgs = [v.message for v in found]
+    assert len(found) == 2
+    assert any("daemon=" in m for m in msgs)
+    assert any("NeverJoined._t" in m for m in msgs)
+
+
+def test_concurrency_rules_respect_suppression(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/scheduler/sup.py", """\
+        import threading
+
+        class Sup:
+            def start(self):
+                # ballista: allow=thread-lifecycle — fixture exception
+                threading.Thread(target=self.run).start()
+        """)
+    assert lint(tmp_path, "thread-lifecycle") == []
 
 
 def test_unknown_rule_name_rejected(tmp_path):

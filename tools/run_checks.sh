@@ -2,18 +2,24 @@
 # CI entrypoint for the repository's consistency checks:
 #   1. the static-analysis lint suite (AST rules + metrics-docs),
 #   2. generated-docs freshness (docs/user-guide/configs.md),
-#   3. the static-analysis + wire-serde + speculation + observability +
-#      adaptive-execution test files (rule fixtures, plan-validator cases,
-#      exhaustive wire round-trips, speculation policy math and
+#   3. the static-analysis + concurrency + wire-serde + speculation +
+#      observability + adaptive-execution test files (rule fixtures,
+#      plan-validator cases, seeded-interleaving stress + lock-order shim
+#      units, exhaustive wire round-trips, speculation policy math and
 #      attempt-dedup races, runtime-stats folding / EXPLAIN ANALYZE /
 #      cluster history, AQE rewrites + rollback + serde),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
 #      quarantine, straggler speculation, corrupt-shuffle checksums) —
-#      proves the fault-tolerance paths still recover,
+#      proves the fault-tolerance paths still recover.  Runs with the
+#      runtime lock-order validator on (BALLISTA_LOCK_ORDER_RUNTIME=1):
+#      every real lock acquisition is checked against the static
+#      concurrency model, and any inversion or unpredicted nesting fails
+#      the leg,
 #   5. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
 #      sessions of repeated q6 variants through the prepared-plan +
-#      result caches — zero errors and a nonzero plan-cache hit rate.
+#      result caches — zero errors and a nonzero plan-cache hit rate,
+#      also under the runtime lock-order validator.
 # tests/test_static_analysis.py also runs the lint suite inside tier-1, so
 # pytest alone still gates new violations; this script is the fast
 # standalone form for CI and pre-push hooks.
@@ -28,15 +34,17 @@ python -m arrow_ballista_tpu.analysis
 echo "== generated docs up to date =="
 python docs/gen_configs.py --check
 
-echo "== analysis + serde + speculation + observability + aqe test files =="
-python -m pytest tests/test_static_analysis.py tests/test_serde_wire.py \
-    tests/test_speculation.py tests/test_observatory.py tests/test_aqe.py \
+echo "== analysis + concurrency + serde + speculation + observability + aqe test files =="
+python -m pytest tests/test_static_analysis.py tests/test_concurrency.py \
+    tests/test_serde_wire.py tests/test_speculation.py \
+    tests/test_observatory.py tests/test_aqe.py \
     -q -p no:cacheprovider
 
-echo "== chaos recovery suite (-m chaos) =="
-python -m pytest tests/test_chaos.py -q -m chaos -p no:cacheprovider
+echo "== chaos recovery suite (-m chaos, runtime lock-order validation on) =="
+BALLISTA_LOCK_ORDER_RUNTIME=1 \
+    python -m pytest tests/test_chaos.py -q -m chaos -p no:cacheprovider
 
-echo "== serving smoke (8 sessions x q6, caches on) =="
-python -m benchmarks.serving --smoke
+echo "== serving smoke (8 sessions x q6, caches on, runtime lock-order validation on) =="
+BALLISTA_LOCK_ORDER_RUNTIME=1 python -m benchmarks.serving --smoke
 
 echo "all checks passed"
