@@ -172,14 +172,21 @@ class BallistaContext:
         self._remote = None
 
     @staticmethod
-    def remote(host: str, port: int, config: Optional[BallistaConfig] = None) -> "BallistaContext":
+    def remote(host: Optional[str] = None, port: Optional[int] = None,
+               config: Optional[BallistaConfig] = None,
+               endpoints=None) -> "BallistaContext":
         """Connect to a scheduler daemon (parity: BallistaContext::remote,
         reference client context.rs:80-140).  SQL text ships to the
-        scheduler; results stream back from executor data planes."""
+        scheduler; results stream back from executor data planes.
+
+        ``endpoints=[(host, port), ...]`` connects to a scheduler FLEET:
+        calls stick to the first reachable shard and fail over down the
+        list when it dies (docs/user-guide/ha.md)."""
         ctx = BallistaContext(config, engine="remote")
         from .remote import RemoteCluster
 
-        ctx._remote = RemoteCluster(host, port, ctx.config)
+        ctx._remote = RemoteCluster(host, port, ctx.config,
+                                    endpoints=endpoints)
         return ctx
 
     # --- registration ---------------------------------------------------

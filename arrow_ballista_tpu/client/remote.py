@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import io
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .. import serde
 from ..models.batch import ColumnBatch
@@ -21,44 +21,147 @@ POLL_INTERVAL_S = 0.1  # reference: 100 ms
 
 
 class RemoteCluster:
-    def __init__(self, host: str, port: int, config: Optional[BallistaConfig] = None):
-        self.host, self.port = host, port
+    """Scheduler client with fleet failover.
+
+    Single-scheduler callers keep the old surface: ``RemoteCluster(host,
+    port, config)`` binds one endpoint and transport errors surface raw.
+    Fleet callers pass ``endpoints=[(h1, p1), (h2, p2), ...]``: calls stick
+    to one shard until it dies, then rotate down the ordered list; sessions
+    are shard-local so one is created per endpoint on first use, and
+    catalog mutations are broadcast (plus replayed on session creation) so
+    any shard can plan this client's queries after a failover.  A poll that
+    lands on a non-owning shard is redirected via the lease record the
+    shards keep in their shared KV (netservice._resolve_foreign_status)."""
+
+    def __init__(self, host: Optional[str] = None, port: Optional[int] = None,
+                 config: Optional[BallistaConfig] = None,
+                 endpoints: Optional[List[Tuple[str, int]]] = None):
         self.config = config or BallistaConfig()
+        eps = [(h, int(p)) for h, p in (endpoints or [])]
+        if host is not None and (host, port) not in eps:
+            eps.insert(0, (host, port))
+        if not eps:
+            raise ValueError("RemoteCluster needs host/port or endpoints")
+        self._endpoints = eps
+        self._primary = 0
+        self.host, self.port = eps[0]
+        # shard-local sessions, created lazily per endpoint; catalog
+        # mutations are logged for replay so a session created AFTER a
+        # registration (failover to a lazily-dialed shard) still sees the
+        # client's tables
+        self._sessions: Dict[Tuple[str, int], str] = {}
+        self._catalog_log: List[tuple] = []
+        # how long a fleet client keeps polling through "not_found" before
+        # declaring the job lost: one lease TTL (the owner must miss that
+        # many renewals before expiry) + two adoption scans + slack
+        from ..utils.config import FLEET_ADOPT_INTERVAL_S, FLEET_LEASE_TTL_S
+
+        self._adoption_grace_s = (
+            float(self.config.get(FLEET_LEASE_TTL_S))
+            + 2.0 * float(self.config.get(FLEET_ADOPT_INTERVAL_S)) + 2.0)
         # one scheduler session per client context: private table namespace
         # + this client's config (reference: ExecuteQuery with no query
         # creates the server-side session, context.rs:80-140)
-        payload, _ = wire.call(host, port, "create_session",
-                               {"settings": dict(self.config._settings)})
-        self.session_id = payload["session_id"]
+        self.session_id = self._session_for(eps[0])
 
     def close(self) -> None:
-        if self.session_id is not None:
+        for ep, sid in list(self._sessions.items()):
             try:
-                wire.call(self.host, self.port, "remove_session",
-                          {"session_id": self.session_id})
+                wire.call(ep[0], ep[1], "remove_session", {"session_id": sid})
             except Exception:  # noqa: BLE001 — scheduler may be gone
                 pass
-            self.session_id = None
+        self._sessions.clear()
+        self.session_id = None
+
+    # --- endpoint walking ------------------------------------------------
+    def _session_for(self, ep: Tuple[str, int]) -> str:
+        sid = self._sessions.get(ep)
+        if sid is not None:
+            return sid
+        payload, _ = wire.call(ep[0], ep[1], "create_session",
+                               {"settings": dict(self.config._settings)})
+        sid = payload["session_id"]
+        self._sessions[ep] = sid
+        # catch the new session up on this client's catalog (idempotent:
+        # registration overwrites by name)
+        for method, p, binary in self._catalog_log:
+            q = dict(p)
+            q["session_id"] = sid
+            wire.call(ep[0], ep[1], method, q, binary)
+        return sid
+
+    def _rotate(self, failed_ep: Tuple[str, int]) -> None:
+        # the dead shard's session dies with it: a restarted shard would
+        # not recognise the id, so re-create (and replay) on reconnect
+        self._sessions.pop(failed_ep, None)
+        self._primary = (self._primary + 1) % len(self._endpoints)
+        self.host, self.port = self._endpoints[self._primary]
+        self.session_id = self._sessions.get(self._endpoints[self._primary])
+
+    def _point_primary(self, endpoint: str) -> None:
+        """Re-stick to the shard a not_found redirect named as the job's
+        current lease owner ("host:port")."""
+        host, _, port = endpoint.rpartition(":")
+        ep = (host, int(port))
+        if ep not in self._endpoints:
+            self._endpoints.append(ep)
+        self._primary = self._endpoints.index(ep)
+        self.host, self.port = ep
+        self.session_id = self._sessions.get(ep)
 
     def _call(self, method: str, payload: dict = None, binary: bytes = b""):
         payload = dict(payload or {})
-        if self.session_id is not None:
-            payload.setdefault("session_id", self.session_id)
-        return wire.call(self.host, self.port, method, payload, binary)
+        last: Optional[Exception] = None
+        for _ in range(len(self._endpoints)):
+            ep = self._endpoints[self._primary]
+            try:
+                sid = self._session_for(ep)
+                p = dict(payload)
+                p.setdefault("session_id", sid)
+                return wire.call(ep[0], ep[1], method, p, binary)
+            except (ConnectionError, OSError) as e:
+                if len(self._endpoints) == 1:
+                    raise  # single-scheduler surface: raw transport error
+                last = e
+                self._rotate(ep)
+        raise ConnectionError(
+            f"no scheduler endpoint reachable for {method}: {last}") from last
 
     # --- catalog ---------------------------------------------------------
+    def _broadcast_catalog(self, method: str, payload: dict,
+                           binary: bytes = b"") -> None:
+        """Catalog mutations go to EVERY shard (sessions — and therefore
+        table namespaces — are shard-local): the current primary must
+        succeed, siblings are best-effort and get caught up by the replay
+        log when their session is next created."""
+        self._catalog_log.append((method, dict(payload), binary))
+        self._call(method, payload, binary)
+        current = self._endpoints[self._primary]
+        for ep in list(self._endpoints):
+            if ep == current:
+                continue
+            try:
+                sid = self._session_for(ep)
+                p = dict(payload)
+                p["session_id"] = sid
+                wire.call(ep[0], ep[1], method, p, binary)
+            except (ConnectionError, OSError):
+                # shard down: the replay log catches it up on reconnect
+                self._sessions.pop(ep, None)
+
     def register_table(self, name: str, table) -> None:
         import pyarrow.ipc as ipc
 
         buf = io.BytesIO()
         with ipc.new_stream(buf, table.schema) as w:
             w.write_table(table)
-        self._call("register_table", {"name": name}, buf.getvalue())
+        self._broadcast_catalog("register_table", {"name": name},
+                                buf.getvalue())
 
     def register_external_table(self, name: str, fmt: str, path: str,
                                 schema=None, delimiter: str = ",",
                                 has_header: bool = True) -> None:
-        self._call("register_external_table", {
+        self._broadcast_catalog("register_external_table", {
             "name": name, "format": fmt, "path": path,
             "schema": serde.schema_to_obj(schema) if schema is not None else None,
             "delimiter": delimiter, "has_header": has_header})
@@ -72,7 +175,7 @@ class RemoteCluster:
         return serde.schema_from_obj(payload["schema"])
 
     def deregister_table(self, name: str) -> None:
-        self._call("deregister_table", {"name": name})
+        self._broadcast_catalog("deregister_table", {"name": name})
 
     def explain(self, sql: str) -> List[dict]:
         payload, _ = self._call("explain", {"sql": sql})
@@ -86,6 +189,26 @@ class RemoteCluster:
     def execute_sql(self, sql: str, timeout: Optional[float] = None) -> List[ColumnBatch]:
         if timeout is None:
             timeout = float(self.config.job_timeout_s)
+        deadline = time.monotonic() + timeout
+        # fleet: a job that dies with its shard BEFORE the first checkpoint
+        # leaves no lease and no graph in the KV — nothing for a sibling to
+        # adopt — so the client resubmits the query once (SQL reads are
+        # safe to re-run; at worst a partitioned-but-unreachable ex-owner
+        # wastes work, which lease fencing already makes harmless)
+        tries = 2 if len(self._endpoints) > 1 else 1
+        for attempt in range(tries):
+            batches = self._execute_once(sql, deadline,
+                                         final=attempt == tries - 1)
+            if batches is not None:
+                return batches
+        raise ExecutionError(
+            "query lost across scheduler failover (resubmitted once)")
+
+    def _execute_once(self, sql: str, deadline: float,
+                      final: bool) -> Optional[List[ColumnBatch]]:
+        """One submit+poll+fetch round.  Returns the batches, or None when
+        the job was lost without a trace in the fleet's shared KV and the
+        caller should resubmit (never when ``final``: then it raises)."""
         from ..obs import new_trace_context
 
         # the client owns the trace root: the scheduler parents its job
@@ -99,7 +222,7 @@ class RemoteCluster:
             # result-cache hit: no job ran; pull the parked bytes in one
             # round-trip instead of polling
             return self._fetch_cached(job_id)
-        deadline = time.monotonic() + timeout
+        lost_since: Optional[float] = None
         while True:
             status, _ = self._call("get_job_status", {"job_id": job_id})
             state = status["state"]
@@ -107,6 +230,25 @@ class RemoteCluster:
                 if status.get("cached"):
                     return self._fetch_cached(job_id)
                 break
+            if state == "not_found" and len(self._endpoints) > 1:
+                if status.get("owner") and status.get("endpoint"):
+                    # a sibling named the current lease owner: re-stick
+                    # there and keep polling (sticky routing survives the
+                    # submitting shard's death)
+                    self._point_primary(status["endpoint"])
+                    lost_since = None
+                    time.sleep(POLL_INTERVAL_S)
+                    continue
+                # no owner yet: adoption may be mid-flight (the lease must
+                # expire first) — keep polling for one grace window
+                lost_since = lost_since if lost_since is not None \
+                    else time.monotonic()
+                if (time.monotonic() - lost_since < self._adoption_grace_s
+                        and time.monotonic() < deadline):
+                    time.sleep(POLL_INTERVAL_S)
+                    continue
+                if not final:
+                    return None  # lost pre-checkpoint: resubmit once
             if state in ("failed", "cancelled", "not_found"):
                 if status.get("retriable"):
                     # admission shed (queue full / timeout): transient
@@ -117,7 +259,7 @@ class RemoteCluster:
                     f"job {job_id} {state}: {status.get('error', '')}")
             if time.monotonic() > deadline:
                 self._call("cancel_job", {"job_id": job_id})
-                raise ExecutionError(f"job {job_id} timed out after {timeout}s")
+                raise ExecutionError(f"job {job_id} timed out")
             time.sleep(POLL_INTERVAL_S)
 
         schema = serde.schema_from_obj(status["schema"])

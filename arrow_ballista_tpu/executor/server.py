@@ -15,7 +15,8 @@ import queue
 import shutil
 import threading
 import time
-from typing import List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 from .. import faults, serde
 from ..net import wire
@@ -164,7 +165,8 @@ class ExecutorServer:
                  janitor_interval_s: float = 300.0,
                  flight_port: int = -1,
                  metrics_port: int = -1,
-                 heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S):
+                 heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+                 scheduler_endpoints: Optional[List[Tuple[str, int]]] = None):
         import socket as socketmod
         import tempfile
         import uuid
@@ -210,6 +212,27 @@ class ExecutorServer:
             if config is not None else RetryPolicy()
         self.scheduler = SchedulerClient(scheduler_host, scheduler_port,
                                          policy=self.retry_policy)
+        # fleet mode: one control-plane client per scheduler shard.  The
+        # primary (index 0 / scheduler_host:port) keeps the single-scheduler
+        # surface (self.scheduler, _scheduler_down) intact; extra shards get
+        # registration + heartbeats so the shared-KV heartbeat row keeps
+        # refreshing even after the primary dies, and task statuses route
+        # back to whichever shard LAUNCHED the task (see _route_client —
+        # a broadcast would double-free shared slot accounting).
+        self._route_lock = threading.Lock()
+        primary = (scheduler_host, scheduler_port)
+        self._clients: Dict[Tuple[str, int], SchedulerClient] = \
+            {primary: self.scheduler}  # ballista: guarded-by=_route_lock
+        for ep in (scheduler_endpoints or []):
+            ep = (ep[0], int(ep[1]))
+            if ep not in self._clients:
+                self._clients[ep] = SchedulerClient(
+                    ep[0], ep[1], policy=self.retry_policy)
+        # job -> launching shard endpoint, learned from launch payloads;
+        # LRU-bounded (routes die with the job's data cleanup anyway)
+        self._job_routes: "OrderedDict[str, Tuple[str, int]]" = \
+            OrderedDict()  # ballista: guarded-by=_route_lock
+        self._max_job_routes = 512
         assert policy in ("push", "pull")
         self.policy = policy
         self.heartbeat_interval_s = heartbeat_interval_s
@@ -300,6 +323,14 @@ class ExecutorServer:
             self.obs_http.start()
         if register:
             self.scheduler.register_executor(self.metadata)
+            # extra shards are best-effort: a shard that is down now learns
+            # us later from the metadata riding on every heartbeat
+            for ep, client in self._extra_clients():
+                try:
+                    client.register_executor(self.metadata)
+                except Exception:  # noqa: BLE001 — heartbeat re-registers
+                    log.warning("register to scheduler shard %s:%d failed "
+                                "(heartbeats will retry)", ep[0], ep[1])
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            name="executor-heartbeat", daemon=True)
         self._hb_thread.start()
@@ -514,6 +545,99 @@ class ExecutorServer:
                 self._log_throttle.warning(
                     "heartbeat", "heartbeat to scheduler failed",
                     exc_info=True)
+            # fleet: every shard gets a beat so the shared heartbeat row
+            # keeps refreshing through ANY live shard — an executor must
+            # not be reaped just because its primary shard died
+            for ep, client in self._extra_clients():
+                try:
+                    client.heartbeat(self.metadata.executor_id,
+                                     meta=self.metadata)
+                except Exception:  # noqa: BLE001 — that shard may be dead
+                    self._log_throttle.warning(
+                        f"heartbeat-{ep[0]}:{ep[1]}",
+                        "heartbeat to scheduler shard %s:%d failed",
+                        ep[0], ep[1], exc_info=True)
+
+    # --- fleet routing ---------------------------------------------------
+    #: consecutive failed reporter rounds against one shard before its
+    #: statuses fail over to a sibling (each round already spends the
+    #: client's full in-call retry deadline, so 2 rounds ≈ several seconds
+    #: of continuous unreachability — a dead shard, not a blip)
+    REROUTE_AFTER = 2
+
+    def _extra_clients(self):
+        with self._route_lock:
+            return [(ep, c) for ep, c in self._clients.items()
+                    if c is not self.scheduler]
+
+    def _primary_endpoint(self) -> Optional[Tuple[str, int]]:
+        # an injected in-process scheduler (tests, embedded standalone mode)
+        # has no endpoint; routing then collapses to the single-scheduler
+        # path: every status goes straight through self.scheduler
+        host = getattr(self.scheduler, "host", None)
+        port = getattr(self.scheduler, "port", None)
+        if host is None or port is None:
+            return None
+        return (host, int(port))
+
+    def _client_for(self, ep: Optional[Tuple[str, int]]) -> SchedulerClient:
+        if ep is None:
+            return self.scheduler
+        with self._route_lock:
+            client = self._clients.get(ep)
+            if client is None:
+                client = SchedulerClient(ep[0], ep[1],
+                                         policy=self.retry_policy)
+                self._clients[ep] = client
+            return client
+
+    def _route_endpoint(self, job_id: str) -> Optional[Tuple[str, int]]:
+        """The shard that most recently launched tasks for this job: task
+        statuses must go back to the shard DRIVING the job.  A broadcast
+        would double-free the shared slot accounting, and pinning the
+        primary would strand statuses after an adoption re-homes the job
+        (the adopter's launches overwrite the route).  ``None`` means the
+        in-process injected scheduler (no endpoint to route by)."""
+        with self._route_lock:
+            return self._job_routes.get(job_id) or self._primary_endpoint()
+
+    def _route_client(self, job_id: str) -> SchedulerClient:
+        return self._client_for(self._route_endpoint(job_id))
+
+    def _reroute_jobs(self, job_ids, dead_ep: Optional[Tuple[str, int]],
+                      attempt: int) -> Optional[Tuple[str, int]]:
+        """Re-home these jobs' statuses to a sibling shard: their routed
+        shard stayed unreachable for REROUTE_AFTER reporter rounds (killed
+        or partitioned away).  Delivering to ANY live shard frees the
+        shared slot accounting — without this, slots reserved by a dead
+        shard's in-flight tasks leak and the adopter can never relaunch —
+        and once the adopter launches, its payload overwrites the route
+        with itself.  Continued failure walks the candidate list."""
+        if dead_ep is None:
+            # the injected in-process scheduler has no siblings; rerouting
+            # to a networked endpoint would strand the statuses instead
+            return None
+        with self._route_lock:
+            candidates = [e for e in self._clients if e != dead_ep]
+            if not candidates:
+                return None
+            fallback = candidates[attempt % len(candidates)]
+            for job_id in job_ids:
+                self._job_routes[job_id] = fallback
+                self._job_routes.move_to_end(job_id)
+        return fallback
+
+    def _learn_routes(self, payload: dict, tasks) -> None:
+        sched = payload.get("scheduler")
+        if not sched:
+            return
+        ep = (sched["host"], int(sched["port"]))
+        with self._route_lock:
+            for task in tasks:
+                self._job_routes[task.task.job_id] = ep
+                self._job_routes.move_to_end(task.task.job_id)
+            while len(self._job_routes) > self._max_job_routes:
+                self._job_routes.popitem(last=False)
 
     # --- RPC handlers ----------------------------------------------------
     def _launch_multi_task(self, payload: dict, _bin: bytes):
@@ -522,6 +646,7 @@ class ExecutorServer:
         # MultiTaskDefinition shape (one plan + N task envelopes) or the
         # legacy flat shape
         tasks = [self._decode_task(t) for t in ungroup_tasks(payload)]
+        self._learn_routes(payload, tasks)
         for task in tasks:
             self.executor.submit_task(task, self._report_status)
         return {"accepted": len(tasks)}, b""
@@ -538,6 +663,9 @@ class ExecutorServer:
 
     def _reporter_loop(self) -> None:
         pending: List[TaskStatus] = []
+        # consecutive failed rounds per shard endpoint; reaching
+        # REROUTE_AFTER re-homes that shard's statuses to a sibling
+        route_fails: Dict[Tuple[str, int], int] = {}
         while not self._stop.is_set():
             try:
                 pending.append(self._status_queue.get(timeout=0.2))
@@ -550,30 +678,68 @@ class ExecutorServer:
                     break
             if not pending:
                 continue
-            try:
-                self.scheduler.update_task_status(self.metadata.executor_id,
-                                                  list(pending))
-                pending.clear()
-                self._mark_scheduler_up()
-            except Exception:  # noqa: BLE001 — keep and retry next round
-                self._mark_scheduler_down("status report")
-                self._log_throttle.warning(
-                    "status-report",
-                    "status report failed (%d pending, will retry)",
-                    len(pending), exc_info=True)
+            # fleet: group by the shard that launched each job's tasks and
+            # flush per shard — one dead shard must not dam statuses bound
+            # for live ones.  Routes are re-resolved on every attempt, so
+            # statuses stranded toward a dead shard drain to the adopter as
+            # soon as its first launch overwrites the job's route.
+            groups: Dict[Tuple[str, int], List[TaskStatus]] = {}
+            for st in pending:
+                groups.setdefault(self._route_endpoint(st.task.job_id),
+                                  []).append(st)
+            primary = self._primary_endpoint()
+            still_pending: List[TaskStatus] = []
+            for ep, sts in groups.items():
+                client = self._client_for(ep)
+                try:
+                    client.update_task_status(self.metadata.executor_id,
+                                              list(sts))
+                    route_fails.pop(ep, None)
+                    if ep == primary:
+                        self._mark_scheduler_up()
+                except Exception:  # noqa: BLE001 — keep and retry next round
+                    fails = route_fails.get(ep, 0) + 1
+                    route_fails[ep] = fails
+                    if ep == primary:
+                        self._mark_scheduler_down("status report")
+                    ep_label = "%s:%d" % ep if ep else "in-process"
+                    if fails >= self.REROUTE_AFTER:
+                        fallback = self._reroute_jobs(
+                            {st.task.job_id for st in sts}, ep,
+                            fails - self.REROUTE_AFTER)
+                        if fallback is not None:
+                            log.warning(
+                                "shard %s unreachable for %d status "
+                                "rounds; rerouting %d status(es) to %s:%d",
+                                ep_label, fails, len(sts),
+                                fallback[0], fallback[1])
+                    self._log_throttle.warning(
+                        "status-report",
+                        "status report to %s failed (%d pending, will "
+                        "retry)", ep_label, len(sts), exc_info=True)
+                    still_pending.extend(sts)
+            pending = still_pending
+            if pending:
                 self._stop.wait(1.0)
         # final best-effort flush on shutdown — but NOT after kill():
         # a SIGKILLed executor reports nothing
         with self._teardown_lock:
             killed = self._killed
         if pending and not killed:
-            try:
-                self.scheduler.update_task_status(self.metadata.executor_id,
-                                                  list(pending))
-            # last-gasp flush on shutdown; nothing listens to a failure here
-            # ballista: allow=recovery-path-logging — shutdown best effort
-            except Exception:  # noqa: BLE001
-                pass
+            flush: Dict[int, List[TaskStatus]] = {}
+            fclients: Dict[int, SchedulerClient] = {}
+            for st in pending:
+                client = self._route_client(st.task.job_id)
+                fclients[id(client)] = client
+                flush.setdefault(id(client), []).append(st)
+            for key, sts in flush.items():
+                try:
+                    fclients[key].update_task_status(
+                        self.metadata.executor_id, list(sts))
+                # last-gasp flush on shutdown; nothing listens to a failure
+                # ballista: allow=recovery-path-logging — best effort
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _cancel_tasks(self, payload: dict, _bin: bytes):
         self.executor.cancel_job_tasks(payload["job_id"])

@@ -58,6 +58,15 @@ KNOWN_SITES = frozenset({
     "scheduler.aqe.before_rewrite",  # scheduler/aqe.py, between an AQE
                                      # rewrite decision and the graph
                                      # mutation (drop => skip the rewrite)
+    "scheduler.lease.renew",        # scheduler/scheduler.py lease loop, per
+                                    # job renewal (raise => shard stops
+                                    # renewing: simulated partition/hang)
+    "scheduler.kv.txn",             # scheduler/kv.py fenced job writes,
+                                    # before the guarded KV transaction
+    "scheduler.adopt.before_resume",  # scheduler/scheduler.py adoption,
+                                      # between lease takeover and graph
+                                      # resume (delay => widen the race
+                                      # window against completion)
 })
 
 ACTIONS = frozenset({"raise", "delay", "drop", "corrupt", "kill"})

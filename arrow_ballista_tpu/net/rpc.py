@@ -28,18 +28,29 @@ class RpcServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.handlers: Dict[str, Handler] = {}
         self.stream_handlers: Dict[str, StreamHandler] = {}
+        # live connection sockets, severed on stop(): a stopped (or chaos-
+        # killed) in-process server must look like a dead PROCESS to
+        # clients holding pooled persistent connections (RemoteKv), not
+        # keep answering them off orphaned handler threads
+        self._conns: set = set()  # ballista: guarded-by=_conns_lock
+        self._conns_lock = threading.Lock()
         outer = self
 
         class _Conn(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with outer._conns_lock:
+                    outer._conns.add(sock)
                 try:
                     while True:
                         req, binary = recv_frame(sock)
                         outer._dispatch(sock, req, binary)
                 except (ConnectionError, OSError):
                     return
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(sock)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -73,6 +84,16 @@ class RpcServer:
             # timeout keeps a wedged accept loop from hanging teardown
             self._thread.join(timeout=5.0)
         self._server.server_close()
+        # sever established connections: daemon handler threads would
+        # otherwise keep serving pooled client sockets off this "dead"
+        # server forever (a restart on the same port would go unnoticed)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def _dispatch(self, sock, req: dict, binary: bytes) -> None:
         method = req.get("method", "")

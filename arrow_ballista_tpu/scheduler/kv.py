@@ -29,8 +29,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .. import serde
+from .. import faults, serde
 from .execution_graph import ExecutionGraph
+from .types import JobLease
 
 
 # --------------------------------------------------------------------------
@@ -346,20 +347,39 @@ EXECUTORS = "executors"
 HEARTBEATS = "heartbeats"
 SLOTS = "slots"
 SESSIONS = "sessions"
+SCHEDULERS = "schedulers"  # shard registry: scheduler_id -> endpoint + sample
+
+
+class LeaseLost(Exception):
+    """A fenced job write was refused: the writer no longer holds the
+    job's lease at the epoch it claims (another shard adopted the job).
+    The only correct reaction is to stop driving the job locally — the
+    adopter owns it now."""
 
 
 class KvJobStateBackend:
     """Drop-in for FileJobStateBackend over any KeyValueStore (reference
     KeyValueState's JobState half, cluster/kv.rs save_job/get_job +
-    try_acquire_job, cluster/mod.rs:347-350)."""
+    try_acquire_job, cluster/mod.rs:347-350), extended with epoch-fenced
+    TTL leases so a fleet of schedulers can fail over without a
+    partitioned ex-owner double-driving a job."""
 
-    def __init__(self, store: KeyValueStore):
+    def __init__(self, store: KeyValueStore, lease_ttl_s: float = 15.0):
         self.store = store
+        self.lease_ttl_s = lease_ttl_s
 
-    def save_job(self, graph: ExecutionGraph) -> None:
-        self.store.put(JOBS, graph.job_id,
-                       json.dumps(serde.graph_to_obj(graph),
-                                  separators=(",", ":")))
+    def save_job(self, graph: ExecutionGraph, owner: Optional[str] = None,
+                 epoch: Optional[int] = None) -> None:
+        """Persist a graph checkpoint.  With ``owner``/``epoch`` the write
+        is fenced: it only applies while that lease is held at that epoch
+        (raises LeaseLost otherwise).  Without them it is a plain put —
+        the single-scheduler/recovery path."""
+        blob = json.dumps(serde.graph_to_obj(graph), separators=(",", ":"))
+        if owner is None:
+            self.store.put(JOBS, graph.job_id, blob)
+            return
+        self.fenced_txn(graph.job_id, owner, epoch or 0,
+                        [("put", JOBS, graph.job_id, blob)], op="save_job")
 
     def load_job(self, job_id: str) -> Optional[ExecutionGraph]:
         val = self.store.get(JOBS, job_id)
@@ -374,10 +394,164 @@ class KvJobStateBackend:
 
     def try_acquire_job(self, job_id: str, owner: str,
                         stale_after_s: float = 60.0) -> bool:
-        return self.store.lock(JOB_LOCKS, job_id, owner, stale_after_s)
+        return self.acquire_lease(job_id, owner,
+                                  ttl_s=stale_after_s) is not None
 
     def renew_lock(self, job_id: str, owner: str) -> None:
-        self.store.lock(JOB_LOCKS, job_id, owner, ttl_s=0x7FFFFFFF)
+        lease = self.get_lease(job_id)
+        if lease is None:
+            self.acquire_lease(job_id, owner)
+        elif lease.owner == owner:
+            self.renew_lease(job_id, owner, lease.epoch)
+
+    # --- epoch-fenced TTL leases -----------------------------------------
+    def _parse_lease(self, job_id: str, val: Optional[str]
+                     ) -> Optional[JobLease]:
+        if not val:
+            return None
+        try:
+            obj = json.loads(val)
+        except ValueError:
+            return None
+        obj["job_id"] = job_id
+        return serde.job_lease_from_obj(obj)
+
+    @staticmethod
+    def _lease_value(lease: JobLease) -> str:
+        return json.dumps({"owner": lease.owner, "epoch": lease.epoch,
+                           "ts": lease.ts, "endpoint": lease.endpoint},
+                          separators=(",", ":"))
+
+    def get_lease(self, job_id: str) -> Optional[JobLease]:
+        return self._parse_lease(job_id, self.store.get(JOB_LOCKS, job_id))
+
+    def leases(self) -> List[JobLease]:
+        out = []
+        for job_id, val in self.store.scan(JOB_LOCKS):
+            lease = self._parse_lease(job_id, val)
+            if lease is not None:
+                out.append(lease)
+        return out
+
+    def expired_leases(self, ttl_s: Optional[float] = None) -> List[JobLease]:
+        ttl = self.lease_ttl_s if ttl_s is None else ttl_s
+        now = time.time()
+        return [l for l in self.leases() if now - l.ts > ttl]
+
+    def acquire_lease(self, job_id: str, owner: str, endpoint: str = "",
+                      ttl_s: Optional[float] = None) -> Optional[JobLease]:
+        """Claim (or re-claim) the job's lease via a guarded CAS.  A fresh
+        claim or a takeover of an expired lease bumps the epoch — that is
+        the fencing token; a same-owner re-acquire keeps it (renewal).
+        Returns the held lease, or None while another owner's lease is
+        still fresh (or a racer won the CAS)."""
+        ttl = self.lease_ttl_s if ttl_s is None else ttl_s
+        now = time.time()
+        val = self.store.get(JOB_LOCKS, job_id)
+        cur = self._parse_lease(job_id, val)
+        if cur is not None and cur.owner != owner and now - cur.ts <= ttl:
+            return None
+        if cur is not None and cur.owner == owner:
+            epoch = cur.epoch
+            endpoint = endpoint or cur.endpoint
+        else:
+            epoch = (cur.epoch if cur is not None else 0) + 1
+        lease = JobLease(job_id, owner, epoch, now, endpoint)
+        try:
+            self.store.txn([("put", JOB_LOCKS, job_id,
+                             self._lease_value(lease))],
+                           guards=[(JOB_LOCKS, job_id, val)])
+            return lease
+        except TxnGuardFailed:
+            return None
+
+    def renew_lease(self, job_id: str, owner: str, epoch: int
+                    ) -> Optional[JobLease]:
+        """Refresh the lease timestamp iff still held at (owner, epoch).
+        Returns the renewed lease, or None when ownership moved — the
+        caller must stop driving the job."""
+        for _ in range(4):
+            val = self.store.get(JOB_LOCKS, job_id)
+            cur = self._parse_lease(job_id, val)
+            if cur is None or cur.owner != owner or cur.epoch != epoch:
+                return None
+            lease = JobLease(job_id, owner, epoch, time.time(), cur.endpoint)
+            try:
+                self.store.txn([("put", JOB_LOCKS, job_id,
+                                 self._lease_value(lease))],
+                               guards=[(JOB_LOCKS, job_id, val)])
+                return lease
+            except TxnGuardFailed:
+                continue  # racing fenced write/renewal; re-read and retry
+        return None
+
+    def release_lease(self, job_id: str, owner: str) -> None:
+        val = self.store.get(JOB_LOCKS, job_id)
+        cur = self._parse_lease(job_id, val)
+        if cur is not None and cur.owner == owner:
+            try:
+                self.store.txn([("del", JOB_LOCKS, job_id, None)],
+                               guards=[(JOB_LOCKS, job_id, val)])
+            except TxnGuardFailed:
+                pass  # adopted or renewed concurrently; not ours to delete
+
+    def fenced_txn(self, job_id: str, owner: str, epoch: int,
+                   ops: List[Tuple[str, str, str, Optional[str]]],
+                   op: str = "txn") -> None:
+        """Apply ``ops`` atomically, guarded on the job's lease standing at
+        (owner, epoch).  The guard covers the whole lease value, so a
+        concurrent self-renewal (ts bump) just retries; an owner or epoch
+        change raises LeaseLost and nothing is applied."""
+        for _ in range(8):
+            val = self.store.get(JOB_LOCKS, job_id)
+            cur = self._parse_lease(job_id, val)
+            if cur is None or cur.owner != owner or cur.epoch != epoch:
+                held = (f"{cur.owner}@e{cur.epoch}" if cur is not None
+                        else "nobody")
+                raise LeaseLost(f"job {job_id} {op}: lease held by {held}, "
+                                f"writer is {owner}@e{epoch}")
+            faults.inject("scheduler.kv.txn", job_id=job_id, owner=owner,
+                          op=op)
+            try:
+                self.store.txn(list(ops), guards=[(JOB_LOCKS, job_id, val)])
+                return
+            except TxnGuardFailed:
+                continue  # lease value moved under us; re-read and re-check
+        raise LeaseLost(f"job {job_id} {op}: lease CAS kept failing for "
+                        f"{owner}@e{epoch}")
+
+
+# --- shard registry (client failover + /api/autoscale aggregation) --------
+
+
+def publish_scheduler(store: KeyValueStore, scheduler_id: str, endpoint: str,
+                      sample: Optional[dict] = None) -> None:
+    """Announce a shard's client endpoint (and optionally its latest
+    cluster sample) in the shared KV; refreshed from the lease thread so
+    freshness doubles as shard liveness."""
+    obj = {"scheduler_id": scheduler_id, "endpoint": endpoint,
+           "ts": time.time()}
+    if sample is not None:
+        obj["sample"] = sample
+    store.put(SCHEDULERS, scheduler_id, json.dumps(obj, separators=(",", ":")))
+
+
+def scheduler_registry(store: KeyValueStore, stale_s: float = 30.0
+                       ) -> Dict[str, dict]:
+    now = time.time()
+    out: Dict[str, dict] = {}
+    for sid, val in store.scan(SCHEDULERS):
+        try:
+            obj = json.loads(val)
+        except ValueError:
+            continue
+        if now - obj.get("ts", 0) <= stale_s:
+            out[sid] = obj
+    return out
+
+
+def remove_scheduler(store: KeyValueStore, scheduler_id: str) -> None:
+    store.delete(SCHEDULERS, scheduler_id)
 
 
 # --------------------------------------------------------------------------
@@ -564,3 +738,8 @@ class KvClusterState:
 
     def available_slots(self) -> int:
         return sum(int(v) for _, v in self.store.scan(SLOTS))
+
+    def total_available(self) -> int:
+        """Free slots fleet-wide (cluster.ClusterState surface — the
+        utilization numerator in cluster_sample/autoscale_signal)."""
+        return self.available_slots()

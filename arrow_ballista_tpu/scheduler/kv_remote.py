@@ -190,33 +190,79 @@ class RemoteKv(KeyValueStore):
 
 
 class _RemoteWatch(_QueueWatch):
+    """Watch over KvServer's replay log, resilient to server restarts:
+
+    - the constructor tolerates a down server (cursor acquisition moves
+      into the loop; the watch comes up when the server does, primed with
+      a resync + snapshot);
+    - poll failures retry with doubling capped backoff instead of a fixed
+      sleep, so a bounced server is re-attached to quickly without
+      hammering a dead one;
+    - a HEAD REGRESSION (``head < since``: the restarted server's sequence
+      counter reset to 0) forces a client-side resync — the server-side
+      ``resync`` marker cannot flag this case because the fresh server's
+      replay log is empty."""
+
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_CAP_S = 2.0
+
     def __init__(self, kv: RemoteKv, space: str):
         super().__init__()
         self._stop = threading.Event()
-        # cursor starts at the server head so only NEW events stream
-        head = kv._call("kv_poll", {"space": space, "since": 0,
-                                    "timeout": 0.0})["head"]
+        # cursor starts at the server head so only NEW events stream; a
+        # down server defers acquisition to the loop rather than failing
+        # the caller
+        try:
+            head = int(kv._call("kv_poll", {"space": space, "since": 0,
+                                            "timeout": 0.0})["head"])
+        except Exception:  # noqa: BLE001 — server away; loop will attach
+            log.debug("kv watch on %s deferred: server unreachable", space,
+                      exc_info=True)
+            head = None
+
+        def _resync(since_hint):
+            """Clear-and-snapshot: consumers drop their mirror (deletes
+            during the gap produce no events), then the snapshot streams
+            as puts.  Returns the new cursor, or None to retry."""
+            self._push(WatchEvent("resync", space, "", None))
+            try:
+                snapshot = kv.scan(space)
+            except Exception:  # noqa: BLE001 — bounced again mid-resync
+                log.debug("kv watch resync scan on %s failed; retrying",
+                          space, exc_info=True)
+                return None
+            for k, v in snapshot:
+                self._push(WatchEvent("put", space, k, v))
+            return since_hint
 
         def run():
             since = head
+            backoff = self.BACKOFF_BASE_S
             while not self._stop.is_set():
                 try:
+                    if since is None:
+                        # (re)acquire the cursor, then prime the consumer:
+                        # anything that happened while detached is invisible
+                        # to the replay cursor, so snapshot from scratch
+                        cur = int(kv._call("kv_poll", {
+                            "space": space, "since": 0,
+                            "timeout": 0.0})["head"])
+                        since = _resync(cur)
+                        backoff = self.BACKOFF_BASE_S
+                        continue
                     out = kv._call("kv_poll", {"space": space, "since": since,
                                                "timeout": 5.0})
-                except Exception:  # noqa: BLE001 — server away; retry
+                except Exception:  # noqa: BLE001 — server away; back off
                     log.debug("kv_poll on %s failed; retrying", space,
                               exc_info=True)
-                    if self._stop.wait(1.0):
+                    if self._stop.wait(backoff):
                         break
+                    backoff = min(backoff * 2.0, self.BACKOFF_CAP_S)
                     continue
-                if out.get("resync"):
-                    # replay window lost: a 'resync' marker tells consumers
-                    # to CLEAR their mirror (deletes during the gap produce
-                    # no events), then the snapshot streams as puts
-                    self._push(WatchEvent("resync", space, "", None))
-                    for k, v in kv.scan(space):
-                        self._push(WatchEvent("put", space, k, v))
-                    since = out["head"]
+                backoff = self.BACKOFF_BASE_S
+                hd = int(out.get("head", since))
+                if out.get("resync") or hd < since:
+                    since = _resync(hd)
                     continue
                 for ev in out["events"]:
                     self._push(WatchEvent(ev["op"], space, ev["key"],
@@ -225,7 +271,7 @@ class _RemoteWatch(_QueueWatch):
                 # read under one server lock), so advancing to head is safe
                 # AND required: without it, traffic in OTHER keyspaces makes
                 # the long-poll return immediately forever (busy loop)
-                since = max(since, int(out.get("head", since)))
+                since = max(since, hd)
 
         self._thread = threading.Thread(target=run, name=f"kv-rwatch-{space}",
                                         daemon=True)

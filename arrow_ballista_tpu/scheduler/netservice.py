@@ -115,6 +115,11 @@ class NetTaskLauncher(TaskLauncher):
         # the retryable path that re-runs the tasks elsewhere without
         # charging task retry budgets
         self.policy = policy or RetryPolicy()
+        # (host, port) this scheduler serves RPC on; rides in every launch
+        # payload so multi-registered executors report task statuses back
+        # to the shard that LAUNCHED the task (fleet mode: a status
+        # broadcast to every shard would double-free shared slot accounting)
+        self.endpoint: Optional[tuple] = None
 
     def _addr(self, executor_id: str):
         meta = self.scheduler.cluster.get_executor(executor_id)
@@ -131,9 +136,12 @@ class NetTaskLauncher(TaskLauncher):
         # envelopes, so the plan crosses the wire once per stage, not once
         # per task
         host, port = self._addr(executor_id)
+        payload = {"stages": group_tasks_by_plan(objs)}
+        if self.endpoint is not None:
+            payload["scheduler"] = {"host": self.endpoint[0],
+                                    "port": self.endpoint[1]}
         try:
-            call_with_retry(host, port, "launch_multi_task",
-                            {"stages": group_tasks_by_plan(objs)},
+            call_with_retry(host, port, "launch_multi_task", payload,
                             policy=self.policy)
         except wire.RemoteError as e:
             if "'tasks'" not in str(e):
@@ -187,6 +195,10 @@ class SchedulerNetService:
             # reaper, and the REST summary alike
             from ..utils.config import (
                 CLUSTER_EXECUTOR_TIMEOUT_S,
+                FLEET_ADOPT_INTERVAL_S,
+                FLEET_LEASE_RENEW_S,
+                FLEET_LEASE_TTL_S,
+                FLEET_REGISTRY_STALE_S,
                 QUARANTINE_FAILURES,
                 QUARANTINE_PROBATION_S,
                 SPECULATION_ENABLED,
@@ -200,6 +212,14 @@ class SchedulerNetService:
             scheduler_config = SchedulerConfig(
                 executor_timeout_s=float(
                     self.config.get(CLUSTER_EXECUTOR_TIMEOUT_S)),
+                fleet_lease_ttl_s=float(
+                    self.config.get(FLEET_LEASE_TTL_S)),
+                fleet_lease_renew_s=float(
+                    self.config.get(FLEET_LEASE_RENEW_S)),
+                fleet_adopt_interval_s=float(
+                    self.config.get(FLEET_ADOPT_INTERVAL_S)),
+                fleet_registry_stale_s=float(
+                    self.config.get(FLEET_REGISTRY_STALE_S)),
                 quarantine_failures=int(
                     self.config.get(QUARANTINE_FAILURES)),
                 quarantine_probation_s=float(
@@ -230,7 +250,8 @@ class SchedulerNetService:
             # kv://host:port -> networked KV service (multi-host HA);
             # memory:// / sqlite:/// -> embedded
             store = open_remote_or_local(cluster_url)
-            job_backend = KvJobStateBackend(store)
+            job_backend = KvJobStateBackend(store,
+                                            lease_ttl_s=sc.fleet_lease_ttl_s)
             cluster_state = KvClusterState(store, sc.task_distribution)
         elif state_dir:
             from .persistence import FileJobStateBackend
@@ -246,6 +267,11 @@ class SchedulerNetService:
         launcher.scheduler = self.server
         self.rpc = RpcServer(host, port)
         self.host, self.port = self.rpc.host, self.rpc.port
+        # published to the shard registry + job leases so a surviving shard
+        # (and redirected clients) can name where this scheduler serves;
+        # launch payloads carry it so executors route statuses back here
+        self.server.client_endpoint = f"{self.host}:{self.port}"
+        launcher.endpoint = (self.host, self.port)
         # job -> result schema, LRU-bounded: clients fetch results right
         # after completion, so old entries are dead weight in a long-running
         # daemon
@@ -318,6 +344,20 @@ class SchedulerNetService:
 
     def stop(self) -> None:
         self.server.shutdown()
+        self.rpc.stop()
+        if self.rest is not None:
+            self.rest.stop()
+        if self.flight is not None:
+            self.flight.stop()
+
+    def kill(self) -> None:
+        """Crash-simulate this shard inside one process (chaos harness):
+        tear the RPC listener and background threads down WITHOUT the
+        goodbyes a clean stop performs — no registry withdrawal, no lease
+        release.  Held job leases simply stop renewing, exactly like
+        kill -9, so a sibling shard must adopt them through lease expiry
+        (the registry entry ages out at the stale cutoff the same way)."""
+        self.server.shutdown(withdraw=False)
         self.rpc.stop()
         if self.rest is not None:
             self.rest.stop()
@@ -449,7 +489,7 @@ class SchedulerNetService:
                     "schema": serde.schema_to_obj(cached["schema"])}, b""
         status = self.server.get_job_status(job_id)
         if status is None:
-            return {"state": "not_found"}, b""
+            return self._resolve_foreign_status(job_id), b""
         out = {"state": status.state, "error": status.error,
                "retriable": status.retriable}
         if status.state == "successful":
@@ -458,9 +498,51 @@ class SchedulerNetService:
                 for part, locs in status.locations.items()}
             with self._lock:
                 schema = self._final_schemas.get(job_id)
+            if schema is None:
+                # adopted job: the submit-time schema cache lives on the
+                # shard that PLANNED it — re-derive from the final stage
+                graph = self.server.jobs.get_graph(job_id)
+                if graph is not None:
+                    final = graph.stages[graph.final_stage_id]
+                    schema = (final.resolved_plan or final.plan).schema
             if schema is not None:
                 out["schema"] = serde.schema_to_obj(schema)
         return out, b""
+
+    def _resolve_foreign_status(self, job_id: str) -> dict:
+        """A job this shard is not driving: consult the shared KV so
+        clients polling the wrong shard after a failover either get
+        redirected (lease held by a sibling — the reply names the owner's
+        endpoint) or served directly (the job finished and its lease was
+        released: the checkpointed graph is the source of truth, and the
+        result schema is re-derived from the final stage's plan because
+        ``_final_schemas`` is shard-local)."""
+        backend = self.server.job_backend
+        if backend is None or not hasattr(backend, "get_lease"):
+            return {"state": "not_found"}
+        try:
+            lease = backend.get_lease(job_id)
+            if lease is not None and lease.owner != self.server.scheduler_id:
+                return {"state": "not_found", "owner": lease.owner,
+                        "endpoint": lease.endpoint}
+            graph = backend.load_job(job_id)
+        except Exception:  # noqa: BLE001 — KV blip: look lost, not failed
+            log.exception("foreign-status resolution failed for %s", job_id)
+            return {"state": "not_found"}
+        if graph is None or graph.status not in ("successful", "failed"):
+            return {"state": "not_found"}
+        if graph.status == "failed":
+            return {"state": "failed", "error": graph.error,
+                    "retriable": False}
+        graph.addr_resolver = self.server._resolve_addr
+        final = graph.stages[graph.final_stage_id]
+        locations = final.output_locations(graph.addr_resolver)
+        return {"state": "successful", "error": "", "retriable": False,
+                "locations": {
+                    str(part): [serde.location_to_obj(l) for l in locs]
+                    for part, locs in locations.items()},
+                "schema": serde.schema_to_obj(
+                    (final.resolved_plan or final.plan).schema)}
 
     def _fetch_result(self, payload: dict, _bin: bytes):
         """One-shot pull of a parked result-cache hit: the reply payload

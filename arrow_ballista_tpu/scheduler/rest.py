@@ -26,6 +26,9 @@ Beyond the reference surface:
     GET  /api/plan-cache       prepared-plan cache: hit/miss/eviction
                                counters, budgets, recent templates
     GET  /api/result-cache     result/subplan cache counters + budgets
+    GET  /api/autoscale        KEDA-style fleet scaling signal: pending
+                               tasks / utilization / queue depths summed
+                               across shards via the shared-KV registry
 """
 from __future__ import annotations
 
@@ -164,6 +167,12 @@ class RestApi:
             # metrics-api trigger (deploy/helm templates/hpa.yaml)
             h._send(200, json.dumps(
                 {"inflight_tasks": self.server.pending_task_count()}))
+        elif rest == ["autoscale"]:
+            # fleet-wide scaling signal: /api/scaler's successor — pending
+            # work, queue depths and utilization summed over every live
+            # shard via the shared-KV shard registry (docs/user-guide/
+            # metrics.md), plus a desired_executors suggestion
+            h._send(200, json.dumps(self.server.autoscale_signal()))
         else:
             h._send(404, json.dumps({"error": "not found"}))
 

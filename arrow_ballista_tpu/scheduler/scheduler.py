@@ -24,6 +24,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import faults
 from ..admission import AdmissionController, AdmissionRequest
 from ..analysis.plan_checks import validate_graph
 from ..utils.config import ANALYSIS_PLAN_CHECKS
@@ -151,9 +152,17 @@ class SchedulerConfig:
                  speculation_max_concurrent: Optional[int] = None,
                  speculation_interval_s: Optional[float] = None,
                  stats_history_capacity: Optional[int] = None,
-                 stats_history_interval_s: Optional[float] = None):
+                 stats_history_interval_s: Optional[float] = None,
+                 fleet_lease_ttl_s: Optional[float] = None,
+                 fleet_lease_renew_s: Optional[float] = None,
+                 fleet_adopt_interval_s: Optional[float] = None,
+                 fleet_registry_stale_s: Optional[float] = None):
         from ..utils.config import (BallistaConfig,
                                     CLUSTER_EXECUTOR_TIMEOUT_S,
+                                    FLEET_ADOPT_INTERVAL_S,
+                                    FLEET_LEASE_RENEW_S,
+                                    FLEET_LEASE_TTL_S,
+                                    FLEET_REGISTRY_STALE_S,
                                     QUARANTINE_FAILURES,
                                     QUARANTINE_PROBATION_S,
                                     SPECULATION_ENABLED,
@@ -220,6 +229,21 @@ class SchedulerConfig:
         # backstop, in standalone mode the work dir dies with the cluster
         # (StandaloneCluster.shutdown).
         self.job_data_cleanup_delay_s = job_data_cleanup_delay_s
+        # scheduler fleet HA (ballista.fleet.*): job-ownership lease TTL,
+        # renewal cadence (0 = ttl/3), expired-lease adoption scan interval
+        # and shard-registry freshness (client failover + /api/autoscale)
+        self.fleet_lease_ttl_s = float(
+            fleet_lease_ttl_s if fleet_lease_ttl_s is not None
+            else defaults.get(FLEET_LEASE_TTL_S))
+        self.fleet_lease_renew_s = float(
+            fleet_lease_renew_s if fleet_lease_renew_s is not None
+            else defaults.get(FLEET_LEASE_RENEW_S))
+        self.fleet_adopt_interval_s = float(
+            fleet_adopt_interval_s if fleet_adopt_interval_s is not None
+            else defaults.get(FLEET_ADOPT_INTERVAL_S))
+        self.fleet_registry_stale_s = float(
+            fleet_registry_stale_s if fleet_registry_stale_s is not None
+            else defaults.get(FLEET_REGISTRY_STALE_S))
 
 
 class SchedulerServer:
@@ -249,6 +273,19 @@ class SchedulerServer:
         # backends + try_acquire_job)
         self.job_backend = job_backend
         self.scheduler_id = scheduler_id or f"scheduler-{uuid.uuid4().hex[:8]}"
+        # fleet HA: lease-capable backends (KvJobStateBackend) get epoch-
+        # fenced TTL ownership; file/legacy backends keep the PR-4 lock path
+        self._lease_capable = job_backend is not None \
+            and hasattr(job_backend, "acquire_lease")
+        # "host:port" this shard serves clients on, published in the lease
+        # and the shard registry for client failover; set by the net
+        # service once its RPC port is known, before init()
+        self.client_endpoint = ""  # ballista: guarded-by=none
+        # _lease_lock guards _leases (job_id -> held lease epoch): written
+        # by event-loop handlers (checkpoint/terminal release), the lease-
+        # renewal thread and the adoption scanner
+        self._lease_lock = threading.Lock()
+        self._leases: Dict[str, int] = {}
         # _meta_lock guards the per-job bookkeeping dicts below
         # (_queued_at_ms, _job_configs, _serving_info): they are touched
         # from submit threads, admission callbacks (sweeper thread), event
@@ -285,6 +322,8 @@ class SchedulerServer:
         self._reaper: Optional[threading.Thread] = None  # ballista: guarded-by=none
         self._spec_monitor: Optional[threading.Thread] = None  # ballista: guarded-by=none
         self._history_sampler: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        self._lease_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        self._adopt_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
         # cluster time series behind GET /api/cluster/history: periodic
         # utilization / queue-depth / event-loop-lag samples in a bounded
         # ring buffer (obs/stats.py)
@@ -326,8 +365,20 @@ class SchedulerServer:
             target=self._history_loop, name="cluster-history-sampler",
             daemon=True)
         self._history_sampler.start()
+        if start_reaper and self._lease_capable:
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, name="lease-renewal", daemon=True)
+            self._lease_thread.start()
+            if self.config.fleet_adopt_interval_s > 0:
+                self._adopt_thread = threading.Thread(
+                    target=self._adopt_loop, name="lease-adoption",
+                    daemon=True)
+                self._adopt_thread.start()
 
-    def shutdown(self) -> None:
+    def shutdown(self, withdraw: bool = True) -> None:
+        # withdraw=False is the chaos harness's crash-simulation: skip the
+        # registry goodbye so the shard vanishes exactly like kill -9
+        # (its entry ages out of scheduler_registry at the stale cutoff)
         # order matters: stop the event loop BEFORE closing the launch pool,
         # so no event handler can race a _launch_pool.submit against
         # pool.shutdown (round-2 bench crash: "cannot schedule new futures
@@ -343,6 +394,23 @@ class SchedulerServer:
             self._spec_monitor.join(timeout=5.0)
         if self._history_sampler is not None:
             self._history_sampler.join(timeout=5.0)
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=5.0)
+        if self._adopt_thread is not None:
+            self._adopt_thread.join(timeout=5.0)
+        # clean shutdown deliberately does NOT release job leases: a
+        # shard stopping mid-job should look exactly like a crash so a
+        # sibling adopts its jobs after one TTL.  Only the registry entry
+        # (client routing hint) is withdrawn.
+        if self._lease_capable and withdraw:
+            store = getattr(self.job_backend, "store", None)
+            if store is not None:
+                try:
+                    from .kv import remove_scheduler
+                    remove_scheduler(store, self.scheduler_id)
+                except Exception:  # noqa: BLE001 — KV may already be gone
+                    log.info("shard registry withdrawal failed",
+                             exc_info=True)
         with self._cleanup_lock:
             timers = list(self._cleanup_timers.values())
             self._cleanup_timers.clear()
@@ -429,6 +497,10 @@ class SchedulerServer:
     def _on_job_terminal(self, status: JobStatus) -> None:
         if status.state in ("successful", "failed", "cancelled"):
             self.admission.release(status.job_id)
+            # fleet: completion releases the ownership lease (the terminal
+            # checkpoint is already durable) so the lock never lingers as
+            # an adoptable expired lease
+            self._release_lease(status.job_id)
             # backstop: success pops this at capture time; failed/cancelled
             # (and crashed-handler) paths release the serving info here
             with self._meta_lock:
@@ -670,14 +742,84 @@ class SchedulerServer:
         self._checkpoint(ev.graph)
         self._offer()
 
-    def _checkpoint(self, graph: ExecutionGraph) -> None:
+    def _checkpoint(self, graph: ExecutionGraph) -> bool:
+        """Persist the graph.  Returns False only when this shard lost the
+        job's lease (another shard adopted it) — the caller must stop
+        driving the job; plain persistence failures stay best-effort."""
         if self.job_backend is None:
-            return
+            return True
+        if not self._lease_capable:
+            try:
+                self.job_backend.try_acquire_job(graph.job_id,
+                                                 self.scheduler_id)
+                self.job_backend.save_job(graph)
+            except Exception:  # noqa: BLE001 — persistence is best-effort
+                log.exception("job checkpoint failed for %s", graph.job_id)
+            return True
+        from .kv import LeaseLost
+
         try:
-            self.job_backend.try_acquire_job(graph.job_id, self.scheduler_id)
-            self.job_backend.save_job(graph)
+            epoch = self._acquire_job_lease(graph.job_id)
+            if epoch is None:
+                self._on_lease_lost(graph.job_id,
+                                    "lease held by another shard")
+                return False
+            self.job_backend.save_job(graph, owner=self.scheduler_id,
+                                      epoch=epoch)
+            return True
+        except LeaseLost as e:
+            self._on_lease_lost(graph.job_id, str(e))
+            return False
         except Exception:  # noqa: BLE001 — persistence is best-effort
             log.exception("job checkpoint failed for %s", graph.job_id)
+            return True
+
+    def _acquire_job_lease(self, job_id: str) -> Optional[int]:
+        """The epoch this shard holds the job's lease at, acquiring the
+        lease on first use (fresh jobs claim at first checkpoint)."""
+        with self._lease_lock:
+            epoch = self._leases.get(job_id)
+        if epoch is not None:
+            return epoch
+        lease = self.job_backend.acquire_lease(
+            job_id, self.scheduler_id, endpoint=self.client_endpoint,
+            ttl_s=self.config.fleet_lease_ttl_s)
+        if lease is None:
+            return None
+        with self._lease_lock:
+            self._leases[job_id] = lease.epoch
+        return lease.epoch
+
+    def _release_lease(self, job_id: str) -> None:
+        if not self._lease_capable:
+            return
+        with self._lease_lock:
+            held = self._leases.pop(job_id, None)
+        if held is None:
+            return
+        try:
+            self.job_backend.release_lease(job_id, self.scheduler_id)
+        except Exception:  # noqa: BLE001 — lease will expire regardless
+            log.exception("lease release failed for %s", job_id)
+
+    def _on_lease_lost(self, job_id: str, why: str) -> None:
+        """Fencing kicked in: another shard owns the job now.  Drop every
+        local trace of it and reap our in-flight tasks — the adopter
+        relaunches them and records all further state."""
+        with self._lease_lock:
+            self._leases.pop(job_id, None)
+        if self.jobs.get_status(job_id) is None:
+            return
+        log.warning("lost lease on job %s (%s): abandoning local drive",
+                    job_id, why)
+        graph = self.jobs.get_graph(job_id)
+        self.jobs.remove_job(job_id)
+        with self._meta_lock:
+            self._queued_at_ms.pop(job_id, None)
+            self._serving_info.pop(job_id, None)
+        self.admission.release(job_id)
+        if graph is not None:
+            self._submit_work(self._cancel_running, graph)
 
     def recover_jobs(self) -> List[str]:
         """Adopt persisted unfinished jobs (reference try_acquire_job,
@@ -688,6 +830,10 @@ class SchedulerServer:
         adopted = []
         for job_id in self.job_backend.list_jobs():
             if self.jobs.get_status(job_id) is not None:
+                continue
+            if self._lease_capable:
+                if self._adopt_one(job_id):
+                    adopted.append(job_id)
                 continue
             if not self.job_backend.try_acquire_job(job_id, self.scheduler_id):
                 continue
@@ -702,6 +848,102 @@ class SchedulerServer:
         if adopted:
             self._event_loop.post(Offer())
         return adopted
+
+    # --- fleet HA: lease renewal + adoption ------------------------------
+    def _lease_loop(self) -> None:
+        """Lease heartbeat: renew every held job lease and refresh this
+        shard's registry entry.  Not an event handler — blocking KV calls
+        are fine here (same idiom as ``_reap_loop``)."""
+        ttl = self.config.fleet_lease_ttl_s
+        interval = self.config.fleet_lease_renew_s or ttl / 3.0
+        while not self._stopped.wait(interval):
+            with self._lease_lock:
+                held = dict(self._leases)
+            for job_id, epoch in held.items():
+                try:
+                    faults.inject("scheduler.lease.renew", job_id=job_id,
+                                  scheduler_id=self.scheduler_id)
+                except Exception as e:  # noqa: BLE001 — injected partition
+                    log.warning("lease renewal suppressed for %s: %s",
+                                job_id, e)
+                    continue
+                try:
+                    if self.job_backend.renew_lease(
+                            job_id, self.scheduler_id, epoch) is None:
+                        self._on_lease_lost(job_id, "renewal refused")
+                except Exception:  # noqa: BLE001 — KV blip; TTL still runs
+                    log.exception("lease renewal failed for %s", job_id)
+            self._publish_registry()
+
+    def _publish_registry(self) -> None:
+        store = getattr(self.job_backend, "store", None)
+        if store is None:
+            return
+        from .kv import publish_scheduler
+
+        try:
+            publish_scheduler(store, self.scheduler_id, self.client_endpoint,
+                              sample=self._registry_sample())
+        except Exception:  # noqa: BLE001 — registry is advisory
+            log.exception("shard registry publish failed")
+
+    _REGISTRY_KEYS = ("pending_tasks", "active_jobs",
+                      "admission_queue_depth", "utilization", "total_slots",
+                      "available_slots", "executors_alive")
+
+    def _registry_sample(self) -> Dict:
+        s = self.cluster_sample()
+        return {k: s[k] for k in self._REGISTRY_KEYS}
+
+    def _adopt_loop(self) -> None:
+        while not self._stopped.wait(self.config.fleet_adopt_interval_s):
+            try:
+                self.adopt_expired_jobs()
+            except Exception:  # noqa: BLE001 — scan again next interval
+                log.exception("lease adoption scan failed")
+
+    def adopt_expired_jobs(self) -> List[str]:
+        """Scan the shared KV for jobs whose owner stopped renewing (crash,
+        partition, kill -9) and adopt them: take the lease over — bumping
+        the fencing epoch — reload the graph from its last checkpoint, and
+        resume driving it."""
+        if not self._lease_capable or self._stopped.is_set():
+            return []
+        adopted: List[str] = []
+        for stale in self.job_backend.expired_leases(
+                self.config.fleet_lease_ttl_s):
+            if stale.owner == self.scheduler_id:
+                continue  # our own expiry: the renewal loop handles it
+            if self.jobs.get_status(stale.job_id) is not None:
+                continue
+            if self._adopt_one(stale.job_id):
+                adopted.append(stale.job_id)
+        if adopted:
+            self._event_loop.post(Offer())
+        return adopted
+
+    def _adopt_one(self, job_id: str) -> bool:
+        lease = self.job_backend.acquire_lease(
+            job_id, self.scheduler_id, endpoint=self.client_endpoint,
+            ttl_s=self.config.fleet_lease_ttl_s)
+        if lease is None:
+            return False  # the owner came back, or another shard won
+        faults.inject("scheduler.adopt.before_resume", job_id=job_id,
+                      scheduler_id=self.scheduler_id)
+        graph = self.job_backend.load_job(job_id)
+        if graph is None or graph.status != "running":
+            # the ex-owner finished the job (adoption raced completion) or
+            # it never reached a running checkpoint: nothing to drive —
+            # drop the claim so the lock doesn't linger as expired
+            self.job_backend.release_lease(job_id, self.scheduler_id)
+            return False
+        with self._lease_lock:
+            self._leases[job_id] = lease.epoch
+        graph.addr_resolver = self._resolve_addr
+        self.jobs.accept_job(job_id)
+        self.jobs.submit_job(job_id, graph)
+        log.info("adopted job %s at lease epoch %d", job_id, lease.epoch)
+        return True
 
     def _on_task_updating(self, ev: TaskUpdating) -> None:
         statuses = ev.statuses
@@ -934,7 +1176,8 @@ class SchedulerServer:
                 # terminal state must be durable BEFORE waiters wake:
                 # set_status releases wait_for_job, and a restarted
                 # scheduler must never see a completed job as running
-                self._checkpoint(graph)
+                if not self._checkpoint(graph):
+                    return  # lease lost: the adopter owns this job now
                 checkpointed = True
                 with self._meta_lock:
                     serving = self._serving_info.pop(job_id, None)
@@ -950,7 +1193,8 @@ class SchedulerServer:
                     job_id, queued_at, int(time.time() * 1000))
                 self._schedule_job_data_cleanup(graph)
             elif kind == "job_failed":
-                self._checkpoint(graph)
+                if not self._checkpoint(graph):
+                    return  # lease lost: the adopter owns this job now
                 checkpointed = True
                 self.jobs.set_status(
                     JobStatus(job_id, "failed", error=str(payload)))
@@ -961,7 +1205,7 @@ class SchedulerServer:
                 self._schedule_job_data_cleanup(graph)
         self._drain_aqe_events(graph)
         if not checkpointed:
-            self._checkpoint(graph)
+            self._checkpoint(graph)  # False = abandoned; nothing more to do
 
     def _drain_aqe_events(self, graph) -> None:
         """Fold the graph's buffered AQE rewrite events into the metrics
@@ -1009,6 +1253,13 @@ class SchedulerServer:
         assignments: Dict[str, List[TaskDescription]] = {}
         unused: List[ExecutorReservation] = []
         graphs = self.jobs.active_graphs()
+        if self._lease_capable:
+            # slots go only to jobs whose lease THIS shard holds: a job we
+            # were fenced off of is the adopter's to drive, even if its
+            # local teardown hasn't landed yet
+            with self._lease_lock:
+                owned = set(self._leases)
+            graphs = [g for g in graphs if g.job_id in owned]
         gate = self.admission.slot_gate(
             lambda: {g.job_id: len(g.running_tasks()) for g in graphs})
         for r in reservations:
@@ -1100,6 +1351,58 @@ class SchedulerServer:
             "event_handler_seconds_mean": ev["handler_seconds_mean"],
             "slow_events": ev["slow_events"],
         }
+
+    def autoscale_signal(self) -> Dict:
+        """KEDA-style scaling signal behind GET /api/autoscale: pending
+        work, utilization and queue depths — aggregated across every live
+        shard via the shared-KV shard registry when one exists, so any
+        shard answers for the whole fleet (reference external_scaler.rs
+        generalized from one scheduler to N)."""
+        local = self.cluster_sample()
+        shards = [{"scheduler_id": self.scheduler_id,
+                   "endpoint": self.client_endpoint,
+                   **{k: local[k] for k in self._REGISTRY_KEYS}}]
+        store = getattr(self.job_backend, "store", None) \
+            if self._lease_capable else None
+        if store is not None:
+            from .kv import scheduler_registry
+
+            try:
+                reg = scheduler_registry(store,
+                                         self.config.fleet_registry_stale_s)
+            except Exception:  # noqa: BLE001 — fall back to local-only
+                log.exception("shard registry read failed")
+                reg = {}
+            for sid in sorted(reg):
+                if sid == self.scheduler_id:
+                    continue
+                obj = reg[sid]
+                sample = obj.get("sample") or {}
+                shards.append({"scheduler_id": sid,
+                               "endpoint": obj.get("endpoint", ""),
+                               **{k: sample.get(k, 0)
+                                  for k in self._REGISTRY_KEYS}})
+        # flow is per-shard (each shard owns distinct jobs) so it sums;
+        # capacity is the SHARED executor pool seen by every shard through
+        # the common KV (executors multi-register), so summing would
+        # multiply it by the shard count — take the freshest full view
+        out = {k: sum(s.get(k, 0) for s in shards)
+               for k in ("pending_tasks", "active_jobs",
+                         "admission_queue_depth")}
+        out.update({k: max(s.get(k, 0) for s in shards)
+                    for k in ("total_slots", "available_slots",
+                              "executors_alive")})
+        total, avail = out["total_slots"], out["available_slots"]
+        out["utilization"] = round((total - avail) / total, 4) if total else 0.0
+        # slots needed for everything runnable now, in executor units at
+        # the fleet's current mean slots-per-executor
+        backlog = out["pending_tasks"] + out["admission_queue_depth"] \
+            + (total - avail)
+        per_exec = max(1.0, total / max(1, out["executors_alive"]))
+        out["desired_executors"] = int(-(-backlog // per_exec))
+        out["inflight_tasks"] = out["pending_tasks"]  # /api/scaler parity
+        out["shards"] = shards
+        return out
 
     def _history_loop(self) -> None:
         """Sampler thread: appends a cluster sample to the ring buffer and

@@ -144,3 +144,20 @@ class JobStatus:
     # failed + retriable: the failure is transient back-pressure (admission
     # queue full / timed out) — clients should back off and resubmit
     retriable: bool = False
+
+
+@dataclasses.dataclass
+class JobLease:
+    """A scheduler shard's ownership claim on a job, stored in the shared
+    KV (scheduler/kv.py JOB_LOCKS keyspace).  The epoch is the fencing
+    token: it increments on every ownership change, and every fenced job
+    write is guarded on (owner, epoch) — a partitioned ex-owner whose
+    lease was adopted holds a stale epoch and cannot write job state
+    (parity: the reference's etcd lease + sled lock in cluster/kv.rs
+    try_acquire_job, hardened with epoch fencing)."""
+
+    job_id: str
+    owner: str = ""      # scheduler_id of the lease holder
+    epoch: int = 0       # bumps on every ownership change, never on renewal
+    ts: float = 0.0      # last acquire/renew time (unix seconds)
+    endpoint: str = ""   # "host:port" the owner serves clients on

@@ -30,6 +30,7 @@ from .scheduler.types import (
     ExecutorMetadata,
     ExecutorReservation,
     FailedReason,
+    JobLease,
     JobStatus,
     TaskDescription,
     TaskId,
@@ -653,6 +654,18 @@ def job_status_from_obj(o: dict) -> JobStatus:
         o.get("retriable", False))
 
 
+def job_lease_to_obj(l: JobLease) -> dict:
+    return vars(l)
+
+
+def job_lease_from_obj(o: dict) -> JobLease:
+    # pre-epoch lock values ({"owner","ts"}) decode with epoch 0 so a
+    # rolling upgrade of the fleet can adopt jobs locked by old shards
+    return JobLease(o.get("job_id", ""), o.get("owner", ""),
+                    int(o.get("epoch", 0)), float(o.get("ts", 0.0)),
+                    o.get("endpoint", ""))
+
+
 # Every control-plane dataclass that crosses a process boundary, with its
 # to/from pair.  The serde-completeness lint checks membership statically;
 # tests/test_serde_wire.py round-trips every entry with representative
@@ -670,4 +683,5 @@ WIRE_TYPES = {
     ExecutorReservation: (executor_reservation_to_obj,
                           executor_reservation_from_obj),
     JobStatus: (job_status_to_obj, job_status_from_obj),
+    JobLease: (job_lease_to_obj, job_lease_from_obj),
 }

@@ -104,6 +104,13 @@ RESULT_CACHE_MAX_ENTRIES = "ballista.result.cache.max.entries"
 RESULT_CACHE_MAX_BYTES = "ballista.result.cache.max.bytes"
 RESULT_CACHE_MAX_ENTRY_BYTES = "ballista.result.cache.max.entry.bytes"
 RESULT_CACHE_SUBPLAN = "ballista.result.cache.subplan.enabled"
+# scheduler fleet HA (scheduler/kv.py + scheduler/scheduler.py): lease-based
+# job ownership in the shared KV, adoption of dead shards' jobs, and the
+# cross-shard registry behind client failover + /api/autoscale
+FLEET_LEASE_TTL_S = "ballista.fleet.lease.ttl.seconds"
+FLEET_LEASE_RENEW_S = "ballista.fleet.lease.renew.seconds"
+FLEET_ADOPT_INTERVAL_S = "ballista.fleet.adopt.interval.seconds"
+FLEET_REGISTRY_STALE_S = "ballista.fleet.registry.stale.seconds"
 
 
 @dataclasses.dataclass
@@ -452,6 +459,21 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "matching stages of later submissions from the cached "
                     "bytes (in-process/shared-filesystem deployments only; "
                     "budget shared with the result cache)"),
+        ConfigEntry(FLEET_LEASE_TTL_S, 15.0, float,
+                    "TTL of a scheduler shard's job-ownership lease in the "
+                    "shared KV; a shard that stops renewing for longer than "
+                    "this has its jobs adopted by a surviving shard"),
+        ConfigEntry(FLEET_LEASE_RENEW_S, 0.0, float,
+                    "interval between lease renewals from the shard's lease "
+                    "heartbeat thread; 0 = ttl/3"),
+        ConfigEntry(FLEET_ADOPT_INTERVAL_S, 2.0, float,
+                    "how often a shard scans the shared KV for expired "
+                    "leases to adopt (only shards with a KV-backed job "
+                    "state run the scanner)"),
+        ConfigEntry(FLEET_REGISTRY_STALE_S, 30.0, float,
+                    "shard-registry entries older than this are ignored "
+                    "when aggregating the /api/autoscale signal and when "
+                    "re-resolving a job's owner for client failover"),
     ]
 }
 

@@ -32,6 +32,12 @@ CLI:
     python -m benchmarks.serving --smoke         # 8 sessions x q6: asserts
                                                  # zero errors + plan-cache
                                                  # hits > 0, exit 1 on fail
+    python -m benchmarks.serving --shards 2      # fleet benchmark: single vs
+                                                 # 2-shard aggregate QPS plus
+                                                 # a mid-leg shard-kill
+                                                 # failover leg
+    python -m benchmarks.serving --smoke --shards 2   # fleet + failover
+                                                      # smoke gate
 """
 from __future__ import annotations
 
@@ -97,10 +103,34 @@ def _quantile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+#: fleet-mode timings for benchmark legs: a killed shard's jobs must be
+#: adopted within ~2 s so a failover leg resolves inside the measured wall;
+#: the short RPC retry deadline is what bounds reporter/client failover —
+#: with the defaults one dead-shard round burns ~30 s before rerouting
+_FLEET_TIMINGS = {
+    "ballista.fleet.lease.ttl.seconds": "1.5",
+    "ballista.fleet.lease.renew.seconds": "0.4",
+    "ballista.fleet.adopt.interval.seconds": "0.4",
+    "ballista.fleet.registry.stale.seconds": "5.0",
+    "ballista.rpc.connect.timeout.seconds": "1.0",
+    "ballista.rpc.read.timeout.seconds": "10.0",
+    "ballista.rpc.retry.base.seconds": "0.05",
+    "ballista.rpc.retry.cap.seconds": "0.2",
+    "ballista.rpc.retry.deadline.seconds": "1.5",
+}
+
+
 def _run_leg(label: str, data_dir: str, sessions: int,
              queries_per_session: int, pool: List[str],
              overrides: Dict[str, str], executors: int = 2,
-             concurrent_tasks: int = 4) -> Dict:
+             concurrent_tasks: int = 4, shards: int = 1,
+             kill_shard_after_s: Optional[float] = None) -> Dict:
+    """One serving leg.  ``shards > 1`` runs a scheduler FLEET behind a
+    shared KV (lease-owned jobs, shared slot accounting): sessions spread
+    their sticky primaries round-robin and QPS aggregates the fleet.
+    ``kill_shard_after_s`` arms the failover leg: shard 0 is crash-killed
+    mid-leg and its sessions must fail over (lease adoption + client
+    endpoint rotation) with zero errors."""
     from arrow_ballista_tpu.catalog import ParquetTable
     from arrow_ballista_tpu.client.context import BallistaContext
     from arrow_ballista_tpu.executor.server import ExecutorServer
@@ -109,59 +139,89 @@ def _run_leg(label: str, data_dir: str, sessions: int,
     from benchmarks.schema import TABLES
 
     conf = {"ballista.shuffle.partitions": "2", **overrides}
+    fleet = shards > 1
+    kv = None
+    if fleet:
+        from arrow_ballista_tpu.scheduler.kv import MemoryKv
+        from arrow_ballista_tpu.scheduler.kv_remote import KvServer
+
+        conf.update(_FLEET_TIMINGS)
+        kv = KvServer(MemoryKv(), "127.0.0.1", 0)
+        kv.start()
     tmp = tempfile.mkdtemp(prefix=f"serving-{label}-")
-    svc = SchedulerNetService("127.0.0.1", 0, config=BallistaConfig(dict(conf)))
-    svc.start()
-    sched = svc.server
+    svcs = []
+    for _ in range(shards):
+        svc = SchedulerNetService(
+            "127.0.0.1", 0, config=BallistaConfig(dict(conf)),
+            cluster_url=f"kv://{kv.host}:{kv.port}" if fleet else None)
+        svc.start()
+        svcs.append(svc)
+    eps = [("127.0.0.1", s.port) for s in svcs]
 
-    # raw queue-to-launch samples: shadow record_submitted on the metrics
-    # instance (queued_at -> graph submitted, ms); appends are atomic
+    # raw queue-to-launch samples across every shard: shadow
+    # record_submitted on each metrics instance (queued_at -> graph
+    # submitted, ms); appends are atomic
     q2l_ms: List[float] = []
-    _orig_submitted = sched.metrics.record_submitted
+    for s in svcs:
+        _orig_submitted = s.server.metrics.record_submitted
 
-    def _rec_submitted(job_id, queued_at_ms, submitted_at_ms):
-        q2l_ms.append(max(0.0, submitted_at_ms - queued_at_ms))
-        _orig_submitted(job_id, queued_at_ms, submitted_at_ms)
+        def _rec_submitted(job_id, queued_at_ms, submitted_at_ms,
+                           _orig=_orig_submitted):
+            q2l_ms.append(max(0.0, submitted_at_ms - queued_at_ms))
+            _orig(job_id, queued_at_ms, submitted_at_ms)
 
-    sched.metrics.record_submitted = _rec_submitted
+        s.server.metrics.record_submitted = _rec_submitted
 
     exs = []
     result: Dict = {"label": label, "sessions": sessions,
-                    "queries_per_session": queries_per_session}
+                    "queries_per_session": queries_per_session,
+                    "shards": shards}
     try:
         for i in range(executors):
             work = os.path.join(tmp, f"exec{i}")
             os.makedirs(work)
-            ex = ExecutorServer("127.0.0.1", svc.port, "127.0.0.1", 0,
+            ex = ExecutorServer("127.0.0.1", eps[i % shards][1],
+                                "127.0.0.1", 0,
                                 work_dir=work,
                                 concurrent_tasks=concurrent_tasks,
                                 executor_id=f"serving-{label}-{i}",
-                                config=BallistaConfig(dict(conf)))
+                                config=BallistaConfig(dict(conf)),
+                                scheduler_endpoints=eps if fleet else None)
             ex.start()
             exs.append(ex)
 
-        # shared catalog: register once, sessions resolve the same
-        # providers (and therefore share plan templates on the on-leg)
-        for name in TABLES:
-            path = os.path.join(data_dir, f"{name}.parquet")
-            if not os.path.exists(path):
-                path = os.path.join(data_dir, name)
-            svc.catalog.register(ParquetTable(name, path))
+        # shared catalog: register once PER SHARD, sessions resolve the
+        # same providers (and therefore share plan templates on the on-leg)
+        for svc in svcs:
+            for name in TABLES:
+                path = os.path.join(data_dir, f"{name}.parquet")
+                if not os.path.exists(path):
+                    path = os.path.join(data_dir, name)
+                svc.catalog.register(ParquetTable(name, path))
 
-        # warmup: every distinct query once (XLA compiles, scan caches;
-        # on the on-leg this also seeds the plan/result caches — the
-        # timed phase measures the steady serving state)
-        warm = BallistaContext.remote("127.0.0.1", svc.port,
-                                      BallistaConfig(dict(conf)))
-        try:
-            for sql in pool:
-                warm.sql(sql).collect()
-        finally:
-            warm.shutdown()
+        # warmup: every distinct query once per shard (XLA compiles, scan
+        # caches; on the on-leg this also seeds each shard's plan/result
+        # caches — the timed phase measures the steady serving state)
+        for svc in svcs:
+            warm = BallistaContext.remote("127.0.0.1", svc.port,
+                                          BallistaConfig(dict(conf)))
+            try:
+                for sql in pool:
+                    warm.sql(sql).collect()
+            finally:
+                warm.shutdown()
 
-        ctxs = [BallistaContext.remote("127.0.0.1", svc.port,
-                                       BallistaConfig(dict(conf)))
-                for _ in range(sessions)]
+        # fleet: session i's endpoint list starts at shard i%N — sticky
+        # primaries spread round-robin, failover order wraps the ring
+        if fleet:
+            ctxs = [BallistaContext.remote(
+                        config=BallistaConfig(dict(conf)),
+                        endpoints=eps[i % shards:] + eps[:i % shards])
+                    for i in range(sessions)]
+        else:
+            ctxs = [BallistaContext.remote("127.0.0.1", svcs[0].port,
+                                           BallistaConfig(dict(conf)))
+                    for _ in range(sessions)]
         e2e_ms: List[float] = []
         errors: List[str] = []
         lock = threading.Lock()
@@ -198,6 +258,19 @@ def _run_leg(label: str, data_dir: str, sessions: int,
             t.start()
         t_wall = time.perf_counter()
         start_gate.set()
+        if kill_shard_after_s is not None and fleet:
+            # crash-kill shard 0 mid-leg: no lease release, no registry
+            # withdrawal, established conns severed — its sessions must
+            # complete via lease adoption + client endpoint rotation
+            def _kill_shard():
+                time.sleep(kill_shard_after_s)
+                svcs[0].kill()
+
+            threading.Thread(target=_kill_shard,
+                             name="serving-shard-killer",
+                             daemon=True).start()
+            result["killed_shard"] = 0
+            result["kill_after_s"] = kill_shard_after_s
         for t in threads:
             t.join()
         wall = time.perf_counter() - t_wall
@@ -207,9 +280,21 @@ def _run_leg(label: str, data_dir: str, sessions: int,
         total = sessions * queries_per_session
         e2e = sorted(e2e_ms)
         q2l = sorted(q2l_ms[q2l_before:])
-        loop = sched._event_loop.stats()
-        pc = sched.plan_cache.snapshot()
-        rc = sched.result_cache.snapshot()
+        loop_lag = 0.0
+        pc = {"hits": 0, "misses": 0}
+        rc = {"hits": 0, "subplan_hits": 0, "misses": 0, "entries": 0}
+        for s in svcs:
+            try:
+                stats = s.server._event_loop.stats()
+                p = s.server.plan_cache.snapshot()
+                r = s.server.result_cache.snapshot()
+            except Exception:  # noqa: BLE001 — killed shard: best-effort
+                continue
+            loop_lag = max(loop_lag, stats.get("max_lag_s", 0.0))
+            pc["hits"] += p["hits"]
+            pc["misses"] += p["misses"]
+            for k in rc:
+                rc[k] += r[k]
         result.update({
             "queries": total,
             "ok": len(e2e_ms),
@@ -222,7 +307,7 @@ def _run_leg(label: str, data_dir: str, sessions: int,
             "queue_to_launch_p50_ms": round(_quantile(q2l, 0.50), 2),
             "queue_to_launch_p99_ms": round(_quantile(q2l, 0.99), 2),
             "planned_submissions": len(q2l),
-            "event_loop_max_lag_s": loop.get("max_lag_s", 0.0),
+            "event_loop_max_lag_s": loop_lag,
             "plan_cache": {"hits": pc["hits"], "misses": pc["misses"],
                            "hit_rate": round(
                                pc["hits"] / max(1, pc["hits"] + pc["misses"]),
@@ -236,7 +321,13 @@ def _run_leg(label: str, data_dir: str, sessions: int,
     finally:
         for ex in exs:
             ex.stop(notify=False)
-        svc.stop()
+        for s in svcs:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 — failover leg's killed shard
+                pass
+        if kv is not None:
+            kv.stop()
         shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -267,16 +358,80 @@ def run_serving_benchmark(data_dir: Optional[str] = None, scale: float = 0.01,
     return out
 
 
-def run_smoke(sessions: int = 8, queries_per_session: int = 6) -> Dict:
+def run_fleet_benchmark(data_dir: Optional[str] = None, scale: float = 0.01,
+                        sessions: int = 32, queries_per_session: int = 8,
+                        shapes: Tuple[str, ...] = ("q6", "q1"),
+                        shards: int = 2, executors: int = 2,
+                        concurrent_tasks: int = 4) -> Dict:
+    """Fleet A/B + failover: the same workload against one shard, then an
+    N-shard fleet behind a shared KV (aggregate QPS must hold the
+    single-shard line), then the fleet again with shard 0 crash-killed
+    mid-leg — every in-flight session must complete with zero errors via
+    lease adoption + client endpoint rotation.  The failover leg runs with
+    the result cache OFF so every query is a real job and the kill lands
+    on in-flight work, not on cache hits."""
+    data_dir = ensure_data(scale, data_dir)
+    pool = build_workload(shapes)
+    caches_on = {"ballista.plan.cache.enabled": "true",
+                 "ballista.result.cache.enabled": "true"}
+    single = _run_leg(
+        "fleet-single", data_dir, sessions, queries_per_session, pool,
+        dict(caches_on), executors=executors,
+        concurrent_tasks=concurrent_tasks)
+    fleet = _run_leg(
+        f"fleet-{shards}shard", data_dir, sessions, queries_per_session,
+        pool, dict(caches_on), executors=executors,
+        concurrent_tasks=concurrent_tasks, shards=shards)
+    failover = _run_leg(
+        f"fleet-{shards}shard-failover", data_dir, sessions,
+        queries_per_session, pool,
+        {"ballista.plan.cache.enabled": "true",
+         "ballista.result.cache.enabled": "false"},
+        executors=executors, concurrent_tasks=concurrent_tasks,
+        shards=shards, kill_shard_after_s=0.5)
+    out = {"scale": scale, "sessions": sessions,
+           "queries_per_session": queries_per_session, "shards": shards,
+           "single": single, "fleet": fleet, "failover": failover}
+    if single.get("qps"):
+        out["qps_fleet_over_single"] = round(fleet["qps"] / single["qps"], 2)
+    out["fleet_pass"] = (fleet["errors"] == 0
+                         and fleet["ok"] == fleet["queries"]
+                         and failover["errors"] == 0
+                         and failover["ok"] == failover["queries"]
+                         and fleet["qps"] >= single["qps"])
+    return out
+
+
+def run_smoke(sessions: int = 8, queries_per_session: int = 6,
+              shards: int = 1) -> Dict:
     """The run_checks.sh gate: N sessions of repeated q6 variants with the
-    caches on; zero errors and a nonzero plan-cache hit rate required."""
+    caches on; zero errors and a nonzero plan-cache hit rate required.
+    With ``shards > 1`` the leg runs against a shared-KV scheduler fleet
+    and a second failover leg crash-kills shard 0 mid-run — both legs must
+    complete every query with zero errors."""
     data_dir = ensure_data(0.01)
     pool = build_workload(("q6",))
+    caches_on = {"ballista.plan.cache.enabled": "true",
+                 "ballista.result.cache.enabled": "true"}
+    if shards > 1:
+        fleet = _run_leg(
+            "smoke-fleet", data_dir, sessions, queries_per_session, pool,
+            dict(caches_on), executors=2, concurrent_tasks=4, shards=shards)
+        failover = _run_leg(
+            "smoke-failover", data_dir, sessions, queries_per_session, pool,
+            {"ballista.plan.cache.enabled": "true",
+             "ballista.result.cache.enabled": "false"},
+            executors=2, concurrent_tasks=4, shards=shards,
+            kill_shard_after_s=0.4)
+        ok = (fleet["errors"] == 0 and fleet["ok"] == fleet["queries"]
+              and fleet["plan_cache"]["hits"] > 0
+              and failover["errors"] == 0
+              and failover["ok"] == failover["queries"])
+        return {"shards": shards, "fleet": fleet, "failover": failover,
+                "smoke_pass": ok}
     leg = _run_leg(
         "smoke", data_dir, sessions, queries_per_session, pool,
-        {"ballista.plan.cache.enabled": "true",
-         "ballista.result.cache.enabled": "true"},
-        executors=1, concurrent_tasks=4)
+        dict(caches_on), executors=1, concurrent_tasks=4)
     ok = (leg["errors"] == 0 and leg["ok"] == leg["queries"]
           and leg["plan_cache"]["hits"] > 0)
     leg["smoke_pass"] = ok
@@ -293,9 +448,14 @@ def main() -> None:
     ap.add_argument("--data", default=None, help="TPC-H data dir "
                     "(default .bench_data/tpch-sf<scale>, generated)")
     ap.add_argument("--executors", type=int, default=2)
+    ap.add_argument("--shards", type=int, default=1,
+                    help="scheduler fleet size; >1 switches to the fleet "
+                    "benchmark (single vs N-shard aggregate QPS) plus a "
+                    "mid-leg shard-kill failover leg")
     ap.add_argument("--smoke", action="store_true",
                     help="run_checks gate: q6-only, assert zero errors + "
-                    "plan-cache hits, exit 1 on failure")
+                    "plan-cache hits, exit 1 on failure; with --shards 2 "
+                    "also runs the fleet + failover smoke legs")
     args = ap.parse_args()
 
     # BALLISTA_LOCK_ORDER_RUNTIME=1: record every package lock acquisition
@@ -319,7 +479,8 @@ def main() -> None:
 
     if args.smoke:
         leg = run_smoke(sessions=args.sessions or 8,
-                        queries_per_session=args.queries or 6)
+                        queries_per_session=args.queries or 6,
+                        shards=args.shards)
         print(json.dumps(leg, indent=2))
         if not leg["smoke_pass"]:
             print("serving smoke FAILED", file=sys.stderr)
@@ -328,11 +489,18 @@ def main() -> None:
         print("serving smoke passed", file=sys.stderr)
         return
 
-    out = run_serving_benchmark(
-        data_dir=args.data, scale=args.scale,
-        sessions=args.sessions or 64,
-        queries_per_session=args.queries or 8,
-        executors=args.executors)
+    if args.shards > 1:
+        out = run_fleet_benchmark(
+            data_dir=args.data, scale=args.scale,
+            sessions=args.sessions or 32,
+            queries_per_session=args.queries or 8,
+            shards=args.shards, executors=args.executors)
+    else:
+        out = run_serving_benchmark(
+            data_dir=args.data, scale=args.scale,
+            sessions=args.sessions or 64,
+            queries_per_session=args.queries or 8,
+            executors=args.executors)
     print(json.dumps(out, indent=2))
     _validate_lock_order()
 

@@ -1,0 +1,487 @@
+"""Scheduler fleet HA: lease-based ownership, adoption, and failover.
+
+Chaos suite for the multi-shard scheduler fleet (ISSUE 11): N schedulers
+share one KV (cluster state + job checkpoints + TTL job leases), executors
+multi-register and route statuses to the launching shard, and clients hold
+an ordered endpoint list with transparent failover.  Scenarios:
+
+- two live shards serve one client with shared cluster state;
+- a shard killed mid-job (in-process kill() == kill -9, and a REAL
+  SIGKILL'd subprocess shard) has its jobs adopted by a survivor, which
+  resumes from the last checkpoint and drives to a bit-identical result;
+- a partitioned shard that stops renewing (``scheduler.lease.renew``
+  failpoint) is fenced out by the adopter's epoch bump — no double-drive;
+- adoption racing completion (``scheduler.adopt.before_resume`` delay)
+  releases the claim instead of re-driving a finished job;
+- a non-owning shard redirects status polls to the lease owner and serves
+  terminal results straight from the checkpoint.
+
+All timings are scaled down (TTL 1.5 s, renew 0.4 s, adopt scan 0.4 s) so
+every scenario resolves in seconds.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import faults
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# --------------------------------------------------------------------------
+# fleet harness: shared KvServer + N scheduler shards + executors + client
+# --------------------------------------------------------------------------
+
+FLEET_CONF = {
+    "ballista.shuffle.partitions": "4",
+    # fast-failure RPC policy so failover scenarios stay seconds-long
+    "ballista.rpc.connect.timeout.seconds": "1.0",
+    "ballista.rpc.read.timeout.seconds": "10.0",
+    "ballista.rpc.retry.base.seconds": "0.05",
+    "ballista.rpc.retry.cap.seconds": "0.2",
+    "ballista.rpc.retry.deadline.seconds": "1.5",
+    "ballista.shuffle.local.host_match": "false",
+    # scaled-down fleet timings: a dead shard's jobs must be adopted
+    # within ~2 s (TTL 1.5 s + one 0.4 s adoption scan)
+    "ballista.fleet.lease.ttl.seconds": "1.5",
+    "ballista.fleet.lease.renew.seconds": "0.4",
+    "ballista.fleet.adopt.interval.seconds": "0.4",
+    "ballista.fleet.registry.stale.seconds": "5.0",
+}
+
+SQL = "select g, sum(v) as s, count(*) as n from t group by g order by g"
+
+
+def _sched_config(adopt_interval_s=0.4):
+    from arrow_ballista_tpu.scheduler.scheduler import SchedulerConfig
+
+    return SchedulerConfig(task_distribution="round-robin",
+                           executor_timeout_s=3.0,
+                           reaper_interval_s=0.3,
+                           fleet_lease_ttl_s=1.5,
+                           fleet_lease_renew_s=0.4,
+                           fleet_adopt_interval_s=adopt_interval_s,
+                           fleet_registry_stale_s=5.0)
+
+
+def _make_fleet(tmp_path, n_shards=2, n_executors=2, concurrent_tasks=4,
+                adopt_interval_s=0.4):
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.kv import MemoryKv
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    kv = KvServer(MemoryKv(), "127.0.0.1", 0)
+    kv.start()
+    url = f"kv://{kv.host}:{kv.port}"
+    shards = []
+    for _ in range(n_shards):
+        s = SchedulerNetService("127.0.0.1", 0,
+                                config=BallistaConfig(FLEET_CONF),
+                                scheduler_config=_sched_config(adopt_interval_s),
+                                cluster_url=url)
+        s.start()
+        shards.append(s)
+    eps = [("127.0.0.1", s.port) for s in shards]
+    executors = []
+    for i in range(n_executors):
+        work = tmp_path / f"exec{i}"
+        work.mkdir()
+        ex = ExecutorServer("127.0.0.1", eps[0][1], "127.0.0.1", 0,
+                            work_dir=str(work),
+                            concurrent_tasks=concurrent_tasks,
+                            executor_id=f"fleet-exec-{i}",
+                            config=BallistaConfig(FLEET_CONF),
+                            heartbeat_interval_s=0.4,
+                            scheduler_endpoints=eps)
+        ex.start()
+        executors.append(ex)
+    return kv, shards, executors
+
+
+def _teardown_fleet(kv, shards, executors):
+    for ex in executors:
+        try:
+            ex.stop(notify=False)
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+    for s in shards:
+        try:
+            s.stop()  # idempotent after kill(): shutdown/stop re-run clean
+        except Exception:  # noqa: BLE001
+            pass
+    try:
+        kv.stop()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _fleet_client(eps, n=8000, groups=7, seed=11):
+    from arrow_ballista_tpu.client.context import BallistaContext
+
+    c = BallistaContext.remote(config=BallistaConfig(FLEET_CONF),
+                               endpoints=eps)
+    rng = np.random.default_rng(seed)
+    c.register_table("t", pa.table({
+        "g": pa.array(rng.integers(0, groups, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+    }))
+    return c
+
+
+def _frames_equal(got, expected):
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  expected.reset_index(drop=True),
+                                  check_dtype=False)
+
+
+def _wait_for(pred, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+class _AsyncQuery(threading.Thread):
+    """Run one SQL query off-thread so the test can kill shards mid-job."""
+
+    def __init__(self, ctx, sql):
+        super().__init__(name="fleet-query", daemon=True)
+        self.ctx, self.sql = ctx, sql
+        self.result = None
+        self.error = None
+
+    def run(self):
+        try:
+            self.result = self.ctx.sql(self.sql).to_pandas()
+        except Exception as e:  # noqa: BLE001 — asserted by the test
+            self.error = e
+
+
+# --------------------------------------------------------------------------
+# scenario 1: two shards, shared state, fleet-wide registry + autoscale
+# --------------------------------------------------------------------------
+
+def test_two_shard_fleet_serves_and_aggregates_registry(tmp_path):
+    kv, shards, executors = _make_fleet(tmp_path)
+    try:
+        c = _fleet_client([("127.0.0.1", s.port) for s in shards])
+        got = c.sql(SQL).to_pandas()
+        again = c.sql(SQL).to_pandas()
+        _frames_equal(got, again)
+
+        # the lease loop publishes each shard into the shared registry;
+        # after that, ANY shard's autoscale signal covers the whole fleet
+        _wait_for(
+            lambda: len(shards[0].server.autoscale_signal()["shards"]) == 2,
+            5.0, "both shards should appear in the shared registry")
+        for s in shards:
+            sig = s.server.autoscale_signal()
+            assert {x["scheduler_id"] for x in sig["shards"]} == \
+                {sh.server.scheduler_id for sh in shards}
+            assert all(x["endpoint"] for x in sig["shards"])
+            assert sig["total_slots"] > 0
+        c.shutdown()
+    finally:
+        _teardown_fleet(kv, shards, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 2: shard killed mid-job -> survivor adopts -> bit-identical
+# --------------------------------------------------------------------------
+
+def test_shard_killed_mid_job_survivor_adopts(tmp_path):
+    kv, shards, executors = _make_fleet(tmp_path, concurrent_tasks=1)
+    try:
+        eps = [("127.0.0.1", s.port) for s in shards]
+        c = _fleet_client(eps)
+        baseline = c.sql(SQL).to_pandas()
+
+        # stretch every task so the kill lands mid-job
+        plan = faults.FaultPlan.from_obj({"seed": 5, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 400, "times": -1}]})
+        with faults.use_plan(plan):
+            q = _AsyncQuery(c, SQL)
+            q.start()
+            _wait_for(lambda: shards[0].server._leases, 10.0,
+                      "primary shard should claim the job lease at submit")
+            job_id = next(iter(shards[0].server._leases))
+            # in-process kill -9: no lease release, no registry goodbye
+            shards[0].kill()
+            q.join(timeout=60.0)
+
+        assert not q.is_alive(), "query never finished after the failover"
+        assert q.error is None, f"query failed across failover: {q.error}"
+        _frames_equal(q.result, baseline)
+        # the survivor adopted and drove the job to terminal
+        status = shards[1].server.jobs.get_status(job_id)
+        assert status is not None and status.state == "successful"
+        c.shutdown()
+    finally:
+        _teardown_fleet(kv, shards, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 3: partition (renewals suppressed) -> epoch fencing, one driver
+# --------------------------------------------------------------------------
+
+def test_partitioned_shard_is_fenced_no_double_drive(tmp_path):
+    kv, shards, executors = _make_fleet(tmp_path, concurrent_tasks=1)
+    try:
+        eps = [("127.0.0.1", s.port) for s in shards]
+        c = _fleet_client(eps)
+        baseline = c.sql(SQL).to_pandas()
+
+        a = shards[0].server
+        b = shards[1].server
+        plan = faults.FaultPlan.from_obj({"seed": 9, "rules": [
+            # shard A stops renewing but keeps driving: simulated partition
+            {"site": "scheduler.lease.renew", "action": "raise",
+             "error": "timeout", "message": "injected partition",
+             "match": {"scheduler_id": a.scheduler_id}, "times": -1},
+            {"site": "executor.task.slow", "action": "delay",
+             "delay_ms": 800, "times": -1},
+        ]})
+        with faults.use_plan(plan):
+            q = _AsyncQuery(c, SQL)
+            q.start()
+            _wait_for(lambda: a._leases, 10.0,
+                      "partitioned shard should claim the lease at submit")
+            job_id = next(iter(a._leases))
+            # lease expires unrenewed -> the survivor adopts it
+            _wait_for(lambda: b.jobs.get_status(job_id) is not None, 15.0,
+                      "survivor should adopt the partitioned shard's job")
+            lease = b.job_backend.get_lease(job_id)
+            if lease is not None:  # None == already completed and released
+                assert lease.owner == b.scheduler_id
+                assert lease.epoch >= 2, "takeover must bump the fencing epoch"
+            # the ex-owner's next fenced checkpoint raises LeaseLost and it
+            # abandons its local drive — that is the no-double-drive proof
+            _wait_for(lambda: a.jobs.get_status(job_id) is None, 20.0,
+                      "fenced ex-owner must abandon its local drive")
+            q.join(timeout=90.0)
+
+        assert not q.is_alive(), "query never finished after the partition"
+        assert q.error is None, f"query failed across the partition: {q.error}"
+        _frames_equal(q.result, baseline)
+        status = b.jobs.get_status(job_id)
+        assert status is not None and status.state == "successful"
+        c.shutdown()
+    finally:
+        _teardown_fleet(kv, shards, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 4: adoption racing completion -> claim released, no re-drive
+# --------------------------------------------------------------------------
+
+def test_adoption_skips_job_that_already_completed(tmp_path):
+    from arrow_ballista_tpu.scheduler.kv import JOB_LOCKS
+
+    # adoption scans effectively disabled (60 s): the race is staged by hand
+    kv, shards, executors = _make_fleet(tmp_path, adopt_interval_s=60.0)
+    try:
+        eps = [("127.0.0.1", s.port) for s in shards]
+        c = _fleet_client(eps)
+        c.sql(SQL).to_pandas()  # runs on shard A; checkpoints terminal graph
+
+        backend = shards[1].server.job_backend
+        [job_id] = backend.list_jobs()
+        assert backend.get_lease(job_id) is None, \
+            "completion must release the job lease"
+
+        # ghost owner that died right after finishing the job but before
+        # releasing: expired lease + terminal checkpoint
+        backend.store.put(JOB_LOCKS, job_id, json.dumps(
+            {"owner": "ghost-shard", "epoch": 7,
+             "ts": time.time() - 60.0, "endpoint": "127.0.0.1:1"}))
+        plan = faults.FaultPlan.from_obj({"seed": 2, "rules": [{
+            "site": "scheduler.adopt.before_resume", "action": "delay",
+            "delay_ms": 150, "match": {"job_id": job_id}, "times": 1}]})
+        with faults.use_plan(plan):
+            adopted = shards[1].server.adopt_expired_jobs()
+
+        assert adopted == []
+        assert plan.schedule() == \
+            (("scheduler.adopt.before_resume", 0, 1, "delay"),)
+        # the claim was dropped, not left dangling as an expired lease,
+        # and the finished job was NOT re-driven
+        assert backend.get_lease(job_id) is None
+        assert shards[1].server.jobs.get_status(job_id) is None
+        c.shutdown()
+    finally:
+        _teardown_fleet(kv, shards, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 5: non-owning shard redirects polls / serves terminal checkpoints
+# --------------------------------------------------------------------------
+
+def test_foreign_status_redirect_and_terminal_serve(tmp_path):
+    from arrow_ballista_tpu.net import wire
+
+    kv, shards, executors = _make_fleet(tmp_path, concurrent_tasks=1)
+    try:
+        eps = [("127.0.0.1", s.port) for s in shards]
+        c = _fleet_client(eps)
+        a = shards[0].server
+
+        plan = faults.FaultPlan.from_obj({"seed": 4, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 400, "times": -1}]})
+        with faults.use_plan(plan):
+            q = _AsyncQuery(c, SQL)
+            q.start()
+            _wait_for(lambda: a._leases, 10.0,
+                      "owner shard should claim the lease at submit")
+            job_id = next(iter(a._leases))
+            # while the job runs on A, B redirects to the lease owner
+            payload, _ = wire.call("127.0.0.1", shards[1].port,
+                                   "get_job_status", {"job_id": job_id})
+            assert payload["state"] == "not_found"
+            assert payload["owner"] == a.scheduler_id
+            assert payload["endpoint"] == f"127.0.0.1:{shards[0].port}"
+            q.join(timeout=60.0)
+        assert q.error is None, f"query failed: {q.error}"
+
+        # after completion the lease is gone; B serves the status (with
+        # result locations + schema) straight from the shared checkpoint
+        payload, _ = wire.call("127.0.0.1", shards[1].port,
+                               "get_job_status", {"job_id": job_id})
+        assert payload["state"] == "successful"
+        assert payload["locations"], "terminal serve must carry locations"
+        assert payload["schema"], "terminal serve must carry the schema"
+        c.shutdown()
+    finally:
+        _teardown_fleet(kv, shards, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario 6: REAL process kill (SIGKILL) of a shard -> live failover
+# --------------------------------------------------------------------------
+
+_CHILD_SHARD_SRC = """
+import json, sys, threading
+from arrow_ballista_tpu.utils.config import BallistaConfig
+from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+from arrow_ballista_tpu.scheduler.scheduler import SchedulerConfig
+
+conf = json.loads(sys.argv[1])
+s = SchedulerNetService(
+    "127.0.0.1", 0, config=BallistaConfig(conf),
+    scheduler_config=SchedulerConfig(
+        task_distribution="round-robin", executor_timeout_s=3.0,
+        reaper_interval_s=0.3, fleet_lease_ttl_s=1.5,
+        fleet_lease_renew_s=0.4, fleet_adopt_interval_s=0.4,
+        fleet_registry_stale_s=5.0),
+    cluster_url=sys.argv[2])
+s.start()
+print("READY", s.port, s.server.scheduler_id, flush=True)
+threading.Event().wait()
+"""
+
+
+def _spawn_child_shard(url, tmp_path, timeout=90.0):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_SHARD_SRC, json.dumps(FLEET_CONF), url],
+        stdout=subprocess.PIPE,
+        stderr=open(tmp_path / "child-shard.log", "w"),
+        text=True, env=dict(os.environ))
+    out = {}
+
+    def rd():
+        out["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=rd, daemon=True)
+    t.start()
+    t.join(timeout)
+    line = out.get("line", "")
+    if not line.startswith("READY"):
+        proc.kill()
+        raise AssertionError(f"child shard failed to start: {line!r} "
+                             f"(see {tmp_path / 'child-shard.log'})")
+    _, port, scheduler_id = line.split()
+    return proc, int(port), scheduler_id
+
+
+def test_real_process_sigkill_failover(tmp_path):
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.kv import MemoryKv
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    kv = KvServer(MemoryKv(), "127.0.0.1", 0)
+    kv.start()
+    url = f"kv://{kv.host}:{kv.port}"
+    proc, child_port, child_sid = _spawn_child_shard(url, tmp_path)
+    survivor = SchedulerNetService("127.0.0.1", 0,
+                                   config=BallistaConfig(FLEET_CONF),
+                                   scheduler_config=_sched_config(),
+                                   cluster_url=url)
+    survivor.start()
+    eps = [("127.0.0.1", child_port), ("127.0.0.1", survivor.port)]
+    executors = []
+    try:
+        for i in range(2):
+            work = tmp_path / f"exec{i}"
+            work.mkdir()
+            ex = ExecutorServer("127.0.0.1", child_port, "127.0.0.1", 0,
+                                work_dir=str(work), concurrent_tasks=1,
+                                executor_id=f"fleet-exec-{i}",
+                                config=BallistaConfig(FLEET_CONF),
+                                heartbeat_interval_s=0.4,
+                                scheduler_endpoints=eps)
+            ex.start()
+            executors.append(ex)
+        c = _fleet_client(eps)
+        baseline = c.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 6, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 400, "times": -1}]})
+        with faults.use_plan(plan):
+            q = _AsyncQuery(c, SQL)
+            q.start()
+            backend = survivor.server.job_backend
+            _wait_for(
+                lambda: any(l.owner == child_sid for l in backend.leases()),
+                15.0, "child shard should claim the job lease at submit")
+            proc.kill()  # SIGKILL: the real thing, not a simulation
+            proc.wait(timeout=10.0)
+            q.join(timeout=60.0)
+
+        assert not q.is_alive(), "query never finished after SIGKILL failover"
+        assert q.error is None, f"query failed across SIGKILL: {q.error}"
+        _frames_equal(q.result, baseline)
+        # the in-process survivor adopted the dead process's job
+        jobs = list(survivor.server.jobs._graphs)
+        assert any(
+            survivor.server.jobs.get_status(j) is not None and
+            survivor.server.jobs.get_status(j).state == "successful"
+            for j in jobs), "survivor should hold the adopted job terminal"
+        c.shutdown()
+    finally:
+        try:
+            proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
+        _teardown_fleet(kv, [survivor], executors)

@@ -362,3 +362,67 @@ def test_watch_close_wakes_blocked_iterator(store):
     w.close()
     t.join(timeout=5.0)
     assert done, "blocked watch iterator did not terminate on close()"
+
+def test_remote_watch_survives_kv_server_restart():
+    """A KvServer bounce mid-watch must not kill the watch thread: the
+    client reconnects with capped backoff, detects the head REGRESSION
+    (the fresh server's sequence restarts at 0, which the server-side
+    resync marker cannot flag — its replay log is empty), and resyncs:
+    consumers see a 'resync' marker, the snapshot as puts, then live
+    events again."""
+    from arrow_ballista_tpu.scheduler.kv import MemoryKv
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer, RemoteKv
+
+    backing = MemoryKv()
+    srv = KvServer(backing)
+    srv.start()
+    host, port = srv.host, srv.port
+    kv = RemoteKv(host, port)
+    w = kv.watch("jobs")
+    try:
+        # advance the cursor well past where the restarted server's fresh
+        # sequence will be, so the regression is unambiguous
+        for i in range(3):
+            kv.put("jobs", f"j{i}", "running")
+        assert _await_event(w, lambda e: e.op == "put" and e.key == "j2") \
+            is not None
+        # bounce: same backing store (the persistent-backing restart
+        # shape), same port, sequence counter reset to 0
+        srv.stop()
+        srv = KvServer(backing, host, port)
+        srv.start()
+        kv.put("jobs", "after", "1")
+        assert _await_event(w, lambda e: e.op == "resync", timeout=10.0) \
+            is not None, "watch did not resync after the server restart"
+        assert _await_event(w, lambda e: e.op == "put" and e.key == "after",
+                            timeout=10.0) is not None, \
+            "watch dead after the server restart"
+    finally:
+        w.close()
+        srv.stop()
+
+
+def test_remote_watch_tolerates_down_server_at_creation():
+    """Creating a watch while the KV service is down must not raise: the
+    cursor acquisition happens inside the watch loop, which attaches (and
+    primes the consumer with resync + snapshot) once the server is up."""
+    from arrow_ballista_tpu.scheduler.kv import MemoryKv
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer, RemoteKv
+
+    backing = MemoryKv()
+    srv = KvServer(backing)
+    srv.start()
+    host, port = srv.host, srv.port
+    srv.stop()
+    kv = RemoteKv(host, port)
+    w = kv.watch("jobs")  # server is down: must not throw
+    srv = KvServer(backing, host, port)
+    srv.start()
+    try:
+        kv.put("jobs", "late", "1")
+        assert _await_event(w, lambda e: e.op == "put" and e.key == "late",
+                            timeout=10.0) is not None, \
+            "watch never attached to the recovered server"
+    finally:
+        w.close()
+        srv.stop()

@@ -31,6 +31,7 @@ from arrow_ballista_tpu.scheduler.types import (
     ExecutorMetadata,
     ExecutorReservation,
     FailedReason,
+    JobLease,
     JobStatus,
     TaskDescription,
     TaskId,
@@ -120,6 +121,11 @@ SAMPLES = {
         JobStatus("job-3", "successful",
                   locations={0: [LOCATION], 3: [LOCATION, LOCATION]}),
     ],
+    JobLease: [
+        JobLease("job-1"),
+        JobLease("job-2", owner="scheduler-a1b2", epoch=7, ts=1700000000.25,
+                 endpoint="10.0.0.7:50050"),
+    ],
 }
 
 
@@ -152,7 +158,7 @@ def test_decoded_fields_match_for_value_types():
     stably — catches a to/from pair that consistently drops a field."""
     for wire_type in (TaskId, FailedReason, ShuffleWritePartition,
                       PartitionLocation, ExecutorMetadata,
-                      ExecutorReservation):
+                      ExecutorReservation, JobLease):
         to_obj, from_obj = serde.WIRE_TYPES[wire_type]
         for sample in SAMPLES[wire_type]:
             assert from_obj(json.loads(json.dumps(to_obj(sample)))) == sample

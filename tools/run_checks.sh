@@ -10,7 +10,10 @@
 #      cluster history, AQE rewrites + rollback + serde),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
-#      quarantine, straggler speculation, corrupt-shuffle checksums) —
+#      quarantine, straggler speculation, corrupt-shuffle checksums) plus
+#      the scheduler-fleet HA suite (tests/test_fleet.py: shard killed
+#      mid-job and adopted by a sibling, lease fencing under partition,
+#      adoption/completion races, real-process SIGKILL failover) —
 #      proves the fault-tolerance paths still recover.  Runs with the
 #      runtime lock-order validator on (BALLISTA_LOCK_ORDER_RUNTIME=1):
 #      every real lock acquisition is checked against the static
@@ -19,7 +22,11 @@
 #   5. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
 #      sessions of repeated q6 variants through the prepared-plan +
 #      result caches — zero errors and a nonzero plan-cache hit rate,
-#      also under the runtime lock-order validator.
+#      also under the runtime lock-order validator,
+#   6. the fleet serving smoke (--smoke --shards 2): the same workload
+#      against a 2-shard scheduler fleet behind a shared KV, then a
+#      failover leg that crash-kills shard 0 mid-run — both legs must
+#      complete every query with zero errors.
 # tests/test_static_analysis.py also runs the lint suite inside tier-1, so
 # pytest alone still gates new violations; this script is the fast
 # standalone form for CI and pre-push hooks.
@@ -40,11 +47,15 @@ python -m pytest tests/test_static_analysis.py tests/test_concurrency.py \
     tests/test_observatory.py tests/test_aqe.py \
     -q -p no:cacheprovider
 
-echo "== chaos recovery suite (-m chaos, runtime lock-order validation on) =="
+echo "== chaos recovery + fleet HA suites (-m chaos, runtime lock-order validation on) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 \
-    python -m pytest tests/test_chaos.py -q -m chaos -p no:cacheprovider
+    python -m pytest tests/test_chaos.py tests/test_fleet.py \
+    -q -m chaos -p no:cacheprovider
 
 echo "== serving smoke (8 sessions x q6, caches on, runtime lock-order validation on) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 python -m benchmarks.serving --smoke
+
+echo "== fleet serving smoke (2 shards + mid-run shard-kill failover) =="
+BALLISTA_LOCK_ORDER_RUNTIME=1 python -m benchmarks.serving --smoke --shards 2
 
 echo "all checks passed"
