@@ -33,22 +33,27 @@ PKG = "arrow_ballista_tpu"
 class HotPathPurityRule(Rule):
     """No host materialization primitives in operator hot-path modules.
 
-    ``np.asarray``/``jax.device_get``/``.block_until_ready()``/``.tolist()``
-    inside ops/kernels.py, ops/operators.py, ops/expressions.py each force a
-    device->host sync (~75 ms fixed latency per transfer on remote-attached
+    ``np.asarray``/``jax.device_get``/``jax.device_put``/
+    ``.block_until_ready()``/``.tolist()`` inside ops/kernels.py,
+    ops/operators.py, ops/expressions.py each force a device<->host
+    sync (~75 ms fixed latency per transfer on remote-attached
     TPU backends) and silently turn a fused device pipeline into a host
-    round-trip.  Deliberate host-mode paths (host UDF projection, the
-    single packed scalar fetch) carry ``# ballista: allow=hot-path-purity``
-    with a justification.
+    round-trip.  ``jax.device_put`` is additionally banned because direct
+    uploads bypass the transfer accounting in models/batch.py (the device
+    observatory would under-report h2d bytes).  Deliberate host-mode paths
+    (host UDF projection, the single packed scalar fetch) carry
+    ``# ballista: allow=hot-path-purity`` with a justification.
     """
 
     name = "hot-path-purity"
-    description = ("no np.asarray / jax.device_get / .block_until_ready() / "
-                   ".tolist() in operator hot-path modules")
+    description = ("no np.asarray / jax.device_get / jax.device_put / "
+                   ".block_until_ready() / .tolist() in operator hot-path "
+                   "modules")
 
     FILES = (f"{PKG}/ops/kernels.py", f"{PKG}/ops/operators.py",
              f"{PKG}/ops/expressions.py")
-    BANNED_MODULE_CALLS = {("numpy", "asarray"), ("jax", "device_get")}
+    BANNED_MODULE_CALLS = {("numpy", "asarray"), ("jax", "device_get"),
+                           ("jax", "device_put")}
     BANNED_METHODS = {"block_until_ready", "tolist"}
 
     def check(self, project: Project) -> Iterable[Violation]:
@@ -108,7 +113,10 @@ class SpanCoverageRule(Rule):
 
     DIR = f"{PKG}/ops/"
     METHODS = ("execute", "execute_write")
-    STATS_FNS = ("deferred_rows",)
+    # record_transfer feeds the device observatory's per-operator transfer
+    # accounting; calling it outside ctx.op_span(self) silently drops the
+    # bytes from the enclosing operator's stage summary.
+    STATS_FNS = ("deferred_rows", "record_transfer")
 
     def check(self, project: Project) -> Iterable[Violation]:
         for sf in project.source_files():

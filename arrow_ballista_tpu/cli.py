@@ -44,6 +44,8 @@ HELP = """\
 \\q            quit
 \\h            this help
 \\timing       toggle timing output
+\\advise SQL   run SQL and print the stage-fusion advisor report
+              (device-observatory overhead ranked per operator chain)
 anything else is executed as SQL.
 """
 
@@ -72,6 +74,13 @@ def run_command(ctx, line: str, timing: bool) -> bool:
         name = cmd[3:].strip()
         df = ctx.sql(f"show columns from {name}")
         print(df.to_pandas().to_string(index=False))
+        return timing
+    if cmd.startswith("\\advise "):
+        t0 = time.perf_counter()
+        advice = ctx.advise(cmd[len("\\advise "):].strip())
+        print(advice["text"])
+        if timing:
+            print(f"time: {time.perf_counter() - t0:.3f}s")
         return timing
     t0 = time.perf_counter()
     df = ctx.sql(cmd)

@@ -372,6 +372,23 @@ class BallistaContext:
             raise PlanningError("explain_analyze requires a SELECT query")
         return self._explain_analyze_statement(stmt)
 
+    def advise(self, sql: str) -> Dict:
+        """Run ``sql`` and return the stage-fusion advisor report
+        (obs/advisor.py): operator chains ranked by the materialization +
+        recompilation overhead a fused program would eliminate, with
+        estimated savings.  Same JSON shape as ``GET
+        /api/job/<id>/advise``; the ``"text"`` key holds the rendered
+        report.  Requires the device observatory
+        (``ballista.observability.device.enabled``) for non-zero
+        numbers."""
+        from ..obs.advisor import advise_report
+        from ..utils.config import OBS_DEVICE_ADVISOR_MIN_SAVINGS_MS
+
+        return advise_report(
+            self.explain_analyze(sql),
+            min_savings_ms=float(
+                self.config.get(OBS_DEVICE_ADVISOR_MIN_SAVINGS_MS)))
+
     def _explain_analyze_statement(self, stmt: "ast.Node") -> Dict:
         """Plan + run one SELECT and build the annotated report.  The
         standalone engine reads the retained ExecutionGraph's stats store
@@ -386,11 +403,15 @@ class BallistaContext:
         planned = planner.plan_query(optimize(logical))
         t0 = time.monotonic()
         if self.engine == "local":
-            batches = self._execute_local(planned)
+            from ..obs import device as device_obs
+
+            with device_obs.task_scope() as dev_acc:
+                batches = self._execute_local(planned)
             wall_ms = (time.monotonic() - t0) * 1000.0
             return local_explain_report(
                 planned.plan, wall_ms,
-                rows_returned=sum(b.num_rows for b in batches))
+                rows_returned=sum(b.num_rows for b in batches),
+                device_stats=dev_acc.snapshot() if dev_acc else None)
         batches = self._standalone.execute(planned)
         wall_ms = (time.monotonic() - t0) * 1000.0
         graph = self._standalone.scheduler.jobs.get_graph(
@@ -432,6 +453,12 @@ class BallistaContext:
         return self._standalone.execute(planned)
 
     def _execute_local(self, planned: PlannedQuery) -> List[ColumnBatch]:
+        from ..obs import device as device_obs
+        from ..utils.config import OBS_DEVICE_ENABLED, OBS_DEVICE_WATERMARKS
+
+        device_obs.set_enabled(bool(self.config.get(OBS_DEVICE_ENABLED)))
+        device_obs.set_watermarks(
+            bool(self.config.get(OBS_DEVICE_WATERMARKS)))
         ctx = TaskContext(config=self.config, work_dir=self.work_dir,
                           job_id=uuid.uuid4().hex[:7])
         for sid, splan in planned.scalars:

@@ -89,9 +89,17 @@ class Executor:
         from .metrics import ExecutorMetrics
 
         self.metrics = ExecutorMetrics()
-        from ..utils.config import OBS_TRACING
+        from ..utils.config import (OBS_DEVICE_ENABLED, OBS_DEVICE_WATERMARKS,
+                                    OBS_TRACING)
 
         self._tracing = bool(self.config.get(OBS_TRACING))
+        # device observatory switches are process-global (the jit wrappers
+        # and transfer sites it instruments are process-wide); every
+        # executor in the process shares one config in practice
+        from ..obs import device as device_obs
+
+        device_obs.set_enabled(bool(self.config.get(OBS_DEVICE_ENABLED)))
+        device_obs.set_watermarks(bool(self.config.get(OBS_DEVICE_WATERMARKS)))
 
     # --- task execution --------------------------------------------------
     def run_task(self, task: TaskDescription) -> TaskStatus:
@@ -121,7 +129,12 @@ class Executor:
                        "actor": f"executor {self.metadata.executor_id}",
                        "lane": f"stage {tid.stage_id} / p{tid.partition}"})
         t0 = time.perf_counter()
-        status = self._run_task_inner(task, launch_ms, recorder)
+        from ..obs import device as device_obs
+
+        with device_obs.task_scope() as dev_acc:
+            status = self._run_task_inner(task, launch_ms, recorder)
+        if dev_acc is not None:
+            status.device_stats = dev_acc.snapshot()
         if recorder is not None:
             if status.shuffle_writes:
                 recorder.annotate(

@@ -87,6 +87,52 @@ class ExecutorMetrics:
             lines.append("# TYPE shuffle_wire_compression_ratio gauge")
             lines.append("shuffle_wire_compression_ratio "
                          f"{dp_stats.compression_ratio():.4f}")
+            # device-observatory process totals (obs/device.py STATS):
+            # process-global like the data-plane counters above
+            from ..obs.device import STATS as dev_stats
+
+            dsnap = dev_stats.snapshot()
+            counter("device_jit_compiles_total",
+                    int(dsnap["jit_compiles"]),
+                    "first-time XLA compilations observed through the "
+                    "engine's jit wrappers")
+            counter("device_jit_retraces_total",
+                    int(dsnap["jit_retraces"]),
+                    "re-compilations of an already-compiled program at a "
+                    "new (shape, dtype, static-arg) key")
+            counter("device_jit_cache_hits_total",
+                    int(dsnap["jit_cache_hits"]),
+                    "jitted calls served by an already-compiled executable")
+            counter("device_jit_compile_seconds_total",
+                    round(float(dsnap["jit_compile_time"]), 6),
+                    "wall time spent inside compiling jit dispatches "
+                    "(trace + lowering + backend compile)")
+            counter("device_program_cache_hits_total",
+                    int(dsnap["program_cache_hits"]),
+                    "cross-job shared_program closure-cache hits "
+                    "(ops/physical.py)")
+            counter("device_program_cache_misses_total",
+                    int(dsnap["program_cache_misses"]),
+                    "shared_program closure-cache misses (a closure was "
+                    "built and inserted)")
+            counter("device_h2d_bytes_total", int(dsnap["h2d_bytes"]),
+                    "bytes moved host->device through accounted "
+                    "device_put sites (batch materialization)")
+            counter("device_d2h_bytes_total", int(dsnap["d2h_bytes"]),
+                    "bytes moved device->host through accounted "
+                    "device_get sites (packed host collects)")
+            lines.append("# HELP device_live_bytes_peak high-water mark of "
+                         "live device-buffer bytes sampled at task/operator "
+                         "boundaries (jax.live_arrays)")
+            lines.append("# TYPE device_live_bytes_peak gauge")
+            lines.append(
+                f"device_live_bytes_peak {int(dsnap['device_live_peak_bytes'])}")
+            lines.append("# HELP host_rss_bytes_peak high-water mark of "
+                         "this process's resident set (ru_maxrss; "
+                         "KB-granular on Linux)")
+            lines.append("# TYPE host_rss_bytes_peak gauge")
+            lines.append(
+                f"host_rss_bytes_peak {int(dsnap['host_rss_peak_bytes'])}")
             lines.append("# HELP executor_active_tasks tasks currently "
                          "executing")
             lines.append("# TYPE executor_active_tasks gauge")

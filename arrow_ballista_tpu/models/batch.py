@@ -26,6 +26,7 @@ with schema + dictionaries.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -33,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import device as device_obs
 from .schema import Schema
 
 
@@ -140,7 +142,10 @@ class ColumnBatch:
         # pay a host->device dispatch round-trip per column, which dominates
         # on remote-attached accelerators (the axon tunnel) and adds up on
         # PCIe too
+        nbytes = mask.nbytes + sum(c.nbytes for c in cols.values())
+        t0 = time.perf_counter()
         cols, mask = jax.device_put((cols, mask))
+        device_obs.record_transfer("h2d", nbytes, time.perf_counter() - t0)
         return ColumnBatch(schema, cols, mask, dicts, num_rows=n)
 
     @staticmethod
@@ -231,8 +236,13 @@ class ColumnBatch:
         cols = dict(self.columns)
         cols.update(extra32)
         while True:
+            t0 = time.perf_counter()
             buf, fbuf = jax.device_get(pack_for_host(
                 cols, self.mask, target, namesi64, namesf64, names32))
+            device_obs.record_transfer(
+                "d2h",
+                buf.nbytes + (fbuf.nbytes if fbuf is not None else 0),
+                time.perf_counter() - t0)
             out, n = unpack_from_host(buf, fbuf, target, i64, f64, f32)
             if out is not None:
                 break
@@ -422,10 +432,11 @@ def _concat_impl(cols_list, mask_list, pad: int):
     return cols, mask
 
 
-_concat_device = functools.partial(jax.jit, static_argnames=("pad",))(_concat_impl)
+_concat_device = device_obs.observed_jit("batch.concat", _concat_impl,
+                                         static_argnames=("pad",))
 
 
-@functools.partial(jax.jit, static_argnames=("target",))
+@device_obs.observed_jit("batch.shrink", static_argnames=("target",))
 def _shrink_device(cols, mask, target: int):
     from ..ops.kernels import compaction_order
 

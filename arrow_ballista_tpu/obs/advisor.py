@@ -1,0 +1,169 @@
+"""Stage-fusion advisor: turn device-observatory evidence into a ranked
+work-list for whole-stage compilation (ROADMAP item 2).
+
+Flare's result (PAPERS.md) is that fusing an operator pipeline into one
+compiled program wins exactly where per-operator materialization and
+recompilation dominate the actual compute; Zerrow's is that the residual
+copies are the remaining cost.  The advisor makes both measurable
+per stage *before* the fusion work exists: it walks an EXPLAIN ANALYZE
+report (obs/stats.py — per-operator ``device_ms`` / ``host_ms`` /
+``transfer_bytes`` / compile counts from obs/device.py), finds maximal
+single-input operator chains inside each stage plan, and scores each
+chain by the overhead fusion would eliminate:
+
+- ``host_ms`` of every operator after the chain head (inter-operator
+  transfer dispatch + compile time that one fused program would not pay),
+- the head operator's own retrace compile time (one fused program has
+  one trace cache instead of N),
+
+producing deterministic, savings-ranked fusion candidates.  Pure
+function of the report: usable offline on a saved JSON, behind
+``GET /api/job/<id>/advise``, and from the CLI (``\\advise``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# operators that cannot be fused into a single XLA program today: their
+# execute crosses the device boundary (shuffle materialization) or runs
+# host-side; a chain breaks at them
+_UNFUSABLE = {
+    "ShuffleWriterExec", "ShuffleReaderExec", "UnresolvedShuffleExec",
+}
+
+
+def _chains(tree: List[Dict]) -> List[List[Dict]]:
+    """Maximal single-child chains of fusable operators in one stage's
+    pre-order ``operator_tree`` (paths are dotted child indexes, so
+    ``a.b`` is a child of ``a``)."""
+    by_path = {op["path"]: op for op in tree}
+    children: Dict[str, List[Dict]] = {}
+    for op in tree:
+        if "." in op["path"]:
+            parent = op["path"].rsplit(".", 1)[0]
+            children.setdefault(parent, []).append(op)
+
+    def fusable(op):
+        return op["op"] not in _UNFUSABLE
+
+    def single_child(op) -> Optional[Dict]:
+        ch = children.get(op["path"], ())
+        return ch[0] if len(ch) == 1 else None
+
+    chains = []
+    consumed = set()
+    for op in tree:  # pre-order: chain heads come first
+        if op["path"] in consumed or not fusable(op):
+            continue
+        chain = [op]
+        nxt = single_child(op)
+        while nxt is not None and fusable(nxt):
+            chain.append(nxt)
+            nxt = single_child(nxt)
+        if len(chain) > 1:
+            chains.append(chain)
+            consumed.update(c["path"] for c in chain)
+    return chains
+
+
+def _candidate(stage_id: int, chain: List[Dict]) -> Dict:
+    device_ms = sum(op.get("device_ms", 0.0) for op in chain)
+    host_ms = sum(op.get("host_ms", 0.0) for op in chain)
+    transfer = sum(op.get("transfer_bytes", 0) for op in chain)
+    compiles = sum(op.get("compiles", 0) for op in chain)
+    retraces = sum(op.get("retraces", 0) for op in chain)
+    # fusing keeps ONE program entry: the chain head still pays its own
+    # first compile + transfers; everything downstream's host_ms goes away,
+    # plus the head's retrace share of its compile time
+    tail_host_ms = sum(op.get("host_ms", 0.0) for op in chain[1:])
+    head = chain[0]
+    head_mm = head.get("metrics") or {}
+    head_compile_ms = head_mm.get("jit_compile_time", 0.0) * 1000.0
+    head_events = head.get("compiles", 0) + head.get("retraces", 0)
+    head_retrace_ms = (head_compile_ms * head.get("retraces", 0)
+                       / head_events) if head_events else 0.0
+    est_savings_ms = tail_host_ms + head_retrace_ms
+    total_ms = device_ms + host_ms
+    reasons = []
+    if tail_host_ms:
+        reasons.append(
+            f"{tail_host_ms:.1f} ms of transfer+compile dispatch in "
+            f"{len(chain) - 1} downstream operator(s)")
+    if head_retrace_ms:
+        reasons.append(
+            f"{head_retrace_ms:.1f} ms retracing the chain head")
+    if transfer:
+        reasons.append(f"{transfer} bytes crossing the host boundary "
+                       "inside the chain")
+    if not reasons:
+        reasons.append("no measured overhead; fusion would only save "
+                       "per-operator dispatch")
+    return {
+        "stage_id": stage_id,
+        "operators": [op["op"] for op in chain],
+        "labels": [op["label"].splitlines()[0] for op in chain],
+        "paths": [op["path"] for op in chain],
+        "device_ms": round(device_ms, 3),
+        "host_ms": round(host_ms, 3),
+        "transfer_bytes": int(transfer),
+        "compiles": int(compiles),
+        "retraces": int(retraces),
+        "est_savings_ms": round(est_savings_ms, 3),
+        "overhead_ratio": round(host_ms / total_ms, 4) if total_ms else 0.0,
+        "reasons": reasons,
+    }
+
+
+def advise_report(report: Dict, min_savings_ms: float = 0.0) -> Dict:
+    """Rank fusion candidates from an EXPLAIN ANALYZE report.  Pure and
+    deterministic: equal inputs produce equal output (ties order by
+    (stage_id, head path))."""
+    candidates = []
+    for stage in report.get("stages", ()):
+        sid = stage.get("stage_id", 0)
+        for chain in _chains(stage.get("operator_tree") or []):
+            cand = _candidate(sid, chain)
+            if cand["est_savings_ms"] >= min_savings_ms:
+                candidates.append(cand)
+    candidates.sort(key=lambda c: (-c["est_savings_ms"], c["stage_id"],
+                                   c["paths"][0]))
+    out = {
+        "job_id": report.get("job_id", ""),
+        "generated_from": "explain_analyze",
+        "min_savings_ms": float(min_savings_ms),
+        "wall_time_ms": report.get("wall_time_ms", 0.0),
+        "candidates": candidates,
+        "total_est_savings_ms": round(
+            sum(c["est_savings_ms"] for c in candidates), 3),
+    }
+    out["text"] = render_advice(out)
+    return out
+
+
+def advise_graph(graph, min_savings_ms: float = 0.0) -> Dict:
+    """Advisor over a live/finished ExecutionGraph (the REST surface)."""
+    from .stats import explain_analyze_report
+
+    return advise_report(explain_analyze_report(graph), min_savings_ms)
+
+
+def render_advice(advice: Dict) -> str:
+    lines = [f"== FUSION ADVISOR: job {advice['job_id']} — "
+             f"{len(advice['candidates'])} candidate(s), "
+             f"~{advice['total_est_savings_ms']:.1f} ms estimated =="]
+    if not advice["candidates"]:
+        lines.append("no operator chain shows measurable materialization "
+                     "or recompilation overhead")
+    for i, c in enumerate(advice["candidates"], 1):
+        lines.append(
+            f"{i}. stage {c['stage_id']}: fuse "
+            + " -> ".join(c["operators"])
+            + f"  (~{c['est_savings_ms']:.1f} ms, overhead ratio "
+              f"{c['overhead_ratio']:.0%})")
+        lines.append(f"   device {c['device_ms']:.1f} ms · host "
+                     f"{c['host_ms']:.1f} ms · {c['transfer_bytes']} "
+                     f"transfer bytes · {c['compiles']} compiles"
+                     f"/{c['retraces']} retraces")
+        for r in c["reasons"]:
+            lines.append(f"   - {r}")
+    return "\n".join(lines)

@@ -132,6 +132,76 @@ class JobObservability:
         span = jt.phases.get("execution") or jt.root
         return span.context()
 
+    def on_adopted(self, job_id: str, epoch: int, prev_owner: str = "",
+                   scheduler_id: str = "",
+                   trace: Optional[Dict[str, str]] = None) -> None:
+        """Fleet-HA failover hook (scheduler._adopt_one / recover_jobs):
+        this shard took over a job whose previous owner stopped renewing
+        its lease.  Opens a root for the adopted drive — continuing the
+        original trace when the checkpointed graph carried its context,
+        so the Chrome trace shows both shards on one timeline — with an
+        ended "lease adoption" marker span annotated with the fencing
+        epoch, then an execution phase for the relaunched tasks."""
+        if not self.tracing:
+            return
+        trace = trace or {}
+        root = Span(f"job {job_id} (adopted)",
+                    trace.get("trace_id") or new_trace_id(),
+                    parent_id=trace.get("span_id", ""), kind="scheduler",
+                    attrs={"job_id": job_id, "actor": "scheduler",
+                           "lane": f"job {job_id}", "adopted": True,
+                           "adoption_epoch": int(epoch),
+                           "adopted_by": scheduler_id})
+        jt = _JobTrace(job_id, root)
+        marker = Span("lease adoption", root.trace_id,
+                      parent_id=root.span_id, kind="scheduler",
+                      attrs={"job_id": job_id, "actor": "scheduler",
+                             "lane": f"job {job_id}",
+                             "adoption_epoch": int(epoch),
+                             "previous_owner": prev_owner,
+                             "adopted_by": scheduler_id})
+        marker.end()
+        jt.phases[f"adoption@{epoch}"] = marker
+        self._start_phase(jt, "execution")
+        with self._lock:
+            self._jobs.pop(job_id, None)
+            self._jobs[job_id] = jt
+            while len(self._jobs) > self._max_live:
+                self._jobs.popitem(last=False)
+
+    def on_stand_down(self, job_id: str, why: str) -> None:
+        """Fleet-HA fencing hook (scheduler._on_lease_lost): this shard
+        lost the job's lease and is abandoning its drive.  Closes the
+        local spans with a "stand-down" marker and retains them, so the
+        ex-owner's /api/job/<id>/trace still shows its half of the
+        failover (the adopter records the other half, on the same
+        trace_id when the checkpoint carried it)."""
+        if not self.tracing:
+            return
+        with self._lock:
+            jt = self._jobs.pop(job_id, None)
+        if jt is None:
+            return
+        marker = Span("lease stand-down", jt.root.trace_id,
+                      parent_id=jt.root.span_id, kind="scheduler",
+                      attrs={"job_id": job_id, "actor": "scheduler",
+                             "lane": f"job {job_id}", "reason": why})
+        marker.end()
+        jt.phases["stand-down"] = marker
+        for span in jt.phases.values():
+            if not span.end_ms:
+                span.end("stand-down")
+        jt.root.end("stand-down")
+        spans = self._job_spans(jt, None)
+        profile = self._build_profile(jt, None, None)
+        profile["state"] = "stood-down"
+        profile["stand_down_reason"] = why
+        self.profiles.put(job_id, profile, spans)
+        try:
+            self.collector.export(spans)
+        except Exception:
+            pass
+
     def on_finished(self, status, graph=None) -> None:
         """Terminal JobStatus hook: close spans, build + retain the
         profile, export to the collector.  Idempotent per job."""
@@ -299,4 +369,8 @@ def _task_profile(info) -> Dict:
     # cumulative per-operator snapshot keyed by plan path (the raw
     # material of stage['operators']; present even with tracing off)
     t["metrics"] = st.metrics or {}
+    # device-observatory fold for this task (obs/device.py; empty when
+    # the observatory is off — key omitted to mirror the wire form)
+    if getattr(st, "device_stats", None):
+        t["device"] = st.device_stats
     return t

@@ -94,6 +94,38 @@ def duration_quantiles(durations: Sequence[float]) -> Dict[str, float]:
     return out
 
 
+_DEVICE_PEAK_KEYS = ("device_mem_peak", "host_mem_peak")
+
+
+def device_summary(stage) -> Dict:
+    """Fold completed tasks' ``TaskStatus.device_stats`` into a per-stage
+    device summary: counters sum (each status carries the task's own
+    delta, not a cumulative snapshot), watermarks take the max.  Same
+    attempt guard as ``operator_metrics`` — a terminal status absorbed
+    from a cancelled speculative loser doesn't count.  Empty dict when
+    the device observatory was off for every task."""
+    totals: Dict[str, float] = {}
+    peaks: Dict[str, float] = {}
+    for t in stage.task_infos:
+        st = getattr(t, "status", None)
+        ds = getattr(st, "device_stats", None)
+        if not ds:
+            continue
+        st_att = getattr(getattr(st, "task", None), "task_attempt", None)
+        if st_att is not None and st_att != getattr(t, "attempt", st_att):
+            continue
+        for k, v in ds.items():
+            if k in _DEVICE_PEAK_KEYS:
+                if v > peaks.get(k, 0):
+                    peaks[k] = v
+            else:
+                totals[k] = totals.get(k, 0) + v
+    out = {k: (round(v, 6) if isinstance(v, float) else v)
+           for k, v in sorted(totals.items())}
+    out.update({k: int(v) for k, v in sorted(peaks.items())})
+    return out
+
+
 def stage_summary(stage) -> Dict:
     """Fold one ExecutionStage's completed-task evidence into a summary.
 
@@ -131,6 +163,9 @@ def stage_summary(stage) -> Dict:
         "row_histogram": row_histogram(rows_list),
         "task_duration_s": duration_quantiles(list(stage.durations)),
         "operators": stage.operator_metrics(),
+        # device-observatory fold (obs/device.py): jit compile/retrace
+        # counts, transfer bytes/seconds, memory watermark peaks
+        "device": device_summary(stage),
         # runtime rewrites applied to this stage (scheduler/aqe.py):
         # coalesce / skew-split / broadcast records with before/after
         # partition counts
@@ -193,6 +228,13 @@ def _walk_plan(node, path="0", depth=0, out=None):
 def _op_entry(path: str, depth: int, node, mm: Dict[str, float]) -> Dict:
     time_ms = sum(v for k, v in mm.items() if k.endswith("_time")) * 1000.0
     nbytes = sum(v for k, v in mm.items() if k.endswith("_bytes"))
+    # device-observatory split (obs/device.py): host_ms is the accounted
+    # non-compute wall time inside this operator — transfer dispatch +
+    # jit compiles — and device_ms the remainder of its timed work.
+    # transfer_bytes separates host<->device traffic from the shuffle
+    # bytes that also fold into ``bytes``.
+    host_ms = (mm.get("h2d_time", 0.0) + mm.get("d2h_time", 0.0)
+               + mm.get("jit_compile_time", 0.0)) * 1000.0
     label = node._label() if hasattr(node, "_label") else type(node).__name__
     return {
         "path": path,
@@ -202,6 +244,11 @@ def _op_entry(path: str, depth: int, node, mm: Dict[str, float]) -> Dict:
         "rows": int(mm["output_rows"]) if "output_rows" in mm else None,
         "time_ms": round(time_ms, 3),
         "bytes": int(nbytes),
+        "device_ms": round(max(time_ms - host_ms, 0.0), 3),
+        "host_ms": round(host_ms, 3),
+        "transfer_bytes": int(mm.get("h2d_bytes", 0) + mm.get("d2h_bytes", 0)),
+        "compiles": int(mm.get("jit_compiles", 0)),
+        "retraces": int(mm.get("jit_retraces", 0)),
         "metrics": {k: round(v, 6) for k, v in sorted(mm.items())},
     }
 
@@ -256,6 +303,15 @@ def _stage_header(s: Dict) -> str:
     if dur.get("count"):
         bits.append(f"task p50 {dur['p50']:.3f}s p95 {dur['p95']:.3f}s "
                     f"max {dur['max']:.3f}s")
+    dev = s.get("device") or {}
+    if dev.get("jit_compiles") or dev.get("jit_retraces"):
+        bits.append(f"jit {int(dev.get('jit_compiles', 0))} compiles"
+                    f"/{int(dev.get('jit_retraces', 0))} retraces")
+    xfer = dev.get("h2d_bytes", 0) + dev.get("d2h_bytes", 0)
+    if xfer:
+        bits.append("xfer " + _fmt_bytes(xfer))
+    if dev.get("device_mem_peak"):
+        bits.append("hbm peak " + _fmt_bytes(dev["device_mem_peak"]))
     return " · ".join(bits)
 
 
@@ -310,15 +366,30 @@ def explain_analyze_report(graph, wall_time_ms: float = 0.0,
 
 
 def local_explain_report(plan, wall_time_ms: float = 0.0,
-                         rows_returned: Optional[int] = None) -> Dict:
+                         rows_returned: Optional[int] = None,
+                         device_stats: Optional[Dict] = None) -> Dict:
     """EXPLAIN ANALYZE for the local (single-process) engine: no stage
     DAG or shuffle files, so the whole plan is one synthetic stage and
-    metrics come straight off the executed operator instances."""
+    metrics come straight off the executed operator instances.
+    ``device_stats`` is the run's device-observatory fold (the local
+    analog of ``TaskStatus.device_stats``); when absent the stage-level
+    device view is re-derived from the operators' own device metrics
+    (which then lacks watermarks — those only exist scope-level)."""
     op_metrics = {
         f"{path}:{type(node).__name__}": node.metrics().to_dict()
         for path, _depth, node in _walk_plan(plan)
         if hasattr(node, "metrics")
     }
+    if device_stats is None:
+        device_stats = {}
+        for mm in op_metrics.values():
+            for k in ("jit_compiles", "jit_retraces", "jit_cache_hits",
+                      "jit_compile_time", "h2d_bytes", "d2h_bytes",
+                      "h2d_time", "d2h_time", "h2d_transfers",
+                      "d2h_transfers"):
+                if mm.get(k):
+                    device_stats[k] = round(
+                        device_stats.get(k, 0) + mm[k], 6)
     stage = {
         "stage_id": 0,
         "state": "successful",
@@ -336,6 +407,7 @@ def local_explain_report(plan, wall_time_ms: float = 0.0,
         "row_histogram": row_histogram([]),
         "task_duration_s": duration_quantiles([]),
         "operators": op_metrics,
+        "device": {k: device_stats[k] for k in sorted(device_stats)},
         "aqe": [],
         "operator_tree": annotate_plan(plan, op_metrics),
     }

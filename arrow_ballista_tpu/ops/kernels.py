@@ -27,6 +27,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs.device import observed_jit
+
 I64_MAX = jnp.int64(2**63 - 1)
 
 
@@ -117,7 +119,8 @@ def compact_columns(cols: Dict[str, jnp.ndarray], mask: jnp.ndarray):
 # this is the TPU-native replacement for that hot loop's memory traffic.
 
 
-@partial(jax.jit, static_argnames=("target", "namesi64", "namesf64", "names32"))
+@observed_jit("kernels.pack_for_host",
+              static_argnames=("target", "namesi64", "namesf64", "names32"))
 def pack_for_host(cols, mask, target: int, namesi64, namesf64, names32):
     """Compact live rows to the front and pack columns + live-row count for
     a minimal device->host transfer.

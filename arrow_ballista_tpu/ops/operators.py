@@ -23,6 +23,7 @@ from ..models.batch import ColumnBatch, concat_batches, remote_device
 from ..models.schema import BOOL, DataType, Field, INT64, Schema
 from ..utils.config import AGG_CAPACITY, JOIN_MAX_CAPACITY
 from ..utils.errors import CapacityError, ExecutionError, InternalError
+from ..obs.device import observed_jit
 from .expressions import Compiled, ExprCompiler
 from . import kernels as K
 from .physical import (ExecutionPlan, Partitioning, TaskContext,
@@ -179,7 +180,7 @@ class ProjectionExec(ExecutionPlan):
             def proj_fn(cols, mask, aux):
                 return {n: f(cols, aux) for f, n in fns}, mask
 
-            jfn = jax.jit(proj_fn)
+            jfn = observed_jit("project", proj_fn)
         else:
             jfn = None
         return comp, compiled, jfn
@@ -313,7 +314,9 @@ class FilterExec(ExecutionPlan):
                     if self.host_mode:
                         jfn = None
                     else:
-                        jfn = jax.jit(lambda cols, mask, aux: mask & pred.fn(cols, aux))
+                        jfn = observed_jit(
+                            "filter",
+                            lambda cols, mask, aux: mask & pred.fn(cols, aux))
                     return comp, pred, jfn
 
                 if has_scalar_subquery(self.predicate):
@@ -546,7 +549,7 @@ class HashAggregateExec(ExecutionPlan):
                                              jnp.iinfo(jnp.int64).min))
                     return jnp.any(live) & ((kmin < lo) | (kmax > hi))
 
-                self._range_check = jax.jit(check)
+                self._range_check = observed_jit("sort.range_check", check)
         lo, hi = ranges[partition]
         aux = comp.aux_arrays(big.dicts)
         return self._range_check(big.columns, big.mask, aux,
@@ -591,7 +594,9 @@ class HashAggregateExec(ExecutionPlan):
                 his = np.full(padn, 0, dtype=np.int64)  # empty: lo > hi
                 for i, (lo, hi) in enumerate(intervals):
                     los[i], his[i] = lo, hi
-                self._cl_compiled = (comp, jax.jit(keep_fn),
+                self._cl_compiled = (comp,
+                                     observed_jit("agg.clustered_keep",
+                                                  keep_fn),
                                      jnp.asarray(los), jnp.asarray(his))
         comp, keep_fn, los, his = self._cl_compiled
         aux = comp.aux_arrays(result.dicts)
@@ -661,7 +666,8 @@ class HashAggregateExec(ExecutionPlan):
                             out[name] = v
                     return out
 
-                self._pt_compiled = (comp, group_c, jax.jit(pt_fn))
+                self._pt_compiled = (comp, group_c,
+                                     observed_jit("agg.passthrough", pt_fn))
         comp, group_c, ptfn = self._pt_compiled
         with self.metrics().timer("agg_time"):
             aux = comp.aux_arrays(big.dicts)
@@ -774,7 +780,7 @@ class HashAggregateExec(ExecutionPlan):
                                        key_ranges=key_ranges)
 
         return (comp, group_c, agg_c, tracked,
-                jax.jit(agg_fn, static_argnums=(3, 4)))
+                observed_jit("agg.grouped", agg_fn, static_argnums=(3, 4)))
 
     def _execute_device(self, ctx, cfg_cap, big):
         comp, group_c, agg_c, tracked, jfn = self._compiled
@@ -931,7 +937,7 @@ class HashAggregateExec(ExecutionPlan):
 # --------------------------------------------------------------------------
 
 
-@jax.jit
+@observed_jit("join.window_mask")
 def _window_mask(mask, lo, hi):
     """Probe-window liveness: live AND row index in [lo, hi).  One compiled
     program serves every window of every chunked join at this capacity."""
@@ -939,7 +945,7 @@ def _window_mask(mask, lo, hi):
     return mask & (idx >= lo) & (idx < hi)
 
 
-_mask_or = jax.jit(lambda a, b: a | b)
+_mask_or = observed_jit("join.mask_or", lambda a, b: a | b)
 
 
 class JoinExec(ExecutionPlan):
@@ -1181,9 +1187,11 @@ class JoinExec(ExecutionPlan):
                                        num_segments=n_windows)
 
         return (lcomp, rcomp, fcomp,
-                jax.jit(join_fn, static_argnums=(9,)),
-                jax.jit(count_fn), jax.jit(prep_fn),
-                jax.jit(wcount_fn, static_argnums=(4, 5)))
+                observed_jit("join.probe", join_fn, static_argnums=(9,)),
+                observed_jit("join.count", count_fn),
+                observed_jit("join.prep", prep_fn),
+                observed_jit("join.wcount", wcount_fn,
+                             static_argnums=(4, 5)))
 
     def _out_row_bytes(self) -> int:
         return self._schema.row_byte_width()
@@ -1476,7 +1484,7 @@ class SortExec(ExecutionPlan):
                         order = K.sort_order(key_arrays, mask)
                         return {k: v[order] for k, v in cols.items()}, mask[order]
 
-                    return comp, jax.jit(sort_fn)
+                    return comp, observed_jit("sort.order", sort_fn)
 
                 if has_scalar_subquery(*[e for e, _ in self.keys]):
                     self._compiled = build()

@@ -23,6 +23,8 @@ import numpy as np
 from ..models import expr as E
 from ..models.batch import ColumnBatch, concat_batches, round_capacity
 from ..models.schema import DataType, Schema
+from ..obs import device as device_obs
+from ..obs.device import observed_jit
 from ..utils.config import BallistaConfig
 from ..utils.errors import ExecutionError, InternalError
 from .expressions import ExprCompiler
@@ -106,7 +108,9 @@ def shared_program(key, build):
         hit = _program_cache.get(key)
         if hit is not None:
             _program_cache.move_to_end(key)
+            device_obs.record_program_cache(hit=True)
             return hit
+    device_obs.record_program_cache(hit=False)
     built = build()
     with _program_cache_lock:
         now = _program_cache.get(key)
@@ -199,6 +203,16 @@ class _Timer:
         self.ms.add(self.name, time.perf_counter() - self.t0)
 
 
+@contextlib.contextmanager
+def _span_and_device(span_cm, op):
+    """Tracing span + device-attribution scope around one operator
+    execute: the device scope nests inside the span so the span's
+    metric-delta snapshot (TaskSpanRecorder.op_span) sees the device
+    counters this call added."""
+    with span_cm, device_obs.op_scope(op):
+        yield
+
+
 @dataclasses.dataclass
 class TaskContext:
     config: BallistaConfig = dataclasses.field(default_factory=BallistaConfig)
@@ -229,11 +243,14 @@ class TaskContext:
             raise CancelledError(f"job {self.job_id} cancelled")
 
     def op_span(self, op):
-        """Context manager spanning one operator's execute call (a no-op
-        without a recorder, so operators instrument unconditionally)."""
+        """Context manager spanning one operator's execute call: always
+        enters the device-observatory attribution scope (obs/device.py —
+        a shared null context when that is off), plus the tracing span
+        when a recorder rides along; operators instrument
+        unconditionally."""
         if self.span_recorder is None:
-            return contextlib.nullcontext()
-        return self.span_recorder.op_span(op)
+            return device_obs.op_scope(op)
+        return _span_and_device(self.span_recorder.op_span(op), op)
 
 
 # --------------------------------------------------------------------------
@@ -535,7 +552,8 @@ class ScanExec(ExecutionPlan):
                 def build():
                     comp = ExprCompiler(self._schema, "device")
                     pred = comp.compile_pred(E.and_all(self.filters))
-                    return comp, jax.jit(
+                    return comp, observed_jit(
+                        "scan.filter",
                         lambda cols, mask, aux: mask & pred.fn(cols, aux))
 
                 self._filter_compiler, self._filter_fn = shared_program(

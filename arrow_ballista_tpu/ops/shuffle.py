@@ -35,6 +35,7 @@ from ..models.batch import ColumnBatch, concat_batches
 from ..models.batch import round_capacity as _round_capacity
 from ..models.ipc import crc32_file, read_ipc_files, write_ipc_file, write_ipc_rows
 from ..models.schema import Schema
+from ..obs.device import observed_jit
 from ..utils.errors import FetchFailedError, InternalError
 from .expressions import ExprCompiler
 from . import kernels as K
@@ -153,7 +154,8 @@ class ShuffleWriterExec(ExecutionPlan):
                             keys = [c.fn(cols, aux) for c in keys_c]
                             return K.bucket_of(keys, num_out)
 
-                        return comp, jax.jit(bucket_fn)
+                        return comp, observed_jit("shuffle.bucket",
+                                                  bucket_fn)
 
                     self._compiled = shared_program(
                         ("bucket", num_out, schema_sig(self.input.schema),
@@ -494,7 +496,7 @@ class RepartitionExec(ExecutionPlan):
                 b = K.bucket_of(keys, num_out)
                 return [mask & (b == q) for q in range(num_out)]
 
-            bfn = jax.jit(bucket_fn)
+            bfn = observed_jit("repartition.bucket", bucket_fn)
             for p in range(self.input.output_partition_count()):
                 for b in self.input.execute(p, ctx):
                     aux = comp.aux_arrays(b.dicts)

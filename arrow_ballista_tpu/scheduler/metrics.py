@@ -62,6 +62,9 @@ class SchedulerMetricsCollector:
     # cluster-history thread)
     def set_event_queue_depth(self, value: int) -> None: ...
     def set_event_loop_lag(self, seconds: float) -> None: ...
+    # device observatory (obs/device.py; shipped as
+    # TaskStatus.device_stats and folded fleet-wide on status intake)
+    def record_device_stats(self, device_stats: Dict[str, float]) -> None: ...
     # serving caches (scheduler/serving_cache.py)
     def record_plan_cache_hit(self) -> None: ...
     def record_plan_cache_miss(self) -> None: ...
@@ -107,6 +110,16 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.plan_cache_misses = 0
         self.result_cache_hits = 0
         self.cache_evictions = 0
+        # fleet-wide device-observatory fold (TaskStatus.device_stats
+        # intake): counters sum across every task the fleet absorbed,
+        # watermarks keep the max any single task reported
+        self.device_jit_compiles = 0
+        self.device_jit_retraces = 0
+        self.device_compile_seconds = 0.0
+        self.device_h2d_bytes = 0
+        self.device_d2h_bytes = 0
+        self.device_mem_peak = 0
+        self.device_host_mem_peak = 0
 
     def record_submitted(self, job_id, queued_at_ms, submitted_at_ms):
         with self._lock:
@@ -185,6 +198,23 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.event_loop_lag_s = seconds
 
+    def record_device_stats(self, device_stats):
+        with self._lock:
+            self.device_jit_compiles += int(
+                device_stats.get("jit_compiles", 0))
+            self.device_jit_retraces += int(
+                device_stats.get("jit_retraces", 0))
+            self.device_compile_seconds += float(
+                device_stats.get("jit_compile_time", 0.0))
+            self.device_h2d_bytes += int(device_stats.get("h2d_bytes", 0))
+            self.device_d2h_bytes += int(device_stats.get("d2h_bytes", 0))
+            self.device_mem_peak = max(
+                self.device_mem_peak,
+                int(device_stats.get("device_mem_peak", 0)))
+            self.device_host_mem_peak = max(
+                self.device_host_mem_peak,
+                int(device_stats.get("host_mem_peak", 0)))
+
     def record_plan_cache_hit(self):
         with self._lock:
             self.plan_cache_hits += 1
@@ -254,6 +284,34 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             counter("cache_evictions_total", self.cache_evictions,
                     "plan templates and result/subplan entries evicted by "
                     "the serving caches' LRU byte/entry budgets")
+            counter("fleet_device_jit_compiles_total",
+                    self.device_jit_compiles,
+                    "first-time XLA compilations reported by completed "
+                    "tasks across the fleet (TaskStatus.device_stats)")
+            counter("fleet_device_jit_retraces_total",
+                    self.device_jit_retraces,
+                    "jit retraces (new shape/static-arg keys of "
+                    "already-compiled programs) reported by completed "
+                    "tasks across the fleet")
+            counter("fleet_device_compile_seconds_total",
+                    round(self.device_compile_seconds, 6),
+                    "wall time tasks spent inside compiling jit "
+                    "dispatches, summed fleet-wide")
+            counter("fleet_device_h2d_bytes_total", self.device_h2d_bytes,
+                    "host->device transfer bytes reported by completed "
+                    "tasks across the fleet")
+            counter("fleet_device_d2h_bytes_total", self.device_d2h_bytes,
+                    "device->host transfer bytes reported by completed "
+                    "tasks across the fleet")
+            lines.append("# HELP fleet_device_mem_peak_bytes largest live "
+                         "device-buffer watermark any single task reported")
+            lines.append("# TYPE fleet_device_mem_peak_bytes gauge")
+            lines.append(f"fleet_device_mem_peak_bytes {self.device_mem_peak}")
+            lines.append("# HELP fleet_host_mem_peak_bytes largest host RSS "
+                         "watermark any single task reported")
+            lines.append("# TYPE fleet_host_mem_peak_bytes gauge")
+            lines.append(
+                f"fleet_host_mem_peak_bytes {self.device_host_mem_peak}")
             lines.append("# HELP quarantined_executors executors currently "
                          "quarantined (no new offers)")
             lines.append("# TYPE quarantined_executors gauge")

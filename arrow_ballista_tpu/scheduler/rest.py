@@ -21,6 +21,8 @@ Beyond the reference surface:
     GET  /api/job/<id>/stats   EXPLAIN ANALYZE report: per-stage skew /
                                histograms / duration quantiles + annotated
                                operator tree (obs/stats.py)
+    GET  /api/job/<id>/advise  stage-fusion advisor: operator chains ranked
+                               by estimated fusion savings (obs/advisor.py)
     GET  /api/cluster/history  ring-buffer time series of cluster samples
                                (utilization, queue depths, event-loop lag)
     GET  /api/plan-cache       prepared-plan cache: hit/miss/eviction
@@ -38,6 +40,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..obs.advisor import advise_graph
 from ..obs.stats import explain_analyze_report
 from .graph_dot import graph_to_dot
 from .scheduler import SchedulerServer
@@ -141,6 +144,12 @@ class RestApi:
                 h._send(404, json.dumps({"error": "no such job"}))
             else:
                 h._send(200, json.dumps(explain_analyze_report(graph)))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "advise":
+            graph = self.server.jobs.get_graph(rest[1])
+            if graph is None:
+                h._send(404, json.dumps({"error": "no such job"}))
+            else:
+                h._send(200, json.dumps(advise_graph(graph)))
         elif rest == ["cluster", "history"]:
             hist = self.server.history.snapshot()
             hist["now"] = self.server.cluster_sample()

@@ -812,6 +812,10 @@ class SchedulerServer:
             return
         log.warning("lost lease on job %s (%s): abandoning local drive",
                     job_id, why)
+        # retain this shard's half of the job trace with a stand-down
+        # marker before the job is dropped locally (the adopter's spans
+        # continue the same trace_id via the checkpointed context)
+        self.obs.on_stand_down(job_id, why)
         graph = self.jobs.get_graph(job_id)
         self.jobs.remove_job(job_id)
         with self._meta_lock:
@@ -916,13 +920,13 @@ class SchedulerServer:
                 continue  # our own expiry: the renewal loop handles it
             if self.jobs.get_status(stale.job_id) is not None:
                 continue
-            if self._adopt_one(stale.job_id):
+            if self._adopt_one(stale.job_id, prev_owner=stale.owner):
                 adopted.append(stale.job_id)
         if adopted:
             self._event_loop.post(Offer())
         return adopted
 
-    def _adopt_one(self, job_id: str) -> bool:
+    def _adopt_one(self, job_id: str, prev_owner: str = "") -> bool:
         lease = self.job_backend.acquire_lease(
             job_id, self.scheduler_id, endpoint=self.client_endpoint,
             ttl_s=self.config.fleet_lease_ttl_s)
@@ -942,6 +946,14 @@ class SchedulerServer:
         graph.addr_resolver = self._resolve_addr
         self.jobs.accept_job(job_id)
         self.jobs.submit_job(job_id, graph)
+        # trace continuity across the failover: open this shard's side of
+        # the job trace (same trace_id as the ex-owner when the checkpoint
+        # carried it) with the fencing epoch annotated, then re-parent the
+        # relaunched tasks under the adopter's execution phase
+        self.obs.on_adopted(job_id, lease.epoch, prev_owner=prev_owner,
+                            scheduler_id=self.scheduler_id,
+                            trace=dict(getattr(graph, "trace", {}) or {}))
+        graph.trace = self.obs.task_parent(job_id)
         log.info("adopted job %s at lease epoch %d", job_id, lease.epoch)
         return True
 
@@ -1089,6 +1101,10 @@ class SchedulerServer:
         self._record_quarantine_signals(executor_id, statuses)
         by_job: Dict[str, List[TaskStatus]] = {}
         for st in statuses:
+            if st.device_stats:
+                # fleet-wide device-observatory fold: each status carries
+                # the task's own delta, so summing on intake is exact
+                self.metrics.record_device_stats(st.device_stats)
             by_job.setdefault(st.task.job_id, []).append(st)
         for job_id, sts in by_job.items():
             graph = self.jobs.get_graph(job_id)

@@ -94,6 +94,12 @@ class TaskStatus:
     # task span tree (obs.tracing.Span objects; serialized with the
     # status over the wire, empty when tracing is disabled)
     spans: List[object] = dataclasses.field(default_factory=list)
+    # device-observatory fold for this task (obs/device.py task_scope):
+    # jit compiles/retraces/cache hits, compile seconds, h2d/d2h
+    # bytes+seconds, memory watermark peaks.  Empty dict when the
+    # observatory is off — and then it serializes to NO wire key, so
+    # disabled mode is byte-identical to the pre-observatory wire format
+    device_stats: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -468,6 +468,10 @@ def graph_to_obj(graph) -> dict:
             "error": graph.error, "scalars": dict(graph.scalars),
             "aqe": _dc.asdict(aqe) if aqe is not None else None,
             "aqe_log": [dict(r) for r in getattr(graph, "aqe_log", [])],
+            # task-propagation trace context: an adopting shard continues
+            # the original trace, so a failed-over job's Chrome trace
+            # shows both shards on one timeline (obs/profile.on_adopted)
+            "trace": dict(getattr(graph, "trace", {}) or {}),
             "stages": stages}
 
 
@@ -502,6 +506,7 @@ def graph_from_obj(o: dict):
         from .scheduler.aqe import AqePolicy
         graph.aqe = AqePolicy(**o["aqe"])
     graph.aqe_log = [dict(r) for r in o.get("aqe_log", [])]
+    graph.trace = dict(o.get("trace", {}))
     for sid, (st, plan_resolved) in meta.items():
         stage = graph.stages[sid]
         stage.state = st["state"]
@@ -557,7 +562,7 @@ def task_from_obj(o: dict) -> TaskDescription:
 def status_to_obj(st: TaskStatus) -> dict:
     from .obs.tracing import span_to_obj
 
-    return {
+    o = {
         "task": vars(st.task), "executor_id": st.executor_id, "state": st.state,
         "writes": [vars(w) for w in st.shuffle_writes],
         "failure": vars(st.failure) if st.failure else None,
@@ -566,6 +571,11 @@ def status_to_obj(st: TaskStatus) -> dict:
         "process_id": st.process_id,
         "spans": [span_to_obj(s) for s in (st.spans or [])],
     }
+    # only when the device observatory recorded something: disabled mode
+    # must stay byte-identical on the wire (test_serde_wire.py)
+    if st.device_stats:
+        o["device_stats"] = st.device_stats
+    return o
 
 
 def status_from_obj(o: dict) -> TaskStatus:
@@ -577,7 +587,8 @@ def status_from_obj(o: dict) -> TaskStatus:
         FailedReason(**o["failure"]) if o.get("failure") else None,
         o.get("launch_ms", 0), o.get("start_ms", 0), o.get("end_ms", 0),
         o.get("metrics", {}), o.get("process_id", ""),
-        spans=[span_from_obj(s) for s in o.get("spans", [])])
+        spans=[span_from_obj(s) for s in o.get("spans", [])],
+        device_stats=dict(o.get("device_stats", {})))
 
 
 # --------------------------------------------------------------------------

@@ -49,6 +49,11 @@ OBS_TRACING = "ballista.observability.tracing"
 OBS_PROFILE_RETENTION = "ballista.observability.profile.retention"
 OBS_COLLECTOR = "ballista.observability.collector"
 OBS_OTLP_ENDPOINT = "ballista.observability.otlp.endpoint"
+# device-level observatory (arrow_ballista_tpu/obs/device.py)
+OBS_DEVICE_ENABLED = "ballista.observability.device.enabled"
+OBS_DEVICE_WATERMARKS = "ballista.observability.device.watermarks"
+OBS_DEVICE_ADVISOR_MIN_SAVINGS_MS = \
+    "ballista.observability.device.advisor.min_savings_ms"
 # static analysis (arrow_ballista_tpu/analysis/)
 ANALYSIS_PLAN_CHECKS = "ballista.analysis.plan_checks"
 ANALYSIS_LOCK_ORDER_RUNTIME = "ballista.analysis.lock_order.runtime"
@@ -257,6 +262,22 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "OTLP/HTTP endpoint (e.g. "
                     "http://localhost:4318/v1/traces) used when the 'otlp' "
                     "collector is selected"),
+        ConfigEntry(OBS_DEVICE_ENABLED, True, _parse_bool,
+                    "device-level observatory (obs/device.py): JIT "
+                    "compile/retrace/cache-hit accounting, host<->device "
+                    "transfer bytes, and memory watermarks, attributed per "
+                    "operator and shipped as TaskStatus.device_stats "
+                    "(False = every probe is a single predicate check)"),
+        ConfigEntry(OBS_DEVICE_WATERMARKS, True, _parse_bool,
+                    "sample device live-buffer bytes and host RSS peaks at "
+                    "task/operator boundaries (requires "
+                    "ballista.observability.device.enabled; False drops "
+                    "only the watermark sampling, keeping compile/transfer "
+                    "accounting)"),
+        ConfigEntry(OBS_DEVICE_ADVISOR_MIN_SAVINGS_MS, 1.0, float,
+                    "fusion advisor (obs/advisor.py): drop stage operator "
+                    "chains whose estimated fusion savings fall below this "
+                    "many milliseconds"),
         ConfigEntry(ADMISSION_RETRY_AFTER_S, 5, int,
                     "retry-after hint (seconds) embedded in retriable "
                     "admission failures (queue full / queue timeout)"),

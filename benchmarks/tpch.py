@@ -138,6 +138,8 @@ def register_tables(ctx, path: str) -> None:
 
 
 def cmd_benchmark(args) -> None:
+    from arrow_ballista_tpu.obs import device as device_obs
+
     from .queries import QUERIES
 
     ctx = make_context(args)
@@ -146,6 +148,7 @@ def cmd_benchmark(args) -> None:
     for q in queries:
         times = []
         rows = 0
+        dev_before = device_obs.STATS.snapshot()
         for it in range(args.iterations):
             t0 = time.perf_counter()
             out = ctx.sql(QUERIES[q]).collect()
@@ -154,10 +157,44 @@ def cmd_benchmark(args) -> None:
             times.append(dt)
             print(f"q{q} iteration {it}: {dt*1000:.1f} ms ({rows} rows)",
                   file=sys.stderr)
-        results.append({"query": q, "iterations": args.iterations,
-                        "min_ms": round(min(times) * 1000, 1),
-                        "avg_ms": round(sum(times) / len(times) * 1000, 1),
-                        "rows": rows})
+        dev_after = device_obs.STATS.snapshot()
+        device = {k: round(dev_after.get(k, 0) - dev_before.get(k, 0), 3)
+                  for k in ("jit_compiles", "jit_retraces",
+                            "jit_compile_time", "h2d_bytes", "d2h_bytes")}
+        entry = {"query": q, "iterations": args.iterations,
+                 "min_ms": round(min(times) * 1000, 1),
+                 "avg_ms": round(sum(times) / len(times) * 1000, 1),
+                 "rows": rows}
+        if device_obs.enabled():
+            entry["device"] = device
+            if device["jit_compiles"] + device["jit_retraces"]:
+                print(f"q{q} device: {device['jit_compiles']:.0f} compiles "
+                      f"+ {device['jit_retraces']:.0f} retraces, "
+                      f"{device['jit_compile_time']*1000:.0f} ms compiling",
+                      file=sys.stderr)
+        if device_obs.enabled() and getattr(args, "advise", False):
+            # opt-in: the advisor re-runs the query once under EXPLAIN
+            # ANALYZE, which would silently double a timing-only run.
+            # min_savings_ms=0 — a bench wants the ranked work-list even
+            # when the warm re-run measures only small dispatch overhead.
+            from arrow_ballista_tpu.obs.advisor import advise_report
+
+            try:
+                advice = advise_report(ctx.explain_analyze(QUERIES[q]),
+                                       min_savings_ms=0.0)
+                if advice["candidates"]:
+                    c = advice["candidates"][0]
+                    entry["advisor_top"] = {
+                        "stage_id": c["stage_id"],
+                        "operators": c["operators"],
+                        "est_savings_ms": c["est_savings_ms"]}
+                    print(f"q{q} advisor: fuse "
+                          + " -> ".join(c["operators"])
+                          + f" (~{c['est_savings_ms']:.1f} ms)",
+                          file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — advice never fails a run
+                print(f"q{q} advisor unavailable: {e}", file=sys.stderr)
+        results.append(entry)
     print(json.dumps({"command": "benchmark", "engine": args.engine,
                       "path": args.path, "results": results}))
     if hasattr(ctx, "shutdown"):
@@ -243,6 +280,9 @@ def main(argv=None) -> None:
     common(b)
     b.add_argument("--query", default=None, help="comma list; default all 22")
     b.add_argument("--iterations", type=int, default=3)
+    b.add_argument("--advise", action="store_true",
+                   help="run the stage-fusion advisor per query (one extra "
+                        "EXPLAIN ANALYZE execution each)")
 
     l = sub.add_parser("loadtest")
     common(l)

@@ -81,6 +81,12 @@ SAMPLES = {
                    process_id="pid-1",
                    spans=[Span("task", trace_id="t" * 32, span_id="s" * 16,
                                kind="executor", start_ms=1.0, end_ms=2.0)]),
+        TaskStatus(TaskId("job-1", 1, 2), "exec-1", "success",
+                   device_stats={"jit_compiles": 4, "jit_retraces": 1,
+                                 "jit_compile_time": 0.82,
+                                 "h2d_bytes": 17408, "d2h_bytes": 16392,
+                                 "device_mem_peak": 262144,
+                                 "host_mem_peak": 104857600}),
     ],
     FailedReason: [
         FailedReason(EXECUTION_ERROR, "boom"),
@@ -210,3 +216,16 @@ def test_scalarref_carries_dtype_for_planless_substitution():
     lit = _substitute_scalars(decoded, {"sq7": 12345})
     assert isinstance(lit, E.Lit)
     assert lit.value == 123.45
+
+
+def test_device_stats_key_absent_when_empty():
+    """Observatory-off statuses must be byte-identical to the pre-device
+    wire format: the device_stats key only appears when non-empty."""
+    bare = TaskStatus(TaskId("job-1", 4, 0), "exec-1", "success")
+    obj = serde.status_to_obj(bare)
+    assert "device_stats" not in obj
+    assert serde.status_from_obj(obj).device_stats == {}
+    carrying = TaskStatus(TaskId("job-1", 4, 1), "exec-1", "success",
+                          device_stats={"h2d_bytes": 1024})
+    assert serde.status_to_obj(carrying)["device_stats"] == \
+        {"h2d_bytes": 1024}

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Per-query perf regression gate over the driver's ``BENCH_r*.json`` rounds.
+
+Each round file records one bench run: ``{"n": <round>, "cmd", "rc", "tail",
+"parsed": {...}}`` where ``parsed`` carries the headline metric
+(``value``/``unit``/``vs_baseline``) plus nested per-suite timing dicts
+(``engine``, ``engine_mesh``, ``engine_sf10``, ``cpu.engine``, ...) whose
+``q<N>_ms`` keys are per-query wall times.
+
+The gate compares the newest round against the previous one, per query:
+
+* wall-time metric (``*_ms``):      regression when new > old * (1 + tol)
+* throughput metric (``rows/s``):   regression when new < old * (1 - tol)
+
+It is **warn-only by default** (always exits 0) because container bench
+numbers are noisy; ``--strict`` turns regressions into a nonzero exit for
+environments with stable hardware.  ``--json`` emits the machine-readable
+report instead of text.
+
+Usage::
+
+    python tools/perf_gate.py [--dir .] [--tolerance 0.25] [--json] [--strict]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# parsed[...] scalar keys that are environment probes, not workload results
+_NON_METRIC = {
+    "platform_rtt_ms",  # RTT probe of the accelerator link, not a query
+}
+
+
+def find_rounds(directory: str) -> List[Tuple[int, str]]:
+    """All ``BENCH_r<NN>.json`` files in *directory*, sorted by round."""
+    out = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def extract_metrics(parsed: dict) -> Dict[str, Tuple[float, str]]:
+    """Flatten a round's ``parsed`` dict into ``{name: (value, kind)}``.
+
+    ``kind`` is ``"ms"`` (lower is better) or ``"rows_per_sec"`` (higher is
+    better).  Nested suite dicts contribute dotted names (``engine.q1_ms``);
+    non-timing sub-structures (stage breakdowns, AQE event lists) are skipped.
+    """
+    metrics: Dict[str, Tuple[float, str]] = {}
+
+    def visit(prefix: str, obj) -> None:
+        if not isinstance(obj, dict):
+            return
+        for key, val in obj.items():
+            name = f"{prefix}{key}"
+            if isinstance(val, dict):
+                visit(f"{name}.", val)
+            elif isinstance(val, (int, float)) and not isinstance(val, bool):
+                if key in _NON_METRIC:
+                    continue
+                if key.endswith("_ms"):
+                    metrics[name] = (float(val), "ms")
+                elif key.endswith("rows_per_sec"):
+                    metrics[name] = (float(val), "rows_per_sec")
+
+    visit("", parsed)
+    # Headline metric: named by parsed["metric"], throughput-valued.
+    value = parsed.get("value")
+    if isinstance(value, (int, float)) and parsed.get("unit") == "rows/s":
+        metrics[parsed.get("metric", "headline")] = (float(value),
+                                                     "rows_per_sec")
+    return metrics
+
+
+def _load_round(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def compare(old: Dict[str, Tuple[float, str]],
+            new: Dict[str, Tuple[float, str]],
+            tolerance: float) -> dict:
+    """Per-metric comparison; only metrics present in both rounds gate."""
+    regressions, improvements, stable = [], [], []
+    for name in sorted(set(old) & set(new)):
+        old_v, kind = old[name]
+        new_v, _ = new[name]
+        if old_v <= 0:
+            continue
+        ratio = new_v / old_v
+        entry = {"metric": name, "kind": kind, "old": old_v, "new": new_v,
+                 "ratio": round(ratio, 4)}
+        if kind == "ms":
+            regressed = new_v > old_v * (1.0 + tolerance)
+            improved = new_v < old_v * (1.0 - tolerance)
+        else:  # rows_per_sec: higher is better
+            regressed = new_v < old_v * (1.0 - tolerance)
+            improved = new_v > old_v * (1.0 + tolerance)
+        (regressions if regressed else
+         improvements if improved else stable).append(entry)
+    return {"regressions": regressions, "improvements": improvements,
+            "stable": stable,
+            "compared": len(regressions) + len(improvements) + len(stable),
+            "only_old": sorted(set(old) - set(new)),
+            "only_new": sorted(set(new) - set(old))}
+
+
+def build_report(directory: str, tolerance: float) -> dict:
+    rounds = find_rounds(directory)
+    report = {"tolerance": tolerance, "status": "ok", "rounds": len(rounds)}
+    if len(rounds) < 2:
+        report["status"] = "skipped"
+        report["reason"] = (f"need >= 2 BENCH_r*.json rounds, "
+                            f"found {len(rounds)}")
+        return report
+    new_n, new_path = rounds[-1]
+    new_doc = _load_round(new_path)
+    if new_doc is None:
+        report["status"] = "skipped"
+        report["reason"] = f"unreadable round file: {new_path}"
+        return report
+    if new_doc.get("rc") not in (0, None):
+        report["status"] = "skipped"
+        report["reason"] = (f"newest round r{new_n} exited "
+                            f"rc={new_doc.get('rc')}; not comparable")
+        return report
+    # Baseline: the most recent *clean* prior round (timed-out or crashed
+    # rounds produce partial/absent parsed metrics and would gate on noise).
+    old_n = old_doc = None
+    for cand_n, cand_path in reversed(rounds[:-1]):
+        doc = _load_round(cand_path)
+        if doc is not None and doc.get("rc") in (0, None):
+            old_n, old_doc = cand_n, doc
+            break
+    if old_doc is None:
+        report["status"] = "skipped"
+        report["reason"] = "no clean (rc=0) prior round to compare against"
+        return report
+    report["old_round"], report["new_round"] = old_n, new_n
+    cmp = compare(extract_metrics(old_doc.get("parsed") or {}),
+                  extract_metrics(new_doc.get("parsed") or {}),
+                  tolerance)
+    report.update(cmp)
+    if not cmp["compared"]:
+        report["status"] = "skipped"
+        report["reason"] = "no metric present in both rounds"
+    elif cmp["regressions"]:
+        report["status"] = "regressed"
+    return report
+
+
+def render(report: dict) -> str:
+    lines = [f"perf gate: tolerance ±{report['tolerance'] * 100:.0f}%"]
+    if report["status"] == "skipped":
+        lines.append(f"  skipped: {report['reason']}")
+        return "\n".join(lines)
+    lines[0] += (f", r{report['old_round']:02d} -> r{report['new_round']:02d}"
+                 f" ({report['compared']} comparable metrics)")
+
+    def fmt(e):
+        unit = "ms" if e["kind"] == "ms" else "rows/s"
+        return (f"  {e['metric']}: {e['old']:.1f} -> {e['new']:.1f} {unit} "
+                f"({e['ratio']:.2f}x)")
+
+    if report["regressions"]:
+        lines.append(f"REGRESSIONS ({len(report['regressions'])}):")
+        lines.extend(fmt(e) for e in report["regressions"])
+    if report["improvements"]:
+        lines.append(f"improvements ({len(report['improvements'])}):")
+        lines.extend(fmt(e) for e in report["improvements"])
+    lines.append(f"stable: {len(report['stable'])}")
+    if report["only_new"]:
+        lines.append(f"new-only metrics (not gated): "
+                     f"{', '.join(report['only_new'])}")
+    if report["only_old"]:
+        lines.append(f"dropped metrics: {', '.join(report['only_old'])}")
+    lines.append(f"verdict: {report['status']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json round files")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="relative slack before a delta counts as a "
+                         "regression (default 0.25)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the JSON report instead of text")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on regressions (default: warn only)")
+    args = ap.parse_args(argv)
+
+    report = build_report(args.dir, args.tolerance)
+    print(json.dumps(report, indent=2) if args.json else render(report))
+    if args.strict and report["status"] == "regressed":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

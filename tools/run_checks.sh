@@ -7,7 +7,9 @@
 #      plan-validator cases, seeded-interleaving stress + lock-order shim
 #      units, exhaustive wire round-trips, speculation policy math and
 #      attempt-dedup races, runtime-stats folding / EXPLAIN ANALYZE /
-#      cluster history, AQE rewrites + rollback + serde),
+#      cluster history, device observatory: jit compile/retrace
+#      accounting, transfer bytes, watermarks, fusion advisor,
+#      AQE rewrites + rollback + serde),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
 #      quarantine, straggler speculation, corrupt-shuffle checksums) plus
@@ -26,7 +28,10 @@
 #   6. the fleet serving smoke (--smoke --shards 2): the same workload
 #      against a 2-shard scheduler fleet behind a shared KV, then a
 #      failover leg that crash-kills shard 0 mid-run — both legs must
-#      complete every query with zero errors.
+#      complete every query with zero errors,
+#   7. the perf gate (tools/perf_gate.py): newest BENCH_r*.json round vs
+#      the previous clean round, per-query wall time and throughput —
+#      warn-only here because container bench numbers are noisy.
 # tests/test_static_analysis.py also runs the lint suite inside tier-1, so
 # pytest alone still gates new violations; this script is the fast
 # standalone form for CI and pre-push hooks.
@@ -44,7 +49,7 @@ python docs/gen_configs.py --check
 echo "== analysis + concurrency + serde + speculation + observability + aqe test files =="
 python -m pytest tests/test_static_analysis.py tests/test_concurrency.py \
     tests/test_serde_wire.py tests/test_speculation.py \
-    tests/test_observatory.py tests/test_aqe.py \
+    tests/test_observatory.py tests/test_device_obs.py tests/test_aqe.py \
     -q -p no:cacheprovider
 
 echo "== chaos recovery + fleet HA suites (-m chaos, runtime lock-order validation on) =="
@@ -57,5 +62,10 @@ BALLISTA_LOCK_ORDER_RUNTIME=1 python -m benchmarks.serving --smoke
 
 echo "== fleet serving smoke (2 shards + mid-run shard-kill failover) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 python -m benchmarks.serving --smoke --shards 2
+
+echo "== perf gate (warn-only: bench rounds vs previous clean round) =="
+# Container bench numbers are noisy; the gate reports per-query regressions
+# but never fails CI here.  Use --strict on stable hardware.
+python tools/perf_gate.py || echo "perf gate: reporting failed (non-fatal)"
 
 echo "all checks passed"
