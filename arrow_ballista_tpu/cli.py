@@ -46,6 +46,8 @@ HELP = """\
 \\timing       toggle timing output
 \\advise SQL   run SQL and print the stage-fusion advisor report
               (device-observatory overhead ranked per operator chain)
+\\doctor [JOB] run the query doctor on JOB (default: the last job):
+              ranked pathology findings with evidence + config remedies
 anything else is executed as SQL.
 """
 
@@ -81,6 +83,11 @@ def run_command(ctx, line: str, timing: bool) -> bool:
         print(advice["text"])
         if timing:
             print(f"time: {time.perf_counter() - t0:.3f}s")
+        return timing
+    if cmd == "\\doctor" or cmd.startswith("\\doctor "):
+        job_id = cmd[len("\\doctor"):].strip() or None
+        diagnosis = ctx.doctor(job_id)
+        print(diagnosis["text"])
         return timing
     t0 = time.perf_counter()
     df = ctx.sql(cmd)

@@ -389,6 +389,38 @@ class BallistaContext:
             min_savings_ms=float(
                 self.config.get(OBS_DEVICE_ADVISOR_MIN_SAVINGS_MS)))
 
+    def forensics(self, job_id: Optional[str] = None) -> Dict:
+        """Assemble the self-contained forensics bundle for ``job_id``
+        (default: the last job this session ran): flight-recorder
+        timeline, stage stats, device stats, spans, AQE/speculation
+        records and scheduler metrics in one JSON artifact.  Same shape
+        as ``GET /api/job/<id>/forensics``.  Standalone engine only —
+        remote sessions read the scheduler's REST endpoint."""
+        from ..obs.doctor import assemble_forensics
+
+        if self._standalone is None:
+            raise PlanningError(
+                "forensics requires a standalone session; over a remote "
+                "connection read GET /api/job/<id>/forensics on the "
+                "scheduler's REST API instead")
+        job_id = job_id or self._standalone.last_job_id
+        if not job_id:
+            raise PlanningError("no job has run in this session yet")
+        bundle = assemble_forensics(self._standalone.scheduler, job_id)
+        if bundle is None:
+            raise PlanningError(f"job {job_id!r} is not known to the "
+                                "scheduler (or has aged out of retention)")
+        return bundle
+
+    def doctor(self, job_id: Optional[str] = None) -> Dict:
+        """Run the query doctor (obs/doctor.py) over ``job_id``'s
+        forensics bundle: ranked pathology findings with cited metric
+        evidence and config-knob remedies.  The ``"text"`` key holds the
+        rendered diagnosis.  Same shape as ``GET /api/job/<id>/doctor``."""
+        from ..obs.doctor import diagnose
+
+        return diagnose(self.forensics(job_id))
+
     def _explain_analyze_statement(self, stmt: "ast.Node") -> Dict:
         """Plan + run one SELECT and build the annotated report.  The
         standalone engine reads the retained ExecutionGraph's stats store

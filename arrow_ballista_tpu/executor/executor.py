@@ -100,6 +100,21 @@ class Executor:
 
         device_obs.set_enabled(bool(self.config.get(OBS_DEVICE_ENABLED)))
         device_obs.set_watermarks(bool(self.config.get(OBS_DEVICE_WATERMARKS)))
+        # flight recorder: enable-only (never force-off — in-proc standalone
+        # executors share the scheduler's process-global journal, and a
+        # default-config executor must not stomp a test's explicit enable)
+        from ..obs import journal
+        from ..utils.config import (JOURNAL_CAPACITY, JOURNAL_ENABLED,
+                                    JOURNAL_SPILL_PATH, env_flag)
+
+        if env_flag("BALLISTA_JOURNAL") \
+                or bool(self.config.get(JOURNAL_ENABLED)):
+            journal.set_enabled(True)
+            journal.configure(
+                capacity=int(self.config.get(JOURNAL_CAPACITY)),
+                spill_path=str(self.config.get(JOURNAL_SPILL_PATH)))
+        if journal.enabled() and not journal.actor():
+            journal.set_actor(metadata.executor_id)
 
     # --- task execution --------------------------------------------------
     def run_task(self, task: TaskDescription) -> TaskStatus:
@@ -130,11 +145,29 @@ class Executor:
                        "lane": f"stage {tid.stage_id} / p{tid.partition}"})
         t0 = time.perf_counter()
         from ..obs import device as device_obs
+        from ..obs import journal
+        from ..utils.logsetup import log_scope
 
-        with device_obs.task_scope() as dev_acc:
+        _trace = task.trace or {}
+        with log_scope(job_id=tid.job_id,
+                       trace_id=str(_trace.get("trace_id") or ""),
+                       span_id=str(_trace.get("span_id") or "")), \
+                device_obs.task_scope() as dev_acc, \
+                journal.task_scope() as jbuf:
+            if jbuf is not None:
+                journal.emit("task.run", job_id=tid.job_id,
+                             stage_id=tid.stage_id, partition=tid.partition,
+                             attempt=tid.task_attempt,
+                             executor_id=self.metadata.executor_id,
+                             speculative=tid.speculative)
             status = self._run_task_inner(task, launch_ms, recorder)
         if dev_acc is not None:
             status.device_stats = dev_acc.snapshot()
+        if jbuf:
+            # ship the task's flight-record buffer piggyback on the status
+            # (merged into the job timeline scheduler-side); empty buffer =
+            # no wire key, same contract as device_stats
+            status.journal = jbuf
         if recorder is not None:
             if status.shuffle_writes:
                 recorder.annotate(

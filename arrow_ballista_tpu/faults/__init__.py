@@ -269,6 +269,7 @@ def inject(site: str, **ctx) -> Optional[FaultRule]:
     rule = plan.evaluate(site, ctx)
     if rule is None:
         return None
+    _journal_fire(site, rule, ctx)
     if rule.action == "delay":
         time.sleep(rule.delay_ms / 1000.0)
         return rule
@@ -277,6 +278,23 @@ def inject(site: str, **ctx) -> Optional[FaultRule]:
     if rule.action == "kill":
         _do_kill(site, rule, ctx)
     return rule  # drop / corrupt: caller's responsibility
+
+
+def _journal_fire(site: str, rule: FaultRule, ctx: Dict[str, Any]) -> None:
+    """Record a fired injection in the flight recorder (chaos postmortems
+    correlate the fault schedule with the decisions it provoked).  Emitted
+    BEFORE the action executes, so raise/kill firings are recorded too."""
+    from ..obs import journal
+
+    if not journal.enabled():
+        return
+    attrs: Dict[str, Any] = {"site": site, "action": rule.action,
+                             "hit": rule.hits}
+    for k in ("executor_id", "stage_id", "scheduler_id"):
+        if k in ctx:
+            attrs[k] = ctx[k]
+    journal.emit("fault.fired", job_id=str(ctx.get("job_id", "") or ""),
+                 **attrs)
 
 
 def dropped(site: str, **ctx) -> bool:

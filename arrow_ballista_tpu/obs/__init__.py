@@ -34,3 +34,10 @@ from .stats import (  # noqa: F401
     stage_summary,
 )
 from .trace_event import spans_to_chrome  # noqa: F401
+from .journal import JournalEvent  # noqa: F401
+from .doctor import (  # noqa: F401
+    assemble_forensics,
+    diagnose,
+    render_diagnosis,
+    validate_bundle,
+)

@@ -1,0 +1,361 @@
+"""Flight recorder: an append-only, causally-ordered event journal.
+
+The engine's telemetry (spans, stage stats, device accounting) answers
+"how long did things take"; the journal answers "what actually happened,
+in what order, and why" — every consequential control-plane decision is
+one event: job lifecycle transitions, stage resolution, task
+launch/finish/cancel per attempt, AQE rewrites, speculation launches and
+wins, plan/result-cache hits and misses, quarantine and lease
+transitions, failpoint firings.
+
+Design (mirrors obs/device.py's cost discipline):
+
+- **Near-zero cost when off.**  Every entry point is one module-global
+  predicate check; call sites guard with ``journal.enabled()`` before
+  building attrs, so the disabled hot path allocates nothing.
+- **Lock-free ring.**  Events are plain dicts appended to a bounded
+  ``deque(maxlen=...)`` — append/evict is GIL-atomic, same idiom as
+  ``ClusterHistory``.  Seq numbers come from ``itertools.count`` (also
+  GIL-atomic), monotonic per process.
+- **Causal order.**  Each event carries ``seq`` (monotonic per actor)
+  and an optional ``parent`` seq: lifecycle events chain per job, and a
+  task-finish event points at its launch via the causal-key registry
+  (``causal_key=`` on the start event, ``parent_key=`` on the end).
+- **Per-job timelines.**  The scheduler keeps one bounded timeline per
+  job (merged from its own events plus executor events shipped
+  piggyback on ``TaskStatus.journal``); ``job_timeline()`` feeds the
+  forensics bundle and the graph checkpoint, so the record survives
+  fleet failover.  Events are epoch-tagged (``set_job_epoch`` at lease
+  acquire/adopt), marking the fencing epoch each decision ran under.
+- **Optional JSONL spill.**  ``ballista.journal.spill_path`` appends
+  every event as one JSON line (file writes take a small lock; the ring
+  stays lock-free).
+
+Config: ``ballista.journal.enabled`` / ``.capacity`` / ``.spill_path``.
+Wire: executor events ride ``TaskStatus.journal`` only when non-empty,
+so disabled mode is byte-identical to the pre-journal format (same
+contract as ``device_stats``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# process-wide switches; flipped from config by Executor.__init__ /
+# SchedulerServer wiring (module default matches the config default)
+_enabled = False
+_capacity = 4096
+_actor = ""           # scheduler_id / executor process identity
+_spill_path = ""
+_spill_lock = threading.Lock()
+_spill_fh = None
+
+#: most recent jobs whose timelines are retained (forensics window)
+_JOB_RETAIN = 256
+
+_seq = itertools.count(1)
+# counters behind journal_events_total / journal_events_dropped_total;
+# plain int += under the GIL — a lost increment under a pathological race
+# is acceptable for monitoring counters (same tolerance as ObservedJit's
+# unlocked key-set membership)
+_emitted = 0
+_dropped = 0
+
+_ring: deque = deque(maxlen=_capacity)
+# job_id -> bounded timeline (insertion order doubles as LRU for retention)
+_jobs: Dict[str, deque] = {}
+# job_id -> current lease/fencing epoch stamped onto that job's events
+_job_epochs: Dict[str, int] = {}
+# causal-key registry: (job_id, ...) -> seq of the "start" event
+_causal: Dict[tuple, int] = {}
+
+_tls = threading.local()
+
+
+@dataclasses.dataclass
+class JournalEvent:
+    """Typed wire shape of one journal event (serde.WIRE_TYPES entry).
+
+    Internally the journal stores plain dicts (one allocation per event,
+    wire-ready); this dataclass is the schema contract the serde layer
+    round-trips."""
+
+    seq: int
+    ts_ms: int
+    kind: str
+    actor: str = ""
+    job_id: str = ""
+    epoch: int = 0
+    parent: int = 0
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(capacity: Optional[int] = None,
+              spill_path: Optional[str] = None,
+              actor: Optional[str] = None) -> None:
+    """Apply config-derived settings (idempotent; resizing the ring keeps
+    the newest events)."""
+    global _capacity, _ring, _spill_path, _spill_fh, _actor
+    if capacity is not None and int(capacity) != _capacity:
+        _capacity = max(1, int(capacity))
+        _ring = deque(_ring, maxlen=_capacity)
+    if actor is not None:
+        _actor = str(actor)
+    if spill_path is not None and str(spill_path) != _spill_path:
+        with _spill_lock:
+            if _spill_fh is not None:
+                try:
+                    _spill_fh.close()
+                except Exception:  # noqa: BLE001 — spill is best-effort
+                    pass
+                _spill_fh = None
+            _spill_path = str(spill_path)
+
+
+def set_actor(name: str) -> None:
+    global _actor
+    _actor = str(name)
+
+
+def actor() -> str:
+    return _actor
+
+
+def counters() -> Tuple[int, int]:
+    """(events_total, events_dropped_total) for the metrics exposition."""
+    return _emitted, _dropped
+
+
+def reset() -> None:
+    """Test hook: drop all state, keep the enable flag."""
+    global _emitted, _dropped, _ring, _seq
+    _emitted = 0
+    _dropped = 0
+    _seq = itertools.count(1)
+    _ring = deque(maxlen=_capacity)
+    _jobs.clear()
+    _job_epochs.clear()
+    _causal.clear()
+
+
+# --------------------------------------------------------------------------
+# emission
+# --------------------------------------------------------------------------
+
+def emit(kind: str, job_id: str = "", parent: Optional[int] = None,
+         causal_key: Optional[tuple] = None,
+         parent_key: Optional[tuple] = None,
+         epoch: Optional[int] = None, **attrs) -> Optional[int]:
+    """Record one event; returns its seq (None when the journal is off).
+
+    ``causal_key`` registers this event's seq so a later event can chain
+    to it with ``parent_key``; lifecycle chains pass the same tuple as
+    both (each event becomes the next one's parent)."""
+    if not _enabled:
+        return None
+    if parent is None and parent_key is not None:
+        parent = _causal.get(parent_key)
+    seq = next(_seq)
+    ev: Dict[str, Any] = {"seq": seq, "ts_ms": int(time.time() * 1000),
+                          "kind": kind}
+    if _actor:
+        ev["actor"] = _actor
+    if job_id:
+        ev["job_id"] = job_id
+        ep = epoch if epoch is not None else _job_epochs.get(job_id, 0)
+        if ep:
+            ev["epoch"] = ep
+    if parent:
+        ev["parent"] = parent
+    if attrs:
+        ev["attrs"] = attrs
+    if causal_key is not None:
+        _causal[causal_key] = seq
+    _append(ev, job_id)
+    buf = getattr(_tls, "buf", None)
+    if buf is not None:
+        buf.append(ev)
+    return seq
+
+
+def emit_job(kind: str, job_id: str, **attrs) -> Optional[int]:
+    """A job-lifecycle event: chained to the job's previous lifecycle
+    event and registered as the next one's parent."""
+    key = ("job", job_id)
+    return emit(kind, job_id=job_id, causal_key=key, parent_key=key, **attrs)
+
+
+def _append(ev: Dict[str, Any], job_id: str) -> None:
+    global _emitted, _dropped
+    _emitted += 1
+    if len(_ring) >= _capacity:
+        _dropped += 1
+    _ring.append(ev)
+    if job_id:
+        tl = _jobs.get(job_id)
+        if tl is None:
+            tl = _jobs[job_id] = deque(maxlen=_capacity)
+            _evict_jobs()
+        elif len(tl) >= _capacity:
+            _dropped += 1
+        tl.append(ev)
+    if _spill_path:
+        _spill(ev)
+
+
+def _evict_jobs() -> None:
+    while len(_jobs) > _JOB_RETAIN:
+        victim = next(iter(_jobs))
+        _jobs.pop(victim, None)
+        _job_epochs.pop(victim, None)
+        # causal keys always embed the job id (("job", jid) /
+        # ("task", jid, ...)), so membership is the prune predicate
+        for k in [k for k in _causal if victim in k]:
+            _causal.pop(k, None)
+
+
+def _spill(ev: Dict[str, Any]) -> None:
+    global _spill_fh
+    with _spill_lock:
+        try:
+            if _spill_fh is None:
+                _spill_fh = open(_spill_path, "a", encoding="utf-8")
+            _spill_fh.write(json.dumps(ev, separators=(",", ":"),
+                                       default=str) + "\n")
+            _spill_fh.flush()
+        except Exception:  # noqa: BLE001 — spill is best-effort
+            _spill_fh = None
+
+
+# --------------------------------------------------------------------------
+# per-job timelines (scheduler side) + executor piggyback intake
+# --------------------------------------------------------------------------
+
+def job_timeline(job_id: str) -> List[Dict[str, Any]]:
+    """The merged per-job timeline (own events + absorbed executor
+    events), oldest first.  Empty when the journal is off or the job has
+    aged out of the retention window."""
+    tl = _jobs.get(job_id)
+    return list(tl) if tl is not None else []
+
+
+def seed_job(job_id: str, events: List[Dict[str, Any]]) -> None:
+    """Restore a checkpointed timeline (fleet adoption: the new owner
+    continues the ex-owner's record under the same job id)."""
+    if not _enabled or not events:
+        return
+    tl = _jobs.get(job_id)
+    if tl is None:
+        tl = _jobs[job_id] = deque(maxlen=_capacity)
+        _evict_jobs()
+    have = {(e.get("actor", ""), e.get("seq", 0)) for e in tl}
+    for ev in events:
+        if (ev.get("actor", ""), ev.get("seq", 0)) not in have:
+            tl.append(dict(ev))
+
+
+def absorb(job_id: str, events: List[Dict[str, Any]]) -> int:
+    """Merge executor-shipped events (``TaskStatus.journal``) into the
+    job's timeline + the global ring.  Returns the number absorbed.
+
+    Dedups on (actor, seq): in-proc standalone executors share this
+    process-global journal, so their events already landed in the
+    timeline at emit time — the piggyback copy must not double them.
+    Remote executors carry a different actor, so theirs always merge."""
+    if not _enabled or not events:
+        return 0
+    global _emitted, _dropped
+    tl = _jobs.get(job_id)
+    if tl is None:
+        tl = _jobs[job_id] = deque(maxlen=_capacity)
+        _evict_jobs()
+    have = {(e.get("actor", ""), e.get("seq", 0)) for e in tl}
+    n = 0
+    for ev in events:
+        if (ev.get("actor", ""), ev.get("seq", 0)) in have:
+            continue
+        _emitted += 1
+        if len(tl) >= _capacity:
+            _dropped += 1
+        tl.append(ev)
+        _ring.append(ev)
+        n += 1
+    return n
+
+
+def set_job_epoch(job_id: str, epoch: int) -> None:
+    """Stamp subsequent events for ``job_id`` with the given fencing
+    epoch (lease acquire/adopt call this; 0 clears)."""
+    if not _enabled:
+        return
+    if epoch:
+        _job_epochs[job_id] = int(epoch)
+    else:
+        _job_epochs.pop(job_id, None)
+
+
+# --------------------------------------------------------------------------
+# executor task scope: buffer events for the TaskStatus piggyback
+# --------------------------------------------------------------------------
+
+class _TaskScope:
+    __slots__ = ("events",)
+
+    def __init__(self):
+        self.events: List[Dict[str, Any]] = []
+
+    def __enter__(self) -> List[Dict[str, Any]]:
+        _tls.buf = self.events
+        return self.events
+
+    def __exit__(self, *exc) -> bool:
+        _tls.buf = None
+        return False
+
+
+class _NullTaskScope:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_TASK = _NullTaskScope()
+
+
+def task_scope():
+    """Collect events emitted on this thread for one task run; yields the
+    buffer (``TaskStatus.journal`` when non-empty) or None when off."""
+    if not _enabled:
+        return _NULL_TASK
+    return _TaskScope()
+
+
+# --------------------------------------------------------------------------
+# snapshot / exposition
+# --------------------------------------------------------------------------
+
+def snapshot(limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The newest ``limit`` events of the process-global ring (all when
+    None), oldest first."""
+    out = list(_ring)
+    if limit is not None and len(out) > limit:
+        out = out[-limit:]
+    return out

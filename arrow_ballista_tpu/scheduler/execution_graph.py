@@ -32,6 +32,7 @@ from ..ops.shuffle import (
     ShuffleWritePartition,
     ShuffleWriterExec,
 )
+from ..obs import journal
 from ..obs.stats import RuntimeStatsStore
 from ..utils.errors import InternalError
 from .aqe import AqePolicy, maybe_broadcast_switch, rewrite_resolved_stage
@@ -409,6 +410,11 @@ class ExecutionGraph:
                         stage.maybe_coalesce()
                 stage.state = RUNNING
                 changed = True
+                if journal.enabled():
+                    journal.emit("stage.resolved", job_id=self.job_id,
+                                 stage_id=stage.stage_id,
+                                 partitions=stage.partitions,
+                                 producers=list(stage.producer_ids))
         return changed
 
     def preload_stage(self, stage_id: int,
@@ -461,6 +467,17 @@ class ExecutionGraph:
                      task_attempt=info.attempt,
                      stage_attempt=stage.stage_attempt,
                      speculative=info.speculative)
+        if journal.enabled():
+            # the single mint point for every launch (normal + speculative):
+            # registers the causal key the scheduler's task.finish event
+            # chains back to
+            journal.emit("task.launch", job_id=self.job_id,
+                         causal_key=("task", self.job_id, stage.stage_id,
+                                     info.partition, info.attempt),
+                         stage_id=stage.stage_id, partition=info.partition,
+                         attempt=info.attempt,
+                         executor_id=info.executor_id,
+                         speculative=info.speculative)
         return TaskDescription(tid, stage.resolved_plan,
                                task_internal_id=next(self._task_id_gen),
                                scalars=self.scalars,

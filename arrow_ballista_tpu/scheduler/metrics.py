@@ -70,6 +70,10 @@ class SchedulerMetricsCollector:
     def record_plan_cache_miss(self) -> None: ...
     def record_result_cache_hit(self) -> None: ...
     def record_cache_eviction(self) -> None: ...
+    # flight recorder (obs/journal.py): events accepted into / evicted
+    # from the journal ring + per-job timelines
+    def record_journal_events(self, n: int) -> None: ...
+    def record_journal_dropped(self, n: int) -> None: ...
     def gather(self) -> str:
         return ""
 
@@ -110,6 +114,8 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.plan_cache_misses = 0
         self.result_cache_hits = 0
         self.cache_evictions = 0
+        self.journal_events = 0
+        self.journal_dropped = 0
         # fleet-wide device-observatory fold (TaskStatus.device_stats
         # intake): counters sum across every task the fleet absorbed,
         # watermarks keep the max any single task reported
@@ -231,6 +237,45 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.cache_evictions += 1
 
+    def record_journal_events(self, n):
+        with self._lock:
+            self.journal_events += n
+
+    def record_journal_dropped(self, n):
+        with self._lock:
+            self.journal_dropped += n
+
+    def counters_snapshot(self) -> Dict[str, float]:
+        """Plain-dict view of the scalar counters/gauges (the forensics
+        bundle embeds this so the doctor's cache/churn rules read metric
+        values, not prometheus text)."""
+        with self._lock:
+            return {
+                "job_submitted_total": self.submitted,
+                "job_completed_total": self.completed,
+                "job_failed_total": self.failed,
+                "job_cancelled_total": self.cancelled,
+                "plan_cache_hits": self.plan_cache_hits,
+                "plan_cache_misses": self.plan_cache_misses,
+                "result_cache_hits": self.result_cache_hits,
+                "cache_evictions": self.cache_evictions,
+                "speculative_launched": self.speculative_launched,
+                "speculative_wins": self.speculative_wins,
+                "quarantined_total": self.quarantined_total,
+                "quarantined_executors": self.quarantined_executors,
+                "integrity_failures": self.integrity_failures,
+                "aqe_coalesced": self.aqe_coalesced,
+                "aqe_broadcast_switches": self.aqe_broadcast_switches,
+                "aqe_skew_splits": self.aqe_skew_splits,
+                "device_jit_compiles": self.device_jit_compiles,
+                "device_jit_retraces": self.device_jit_retraces,
+                "device_compile_seconds":
+                    round(self.device_compile_seconds, 6),
+                "event_loop_lag_s": self.event_loop_lag_s,
+                "journal_events": self.journal_events,
+                "journal_dropped": self.journal_dropped,
+            }
+
     def gather(self) -> str:
         with self._lock:
             lines = []
@@ -284,6 +329,13 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             counter("cache_evictions_total", self.cache_evictions,
                     "plan templates and result/subplan entries evicted by "
                     "the serving caches' LRU byte/entry budgets")
+            counter("journal_events_total", self.journal_events,
+                    "flight-recorder events accepted into the scheduler's "
+                    "journal (own emissions + executor events absorbed "
+                    "from TaskStatus piggybacks)")
+            counter("journal_events_dropped_total", self.journal_dropped,
+                    "flight-recorder events evicted from the bounded "
+                    "journal ring or a per-job timeline at capacity")
             counter("fleet_device_jit_compiles_total",
                     self.device_jit_compiles,
                     "first-time XLA compilations reported by completed "

@@ -188,6 +188,20 @@ class SchedulerNetService:
         # arm the failpoint plan (no-op unless ballista.faults.plan or
         # BALLISTA_FAULTS_PLAN is set) before any instrumented site runs
         faults.configure(self.config)
+        # flight recorder: honour the session config here — SchedulerServer
+        # itself only sees process defaults/env.  Enable-only (a journal a
+        # test already turned on stays on), and before SchedulerServer is
+        # built so its init names the actor.
+        from ..utils.config import (JOURNAL_CAPACITY, JOURNAL_ENABLED,
+                                    JOURNAL_SPILL_PATH)
+
+        if bool(self.config.get(JOURNAL_ENABLED)):
+            from ..obs import journal
+
+            journal.set_enabled(True)
+            journal.configure(
+                capacity=int(self.config.get(JOURNAL_CAPACITY)),
+                spill_path=str(self.config.get(JOURNAL_SPILL_PATH)))
         if scheduler_config is None:
             # honour the session config's cluster keys when the caller did
             # not hand us an explicit SchedulerConfig — one timeout key

@@ -24,7 +24,15 @@ Beyond the reference surface:
     GET  /api/job/<id>/advise  stage-fusion advisor: operator chains ranked
                                by estimated fusion savings (obs/advisor.py)
     GET  /api/cluster/history  ring-buffer time series of cluster samples
-                               (utilization, queue depths, event-loop lag)
+                               (utilization, queue depths, event-loop lag),
+                               fleet-aware: per-shard breakdown + rollup
+                               via the shared-KV shard registry
+    GET  /api/job/<id>/forensics  self-contained postmortem bundle: flight-
+                               recorder timeline + stage stats + device
+                               stats + spans + metrics (obs/doctor.py)
+    GET  /api/job/<id>/doctor  automated pathology diagnosis over the
+                               forensics bundle: ranked findings with
+                               cited metric evidence + config remedies
     GET  /api/plan-cache       prepared-plan cache: hit/miss/eviction
                                counters, budgets, recent templates
     GET  /api/result-cache     result/subplan cache counters + budgets
@@ -41,6 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..obs.advisor import advise_graph
+from ..obs.doctor import assemble_forensics, diagnose
 from ..obs.stats import explain_analyze_report
 from .graph_dot import graph_to_dot
 from .scheduler import SchedulerServer
@@ -150,10 +159,20 @@ class RestApi:
                 h._send(404, json.dumps({"error": "no such job"}))
             else:
                 h._send(200, json.dumps(advise_graph(graph)))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "forensics":
+            bundle = assemble_forensics(self.server, rest[1])
+            if bundle is None:
+                h._send(404, json.dumps({"error": "no such job"}))
+            else:
+                h._send(200, json.dumps(bundle, default=str))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "doctor":
+            bundle = assemble_forensics(self.server, rest[1])
+            if bundle is None:
+                h._send(404, json.dumps({"error": "no such job"}))
+            else:
+                h._send(200, json.dumps(diagnose(bundle), default=str))
         elif rest == ["cluster", "history"]:
-            hist = self.server.history.snapshot()
-            hist["now"] = self.server.cluster_sample()
-            h._send(200, json.dumps(hist))
+            h._send(200, json.dumps(self.server.cluster_history()))
         elif len(rest) == 3 and rest[0] == "job" and rest[2] == "dot":
             graph = self.server.jobs.get_graph(rest[1])
             if graph is None:
@@ -161,6 +180,9 @@ class RestApi:
             else:
                 h._send(200, graph_to_dot(graph), ctype="text/vnd.graphviz")
         elif rest == ["metrics"]:
+            # fold the latest journal counter deltas in before exposition
+            # (the history sampler also does this on its own cadence)
+            self.server.sync_journal_metrics()
             h._send(200, self.server.metrics.gather(), ctype="text/plain")
         elif rest == ["admission"]:
             h._send(200, json.dumps(self.server.admission.snapshot()))

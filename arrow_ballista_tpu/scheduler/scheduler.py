@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .. import faults
 from ..admission import AdmissionController, AdmissionRequest
 from ..analysis.plan_checks import validate_graph
+from ..obs import journal
 from ..utils.config import ANALYSIS_PLAN_CHECKS
 from .aqe import AqePolicy
 from .cluster import ClusterState, JobState
@@ -273,6 +274,27 @@ class SchedulerServer:
         # backends + try_acquire_job)
         self.job_backend = job_backend
         self.scheduler_id = scheduler_id or f"scheduler-{uuid.uuid4().hex[:8]}"
+        # flight recorder (obs/journal.py): enable-only switch — a shard
+        # never force-disables a journal a test/session already turned on
+        # (standalone runs share one process-global journal across the
+        # scheduler and its in-proc executors)
+        from ..utils.config import (BallistaConfig, JOURNAL_CAPACITY,
+                                    JOURNAL_ENABLED, JOURNAL_SPILL_PATH,
+                                    env_flag)
+        _defaults = BallistaConfig()
+        if env_flag("BALLISTA_JOURNAL") or bool(_defaults.get(JOURNAL_ENABLED)):
+            journal.set_enabled(True)
+        if journal.enabled():
+            journal.configure(
+                capacity=int(_defaults.get(JOURNAL_CAPACITY)),
+                spill_path=str(_defaults.get(JOURNAL_SPILL_PATH)))
+            if not journal.actor():
+                # first process identity wins (in-proc fleets share one
+                # journal; lease events carry scheduler_id explicitly)
+                journal.set_actor(self.scheduler_id)
+        # delta base for sync_journal_metrics (journal counters are
+        # process-global; this collector folds only deltas it hasn't seen)
+        self._journal_last = (0, 0)  # ballista: guarded-by=none
         # fleet HA: lease-capable backends (KvJobStateBackend) get epoch-
         # fenced TTL ownership; file/legacy backends keep the PR-4 lock path
         self._lease_capable = job_backend is not None \
@@ -468,6 +490,8 @@ class SchedulerServer:
         storage, validation skipping, subplan preload and result capture."""
         self.jobs.accept_job(job_id)
         self.obs.on_submitted(job_id, trace)
+        if journal.enabled():
+            journal.emit_job("job.submitted", job_id)
         with self._meta_lock:
             if config is not None:
                 self._job_configs[job_id] = config
@@ -481,6 +505,8 @@ class SchedulerServer:
         if self._stopped.is_set():
             return
         self.obs.on_admitted(job_id)
+        if journal.enabled():
+            journal.emit_job("job.admitted", job_id)
         self._event_loop.post(JobQueued(job_id, plan_fn))
 
     def _admission_reject(self, job_id: str, message: str) -> None:
@@ -490,6 +516,8 @@ class SchedulerServer:
         with self._meta_lock:
             self._queued_at_ms.pop(job_id, None)
             self._job_configs.pop(job_id, None)
+        if journal.enabled():
+            journal.emit_job("job.shed", job_id, reason=message)
         self.jobs.set_status(JobStatus(job_id, "failed", error=message,
                                        retriable=True))
         self.metrics.record_failed(job_id)
@@ -579,6 +607,18 @@ class SchedulerServer:
             self.metrics.record_failed(job_id)
 
     def _on_event(self, event: object) -> None:
+        # log <-> trace correlation: job-scoped events stamp their job id
+        # onto every record the handler emits (utils/logsetup.ContextFilter)
+        job_id = getattr(event, "job_id", "")
+        if job_id:
+            from ..utils.logsetup import log_scope
+
+            with log_scope(job_id=job_id):
+                self._dispatch_event(event)
+        else:
+            self._dispatch_event(event)
+
+    def _dispatch_event(self, event: object) -> None:
         if isinstance(event, JobQueued):
             self._on_job_queued(event)
         elif isinstance(event, JobPlanned):
@@ -726,12 +766,17 @@ class SchedulerServer:
 
     def _on_job_planned(self, ev: JobPlanned) -> None:
         if ev.graph is None:
+            if journal.enabled():
+                journal.emit_job("job.plan_failed", ev.job_id, error=ev.error)
             self.jobs.set_status(JobStatus(ev.job_id, "failed", error=ev.error))
             self.metrics.record_failed(ev.job_id)
             with self._meta_lock:
                 self._queued_at_ms.pop(ev.job_id, None)
             return
         self.obs.on_planned(ev.job_id)
+        if journal.enabled():
+            journal.emit_job("job.planned", ev.job_id,
+                             stages=len(ev.graph.stages))
         # hand the execution span's context to every task of this job
         ev.graph.trace = self.obs.task_parent(ev.job_id)
         self.jobs.submit_job(ev.job_id, ev.graph)
@@ -748,6 +793,10 @@ class SchedulerServer:
         driving the job; plain persistence failures stay best-effort."""
         if self.job_backend is None:
             return True
+        if journal.enabled():
+            # the checkpoint carries the job's merged timeline, so the
+            # flight record survives failover (the adopter seeds from it)
+            graph.journal = journal.job_timeline(graph.job_id)
         if not self._lease_capable:
             try:
                 self.job_backend.try_acquire_job(graph.job_id,
@@ -788,6 +837,10 @@ class SchedulerServer:
             return None
         with self._lease_lock:
             self._leases[job_id] = lease.epoch
+        if journal.enabled():
+            journal.set_job_epoch(job_id, lease.epoch)
+            journal.emit_job("lease.acquire", job_id, epoch=lease.epoch,
+                             scheduler_id=self.scheduler_id)
         return lease.epoch
 
     def _release_lease(self, job_id: str) -> None:
@@ -812,6 +865,12 @@ class SchedulerServer:
             return
         log.warning("lost lease on job %s (%s): abandoning local drive",
                     job_id, why)
+        if journal.enabled():
+            # emitted BEFORE the epoch clears, so the stand-down is stamped
+            # with the fenced-off epoch this shard last held
+            journal.emit_job("lease.stand_down", job_id, why=why,
+                             scheduler_id=self.scheduler_id)
+            journal.set_job_epoch(job_id, 0)
         # retain this shard's half of the job trace with a stand-down
         # marker before the job is dropped locally (the adopter's spans
         # continue the same trace_id via the checkpointed context)
@@ -875,6 +934,10 @@ class SchedulerServer:
                     if self.job_backend.renew_lease(
                             job_id, self.scheduler_id, epoch) is None:
                         self._on_lease_lost(job_id, "renewal refused")
+                    elif journal.enabled():
+                        journal.emit("lease.renew", job_id=job_id,
+                                     epoch=epoch,
+                                     scheduler_id=self.scheduler_id)
                 except Exception:  # noqa: BLE001 — KV blip; TTL still runs
                     log.exception("lease renewal failed for %s", job_id)
             self._publish_registry()
@@ -946,6 +1009,16 @@ class SchedulerServer:
         graph.addr_resolver = self._resolve_addr
         self.jobs.accept_job(job_id)
         self.jobs.submit_job(job_id, graph)
+        if journal.enabled():
+            # continue the ex-owner's flight record under the same job id
+            # (the checkpoint carried its timeline), then mark the
+            # ownership change at the new fencing epoch
+            journal.seed_job(job_id,
+                             list(getattr(graph, "journal", []) or []))
+            journal.set_job_epoch(job_id, lease.epoch)
+            journal.emit_job("lease.adopt", job_id, epoch=lease.epoch,
+                             prev_owner=prev_owner,
+                             scheduler_id=self.scheduler_id)
         # trace continuity across the failover: open this shard's side of
         # the job trace (same trace_id as the ex-owner when the checkpoint
         # carried it) with the fencing epoch annotated, then re-parent the
@@ -989,9 +1062,13 @@ class SchedulerServer:
                 with self._meta_lock:
                     self._queued_at_ms.pop(ev.job_id, None)
                     self._job_configs.pop(ev.job_id, None)
+                if journal.enabled():
+                    journal.emit_job("job.cancelled", ev.job_id, queued=True)
                 self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
                 self.metrics.record_cancelled(ev.job_id)
             return
+        if journal.enabled():
+            journal.emit_job("job.cancelled", ev.job_id)
         graph.cancel()
         self.jobs.set_status(JobStatus(ev.job_id, "cancelled"))
         self.metrics.record_cancelled(ev.job_id)
@@ -1105,6 +1182,21 @@ class SchedulerServer:
                 # fleet-wide device-observatory fold: each status carries
                 # the task's own delta, so summing on intake is exact
                 self.metrics.record_device_stats(st.device_stats)
+            if st.journal:
+                # executor flight-record piggyback: merge into the job's
+                # timeline (wire contract mirrors device_stats)
+                journal.absorb(st.task.job_id, st.journal)
+            if journal.enabled():
+                journal.emit("task.finish", job_id=st.task.job_id,
+                             parent_key=("task", st.task.job_id,
+                                         st.task.stage_id,
+                                         st.task.partition,
+                                         st.task.task_attempt),
+                             stage_id=st.task.stage_id,
+                             partition=st.task.partition,
+                             attempt=st.task.task_attempt,
+                             state=st.state,
+                             executor_id=st.executor_id or executor_id)
             by_job.setdefault(st.task.job_id, []).append(st)
         for job_id, sts in by_job.items():
             graph = self.jobs.get_graph(job_id)
@@ -1162,6 +1254,11 @@ class SchedulerServer:
                         "data (%s)", st.failure.executor_id,
                         st.failure.message)
                     self.metrics.record_quarantined(st.failure.executor_id)
+                    if journal.enabled():
+                        journal.emit("quarantine.enter",
+                                     job_id=st.task.job_id,
+                                     executor_id=st.failure.executor_id,
+                                     reason="corrupt shuffle data")
             elif (st.state == "failed" and st.failure is not None
                   and st.failure.retryable):
                 if self.quarantine.record_failure(eid):
@@ -1171,6 +1268,11 @@ class SchedulerServer:
                         self.quarantine.threshold,
                         self.quarantine.probation_s)
                     self.metrics.record_quarantined(eid)
+                    if journal.enabled():
+                        journal.emit("quarantine.enter",
+                                     job_id=st.task.job_id,
+                                     executor_id=eid,
+                                     reason="consecutive retryable failures")
         self.metrics.set_quarantined_executors(self.quarantine.count())
 
     def _absorb_job_statuses(self, job_id: str, graph,
@@ -1182,16 +1284,29 @@ class SchedulerServer:
                 log.info("speculative attempt won: job %s stage %d "
                          "partition %d", job_id, stage_id, partition)
                 self.metrics.record_speculative_win(job_id)
+                if journal.enabled():
+                    journal.emit("speculation.win", job_id=job_id,
+                                 stage_id=stage_id, partition=partition)
             elif kind == "cancel_task":
                 # first result won the race: reap the losing duplicate so
                 # it stops burning a slot (its late status is discarded by
                 # the graph's attempt bookkeeping either way)
                 executor_id, task_id = payload
+                if journal.enabled():
+                    journal.emit("task.cancel", job_id=job_id,
+                                 stage_id=task_id.stage_id,
+                                 partition=task_id.partition,
+                                 attempt=task_id.task_attempt,
+                                 executor_id=executor_id)
                 self._submit_work(self._cancel_one, executor_id, task_id)
             elif kind == "job_successful":
                 # terminal state must be durable BEFORE waiters wake:
                 # set_status releases wait_for_job, and a restarted
                 # scheduler must never see a completed job as running
+                if journal.enabled():
+                    # before the checkpoint, so the terminal event is IN
+                    # the persisted timeline
+                    journal.emit_job("job.successful", job_id)
                 if not self._checkpoint(graph):
                     return  # lease lost: the adopter owns this job now
                 checkpointed = True
@@ -1209,6 +1324,9 @@ class SchedulerServer:
                     job_id, queued_at, int(time.time() * 1000))
                 self._schedule_job_data_cleanup(graph)
             elif kind == "job_failed":
+                if journal.enabled():
+                    journal.emit_job("job.failed", job_id,
+                                     error=str(payload))
                 if not self._checkpoint(graph):
                     return  # lease lost: the adopter owns this job now
                 checkpointed = True
@@ -1231,6 +1349,9 @@ class SchedulerServer:
         if not events:
             return
         for kind, n in events:
+            if journal.enabled():
+                journal.emit("aqe.rewrite", job_id=graph.job_id,
+                             rewrite=kind, partitions=n)
             if kind == "coalesce":
                 self.metrics.record_aqe_coalesce(n)
             elif kind == "broadcast":
@@ -1340,6 +1461,12 @@ class SchedulerServer:
                     task.task.task_attempt, graph.job_id, stage_id,
                     partition, executor_id, running_on)
                 self.metrics.record_speculative_launched(graph.job_id)
+                if journal.enabled():
+                    journal.emit("speculation.launch", job_id=graph.job_id,
+                                 stage_id=stage_id, partition=partition,
+                                 attempt=task.task.task_attempt,
+                                 executor_id=executor_id,
+                                 running_on=running_on)
                 self._submit_work(self._launch, executor_id, [task])
 
     # --- cluster time series (obs/stats.py ClusterHistory) ---------------
@@ -1433,6 +1560,64 @@ class SchedulerServer:
             self.history.record(sample)
             self.metrics.set_event_queue_depth(sample["event_queue_depth"])
             self.metrics.set_event_loop_lag(sample["event_loop_lag_s"])
+            self.sync_journal_metrics()
+
+    def sync_journal_metrics(self) -> None:
+        """Fold the process-global journal counters into this collector as
+        deltas (called by the history sampler and the REST /api/metrics
+        handler; cheap and idempotent)."""
+        tot, drop = journal.counters()
+        last_tot, last_drop = self._journal_last
+        if tot > last_tot:
+            self.metrics.record_journal_events(tot - last_tot)
+        if drop > last_drop:
+            self.metrics.record_journal_dropped(drop - last_drop)
+        self._journal_last = (tot, drop)
+
+    def cluster_history(self) -> Dict:
+        """Fleet-aware GET /api/cluster/history: this shard's sample ring
+        plus a live per-shard breakdown and fleet rollup when a shard
+        registry exists (same merge discipline as ``autoscale_signal``:
+        per-shard flow sums, shared capacity takes the freshest full
+        view)."""
+        out = self.history.snapshot()
+        out["now"] = self.cluster_sample()
+        shards = [{"scheduler_id": self.scheduler_id,
+                   "endpoint": self.client_endpoint, "local": True,
+                   **{k: out["now"][k] for k in self._REGISTRY_KEYS}}]
+        store = getattr(self.job_backend, "store", None) \
+            if self._lease_capable else None
+        if store is not None:
+            from .kv import scheduler_registry
+
+            try:
+                reg = scheduler_registry(store,
+                                         self.config.fleet_registry_stale_s)
+            except Exception:  # noqa: BLE001 — fall back to local-only
+                log.exception("shard registry read failed")
+                reg = {}
+            for sid in sorted(reg):
+                if sid == self.scheduler_id:
+                    continue
+                obj = reg[sid]
+                sample = obj.get("sample") or {}
+                shards.append({"scheduler_id": sid,
+                               "endpoint": obj.get("endpoint", ""),
+                               "local": False,
+                               **{k: sample.get(k, 0)
+                                  for k in self._REGISTRY_KEYS}})
+            fleet = {k: sum(s.get(k, 0) for s in shards)
+                     for k in ("pending_tasks", "active_jobs",
+                               "admission_queue_depth")}
+            fleet.update({k: max(s.get(k, 0) for s in shards)
+                          for k in ("total_slots", "available_slots",
+                                    "executors_alive")})
+            total, avail = fleet["total_slots"], fleet["available_slots"]
+            fleet["utilization"] = round((total - avail) / total, 4) \
+                if total else 0.0
+            out["fleet"] = fleet
+        out["shards"] = shards
+        return out
 
     # --- failure detection ----------------------------------------------
     def _reap_loop(self) -> None:

@@ -47,6 +47,7 @@ import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import journal
 from ..sql.lexer import tokenize
 from ..utils.config import (
     PLAN_CACHE_ENABLED,
@@ -413,6 +414,8 @@ class PlanCache:
             self.hits += 1
         if self.metrics is not None:
             self.metrics.record_plan_cache_hit()
+        if journal.enabled():
+            journal.emit("cache.hit", cache="plan")
         return entry
 
     def _miss(self) -> None:
@@ -420,6 +423,8 @@ class PlanCache:
             self.misses += 1
         if self.metrics is not None:
             self.metrics.record_plan_cache_miss()
+        if journal.enabled():
+            journal.emit("cache.miss", cache="plan")
 
     def store(self, template: PlanTemplate) -> None:
         if template.nbytes <= 0:
@@ -534,15 +539,21 @@ class ResultCache:
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            entry.hits += 1
-            if entry.kind == "subplan":
-                self.subplan_hits += 1
             else:
-                self.hits += 1
+                self._entries.move_to_end(key)
+                entry.hits += 1
+                if entry.kind == "subplan":
+                    self.subplan_hits += 1
+                else:
+                    self.hits += 1
+        if entry is None:
+            if journal.enabled():
+                journal.emit("cache.miss", cache="result")
+            return None
         if self.metrics is not None:
             self.metrics.record_result_cache_hit()
+        if journal.enabled():
+            journal.emit("cache.hit", cache=entry.kind)
         return entry.payload
 
     def put(self, key: tuple, payload, nbytes: int, kind: str = "result") -> None:

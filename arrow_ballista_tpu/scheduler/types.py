@@ -100,6 +100,11 @@ class TaskStatus:
     # observatory is off — and then it serializes to NO wire key, so
     # disabled mode is byte-identical to the pre-observatory wire format
     device_stats: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # flight-recorder events captured during this task's run
+    # (obs/journal.py task_scope; wire-ready dicts).  Same wire contract
+    # as device_stats: empty list serializes to NO key, so journal-off is
+    # byte-identical to the pre-journal wire format
+    journal: List[Dict] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

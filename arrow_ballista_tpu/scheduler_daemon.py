@@ -45,6 +45,10 @@ def main(argv=None) -> None:
     ap.add_argument("--log-file-name-prefix", default="scheduler")
     ap.add_argument("--log-rotation-policy", default="daily",
                     choices=["minutely", "hourly", "daily", "never"])
+    ap.add_argument("--log-format", default=None, choices=["text", "json"],
+                    help="log output format (default: BALLISTA_LOG_FORMAT "
+                         "env or text; json = one object per line with "
+                         "job/trace correlation fields)")
     args = ap.parse_args(argv)
 
     # XLA's C++ stderr (absl) logs bypass python logging; persistent-cache
@@ -60,7 +64,7 @@ def main(argv=None) -> None:
     from .utils.logsetup import init_logging
 
     init_logging(args.log_level, args.log_dir, args.log_file_name_prefix,
-                 args.log_rotation_policy)
+                 args.log_rotation_policy, fmt=args.log_format)
     # native-crash forensics: a SIGSEGV in a daemon otherwise dies silently
     import faulthandler
 

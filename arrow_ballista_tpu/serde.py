@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 from .models import expr as E
 from .models.schema import DataType, Field, Schema
+from .obs.journal import JournalEvent
 from .ops import operators as O
 from .ops.mesh_exec import (
     MeshAggregateExec,
@@ -464,15 +465,24 @@ def graph_to_obj(graph) -> dict:
         })
     import dataclasses as _dc
     aqe = getattr(graph, "aqe", None)
-    return {"job_id": graph.job_id, "status": graph.status,
-            "error": graph.error, "scalars": dict(graph.scalars),
-            "aqe": _dc.asdict(aqe) if aqe is not None else None,
-            "aqe_log": [dict(r) for r in getattr(graph, "aqe_log", [])],
-            # task-propagation trace context: an adopting shard continues
-            # the original trace, so a failed-over job's Chrome trace
-            # shows both shards on one timeline (obs/profile.on_adopted)
-            "trace": dict(getattr(graph, "trace", {}) or {}),
-            "stages": stages}
+    out = {"job_id": graph.job_id, "status": graph.status,
+           "error": graph.error, "scalars": dict(graph.scalars),
+           "aqe": _dc.asdict(aqe) if aqe is not None else None,
+           "aqe_log": [dict(r) for r in getattr(graph, "aqe_log", [])],
+           # task-propagation trace context: an adopting shard continues
+           # the original trace, so a failed-over job's Chrome trace
+           # shows both shards on one timeline (obs/profile.on_adopted)
+           "trace": dict(getattr(graph, "trace", {}) or {}),
+           "stages": stages}
+    # flight-recorder timeline (obs/journal.py): checkpointed so the
+    # epoch-tagged causal record survives fleet failover — the adopter
+    # seeds its own journal from this and appends under the new epoch.
+    # Key present only when events exist (journal-off checkpoints are
+    # byte-identical to pre-journal ones)
+    journal = getattr(graph, "journal", None)
+    if journal:
+        out["journal"] = [dict(e) for e in journal]
+    return out
 
 
 def graph_from_obj(o: dict):
@@ -507,6 +517,7 @@ def graph_from_obj(o: dict):
         graph.aqe = AqePolicy(**o["aqe"])
     graph.aqe_log = [dict(r) for r in o.get("aqe_log", [])]
     graph.trace = dict(o.get("trace", {}))
+    graph.journal = [dict(e) for e in o.get("journal", [])]
     for sid, (st, plan_resolved) in meta.items():
         stage = graph.stages[sid]
         stage.state = st["state"]
@@ -575,6 +586,10 @@ def status_to_obj(st: TaskStatus) -> dict:
     # must stay byte-identical on the wire (test_serde_wire.py)
     if st.device_stats:
         o["device_stats"] = st.device_stats
+    # same contract for the flight recorder: executor journal events ride
+    # piggyback only when the journal recorded something
+    if st.journal:
+        o["journal"] = st.journal
     return o
 
 
@@ -588,7 +603,8 @@ def status_from_obj(o: dict) -> TaskStatus:
         o.get("launch_ms", 0), o.get("start_ms", 0), o.get("end_ms", 0),
         o.get("metrics", {}), o.get("process_id", ""),
         spans=[span_from_obj(s) for s in o.get("spans", [])],
-        device_stats=dict(o.get("device_stats", {})))
+        device_stats=dict(o.get("device_stats", {})),
+        journal=[dict(e) for e in o.get("journal", [])])
 
 
 # --------------------------------------------------------------------------
@@ -665,6 +681,30 @@ def job_status_from_obj(o: dict) -> JobStatus:
         o.get("retriable", False))
 
 
+def journal_event_to_obj(ev: JournalEvent) -> dict:
+    # compact: zero/empty fields are omitted, mirroring what the journal's
+    # in-memory dicts carry (emit() builds the same sparse shape)
+    o = {"seq": ev.seq, "ts_ms": ev.ts_ms, "kind": ev.kind}
+    if ev.actor:
+        o["actor"] = ev.actor
+    if ev.job_id:
+        o["job_id"] = ev.job_id
+    if ev.epoch:
+        o["epoch"] = ev.epoch
+    if ev.parent:
+        o["parent"] = ev.parent
+    if ev.attrs:
+        o["attrs"] = dict(ev.attrs)
+    return o
+
+
+def journal_event_from_obj(o: dict) -> JournalEvent:
+    return JournalEvent(
+        int(o["seq"]), int(o["ts_ms"]), o["kind"], o.get("actor", ""),
+        o.get("job_id", ""), int(o.get("epoch", 0)),
+        int(o.get("parent", 0)), dict(o.get("attrs", {})))
+
+
 def job_lease_to_obj(l: JobLease) -> dict:
     return vars(l)
 
@@ -695,4 +735,5 @@ WIRE_TYPES = {
                           executor_reservation_from_obj),
     JobStatus: (job_status_to_obj, job_status_from_obj),
     JobLease: (job_lease_to_obj, job_lease_from_obj),
+    JournalEvent: (journal_event_to_obj, journal_event_from_obj),
 }

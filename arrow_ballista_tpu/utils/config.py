@@ -54,6 +54,12 @@ OBS_DEVICE_ENABLED = "ballista.observability.device.enabled"
 OBS_DEVICE_WATERMARKS = "ballista.observability.device.watermarks"
 OBS_DEVICE_ADVISOR_MIN_SAVINGS_MS = \
     "ballista.observability.device.advisor.min_savings_ms"
+# flight recorder (arrow_ballista_tpu/obs/journal.py): causal event journal
+JOURNAL_ENABLED = "ballista.journal.enabled"
+JOURNAL_CAPACITY = "ballista.journal.capacity"
+JOURNAL_SPILL_PATH = "ballista.journal.spill_path"
+# structured logging (utils/logsetup.py): 'text' (default) or 'json'
+LOG_FORMAT = "ballista.log.format"
 # static analysis (arrow_ballista_tpu/analysis/)
 ANALYSIS_PLAN_CHECKS = "ballista.analysis.plan_checks"
 ANALYSIS_LOCK_ORDER_RUNTIME = "ballista.analysis.lock_order.runtime"
@@ -278,6 +284,28 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "fusion advisor (obs/advisor.py): drop stage operator "
                     "chains whose estimated fusion savings fall below this "
                     "many milliseconds"),
+        ConfigEntry(JOURNAL_ENABLED, False, _parse_bool,
+                    "flight recorder (obs/journal.py): causally-ordered "
+                    "journal of every consequential scheduler/executor "
+                    "decision (job lifecycle, task attempts, AQE, "
+                    "speculation, cache hits, lease/quarantine "
+                    "transitions, failpoint firings), feeding "
+                    "GET /api/job/<id>/forensics and the query doctor "
+                    "(False = every probe is a single predicate check and "
+                    "the wire format is byte-identical to journal-off)"),
+        ConfigEntry(JOURNAL_CAPACITY, 4096, int,
+                    "events retained in the process-global journal ring "
+                    "and in each per-job timeline; older events are "
+                    "evicted and counted in journal_events_dropped_total"),
+        ConfigEntry(JOURNAL_SPILL_PATH, "", str,
+                    "append every journal event as one JSON line to this "
+                    "file (durable postmortems beyond the in-memory "
+                    "ring); empty = no spill"),
+        ConfigEntry(LOG_FORMAT, "text", str,
+                    "log record format: 'text' (classic one-line) or "
+                    "'json' (structured, one JSON object per line with "
+                    "job_id/trace_id/span_id correlation fields stamped "
+                    "from the ambient observability scope)"),
         ConfigEntry(ADMISSION_RETRY_AFTER_S, 5, int,
                     "retry-after hint (seconds) embedded in retriable "
                     "admission failures (queue full / queue timeout)"),

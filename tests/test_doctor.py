@@ -1,0 +1,592 @@
+"""Flight recorder & query doctor: causal journal, forensics, diagnosis.
+
+Four layers, matching how the PR is built:
+
+  1. journal mechanics: causal chaining (launch -> finish parents),
+     enabled/disabled cost contract (no wire bytes, no per-event
+     allocation on the hot task-status path), counters, ring bounds;
+  2. clean-run e2e: a standalone query produces a valid forensics bundle
+     with the full lifecycle timeline and ZERO doctor findings;
+  3. seeded pathologies, each yielding exactly the expected diagnosis:
+     a straggler (``executor.task.slow`` failpoint + speculation win), a
+     skewed synthetic join (hash-partition row skew), alias-churn
+     retraces (static-key churn through the shared pack wrapper, folded
+     into the serving stage the way a long-lived process accumulates
+     it), plus bundle-level fixtures for shuffle-hotspot,
+     cache-miss-churn and control-plane-churn;
+  4. fleet failover (chaos): a shard killed mid-job leaves one forensics
+     bundle whose timeline spans pre- and post-adoption under one job
+     id, with the fencing epoch marked on post-adoption events.
+"""
+import copy
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import faults, serde
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.obs import device as dev
+from arrow_ballista_tpu.obs import journal
+from arrow_ballista_tpu.obs.doctor import (
+    CACHE_MISS_MIN,
+    HOTSPOT_IMBALANCE_MIN,
+    RETRACE_STORM_MIN,
+    SKEW_COEFFICIENT_MIN,
+    assemble_forensics,
+    diagnose,
+    render_diagnosis,
+    validate_bundle,
+)
+from arrow_ballista_tpu.utils.config import BallistaConfig
+from arrow_ballista_tpu.utils.errors import PlanningError
+
+
+@pytest.fixture(autouse=True)
+def _journal_on():
+    """Fresh, enabled journal per test; components never force-disable an
+    explicitly enabled journal (enable-only switch), so this survives
+    standalone cluster construction."""
+    journal.reset()
+    journal.set_enabled(True)
+    faults.clear()
+    yield
+    faults.clear()
+    journal.reset()
+    journal.set_enabled(False)
+
+
+def _table(rng, n, groups=7):
+    return pa.table({
+        "g": pa.array(rng.integers(0, groups, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+    })
+
+
+def _standalone(conf=None, concurrent_tasks=2, num_executors=2):
+    base = {"ballista.shuffle.partitions": "4"}
+    base.update(conf or {})
+    return BallistaContext.standalone(BallistaConfig(base),
+                                      concurrent_tasks=concurrent_tasks,
+                                      num_executors=num_executors)
+
+
+def _rules(diag):
+    return [f["rule"] for f in diag["findings"]]
+
+
+# --------------------------------------------------------------------------
+# journal mechanics
+# --------------------------------------------------------------------------
+
+def test_emit_chains_lifecycle_and_causal_keys():
+    journal.emit_job("job.submitted", "j1")
+    journal.emit_job("job.admitted", "j1")
+    journal.emit("task.launch", job_id="j1",
+                 causal_key=("task", "j1", 1, 0, 0), stage_id=1, partition=0)
+    journal.emit("task.finish", job_id="j1",
+                 parent_key=("task", "j1", 1, 0, 0), state="success")
+    tl = journal.job_timeline("j1")
+    assert [e["kind"] for e in tl] == \
+        ["job.submitted", "job.admitted", "task.launch", "task.finish"]
+    submitted, admitted, launch, finish = tl
+    assert admitted["parent"] == submitted["seq"], \
+        "lifecycle events must chain causally"
+    assert finish["parent"] == launch["seq"], \
+        "a finish must point at its launch via the causal-key registry"
+    assert launch["attrs"] == {"stage_id": 1, "partition": 0}
+
+
+def test_epoch_stamping_and_clear():
+    journal.set_job_epoch("j1", 3)
+    journal.emit_job("lease.adopt", "j1")
+    journal.set_job_epoch("j1", 0)
+    journal.emit_job("job.successful", "j1")
+    adopt, done = journal.job_timeline("j1")
+    assert adopt["epoch"] == 3
+    assert "epoch" not in done, "epoch 0 must clear the stamp"
+
+
+def test_absorb_dedups_in_process_executor_events():
+    """Standalone executors share the process journal: their task events
+    land in the timeline at emit time, so the TaskStatus piggyback copy
+    must not double them — while a remote executor's events (different
+    actor) always merge."""
+    journal.set_actor("local")
+    with journal.task_scope() as buf:
+        journal.emit("task.run", job_id="j1", stage_id=1, partition=0)
+    assert len(buf) == 1
+    assert journal.absorb("j1", buf) == 0, "piggyback of own events dedups"
+    remote = [{"seq": 1, "ts_ms": 1, "kind": "task.run", "actor": "exec-r",
+               "job_id": "j1", "attrs": {"stage_id": 1, "partition": 1}}]
+    assert journal.absorb("j1", remote) == 1
+    kinds = [(e.get("actor"), e["kind"]) for e in journal.job_timeline("j1")]
+    assert kinds == [("local", "task.run"), ("exec-r", "task.run")]
+
+
+def test_disabled_journal_allocates_nothing_and_is_wire_silent():
+    """The regression contract for the hot task-status path: journal off
+    => emit returns None without buffering, task_scope yields None (the
+    shared null scope, no per-task object), counters stay zero, and a
+    TaskStatus encodes byte-identically to the pre-journal wire format."""
+    journal.set_enabled(False)
+    assert journal.emit("task.run", job_id="j1", stage_id=1) is None
+    scope = journal.task_scope()
+    assert scope is journal.task_scope(), \
+        "disabled task_scope must reuse ONE shared null object"
+    with scope as buf:
+        assert buf is None
+        journal.emit("task.run", job_id="j1", stage_id=1)
+    assert journal.job_timeline("j1") == []
+    assert journal.counters() == (0, 0)
+
+    from arrow_ballista_tpu.scheduler.types import TaskId, TaskStatus
+    st = TaskStatus(TaskId("j1", 1, 0), "exec-1", "success")
+    wire = json.dumps(serde.status_to_obj(st), sort_keys=True)
+    assert "journal" not in wire, \
+        "disabled journal must add zero bytes to task statuses"
+
+
+def test_ring_bounds_and_dropped_counter():
+    journal.configure(capacity=8)
+    try:
+        for i in range(12):
+            journal.emit("tick", job_id="j1", i=i)
+        emitted, dropped = journal.counters()
+        assert emitted == 12 and dropped == 8, \
+            "overflow past capacity must count drops (ring + job timeline)"
+        tl = journal.job_timeline("j1")
+        assert len(tl) == 8 and tl[-1]["attrs"]["i"] == 11, \
+            "the ring keeps the newest events"
+    finally:
+        journal.configure(capacity=4096)
+
+
+def test_spill_writes_jsonl(tmp_path):
+    spill = tmp_path / "journal.jsonl"
+    journal.configure(spill_path=str(spill))
+    try:
+        journal.emit_job("job.submitted", "j1")
+        journal.emit_job("job.successful", "j1")
+        lines = [json.loads(l) for l in
+                 spill.read_text().strip().splitlines()]
+        assert [l["kind"] for l in lines] == \
+            ["job.submitted", "job.successful"]
+    finally:
+        journal.configure(spill_path="")
+
+
+# --------------------------------------------------------------------------
+# clean run: valid bundle, full timeline, zero findings
+# --------------------------------------------------------------------------
+
+def test_clean_run_bundle_timeline_and_zero_findings():
+    ctx = _standalone()
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(3), 4000))
+        df = ctx.sql("select g, sum(v) as s, count(*) as n from t "
+                     "group by g order by g").to_pandas()
+        assert len(df) == 7
+
+        bundle = ctx.forensics()
+        assert validate_bundle(bundle) == []
+        assert bundle["journal_enabled"]
+        tl = bundle["journal"]
+        kinds = [e["kind"] for e in tl]
+        for k in ("job.submitted", "job.admitted", "job.planned",
+                  "stage.resolved", "task.launch", "task.run",
+                  "task.finish", "job.successful"):
+            assert k in kinds, f"clean-run timeline must record {k}: {kinds}"
+        assert kinds[0] == "job.submitted"
+        assert kinds[-1] == "job.successful"
+        # every finish chains to the launch that minted the attempt
+        launches = {e["seq"]: e for e in tl if e["kind"] == "task.launch"}
+        finishes = [e for e in tl if e["kind"] == "task.finish"]
+        assert finishes and all(e.get("parent") in launches for e in finishes)
+        for e in finishes:
+            la = launches[e["parent"]]["attrs"]
+            assert (la["stage_id"], la["partition"]) == \
+                (e["attrs"]["stage_id"], e["attrs"]["partition"])
+        # executor-side task.run events carry through the status piggyback
+        runs = [e for e in tl if e["kind"] == "task.run"]
+        assert len(runs) == len(finishes)
+
+        diag = ctx.doctor()
+        assert diag["findings"] == [], \
+            f"clean run must produce zero findings: {diag['text']}"
+        assert len(diag["rules_evaluated"]) >= 6
+        assert "no pathology detected" in diag["text"]
+        json.dumps(bundle)  # the artifact is one self-contained JSON doc
+    finally:
+        ctx.shutdown()
+
+
+def test_forensics_rest_and_cli_surfaces():
+    from arrow_ballista_tpu.scheduler.rest import RestApi
+
+    ctx = _standalone()
+    api = None
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(4), 4000))
+        ctx.sql("select g, sum(v) as s from t group by g").to_pandas()
+        job_id = ctx._standalone.last_job_id
+
+        api = RestApi(ctx._standalone.scheduler)
+        api.start()
+
+        def get(path, as_json=True):
+            url = f"http://127.0.0.1:{api.port}{path}"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = r.read().decode()
+            return json.loads(body) if as_json else body
+
+        bundle = get(f"/api/job/{job_id}/forensics")
+        assert validate_bundle(bundle) == []
+        assert bundle["job_id"] == job_id
+
+        diag = get(f"/api/job/{job_id}/doctor")
+        assert diag["job_id"] == job_id and diag["findings"] == []
+        assert render_diagnosis(diag) == diag["text"]
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/api/job/zzz-nope/forensics")
+        assert e.value.code == 404
+
+        # fleet-aware history: standalone has no registry -> local shard
+        hist = get("/api/cluster/history")
+        assert [s["local"] for s in hist["shards"]] == [True]
+        assert hist["shards"][0]["scheduler_id"]
+        assert "pending_tasks" in hist["shards"][0]
+
+        # /api/metrics syncs journal counters into the exposition
+        text = get("/api/metrics", as_json=False)
+        assert "journal_events_total" in text
+        assert "journal_events_dropped_total 0" in text
+        emitted = journal.counters()[0]
+        assert f"journal_events_total {emitted}" in text
+
+        # CLI \doctor prints the rendered diagnosis for the last job
+        from arrow_ballista_tpu.cli import run_command
+        run_command(ctx, "\\doctor", False)
+        run_command(ctx, f"\\doctor {job_id}", False)
+    finally:
+        if api is not None:
+            api.stop()
+        ctx.shutdown()
+
+
+def test_forensics_unknown_job_raises():
+    ctx = _standalone(num_executors=1)
+    try:
+        with pytest.raises(PlanningError):
+            ctx.forensics("job-that-never-was")
+        with pytest.raises(PlanningError):
+            ctx.forensics()  # nothing ran yet
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# seeded pathologies -> exactly the expected diagnosis
+# --------------------------------------------------------------------------
+
+def test_straggler_failpoint_diagnosed():
+    """One stage-1 task stalls 2 s (``executor.task.slow``); speculation
+    duplicates it and the copy wins.  The doctor must diagnose exactly a
+    straggler on stage 1, citing the speculation win."""
+    ctx = _standalone({
+        "ballista.speculation.enabled": "true",
+        "ballista.speculation.quantile": "0.5",
+        "ballista.speculation.multiplier": "1.2",
+        "ballista.speculation.min_runtime.seconds": "0.3",
+        "ballista.speculation.interval.seconds": "0.1",
+    })
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(23), 4000))
+        sql = "select g, sum(v) as s, count(*) as n from t group by g order by g"
+        plan = faults.FaultPlan.from_obj({"seed": 21, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 2000, "times": 1,
+            "match": {"stage_id": 1, "executor_id": "executor-0"}}]})
+        with faults.use_plan(plan):
+            ctx.sql(sql).to_pandas()
+        assert plan.events, "the slow failpoint must actually have fired"
+
+        bundle = ctx.forensics()
+        kinds = [e["kind"] for e in bundle["journal"]]
+        assert "speculation.launch" in kinds
+        assert "speculation.win" in kinds
+        assert "fault.fired" in kinds, \
+            "failpoint firings must land in the journal"
+
+        diag = diagnose(bundle)
+        assert _rules(diag) == ["straggler"], diag["text"]
+        f = diag["findings"][0]
+        assert f["stage_id"] == 1
+        assert f["evidence"]["speculation_wins"] >= 1
+        assert f["evidence"]["speculative_launches"] >= 1
+        assert "speculation" in f["remedy"]
+    finally:
+        ctx.shutdown()
+
+
+def test_partition_skew_join_diagnosed():
+    """A join whose probe side hashes 90% of its rows to one shuffle
+    partition.  The doctor must diagnose exactly a partition skew on the
+    probe map stage, citing the skew coefficient and the hot partition."""
+    ctx = _standalone({"ballista.join.broadcast_threshold": "0"})
+    try:
+        rng = np.random.default_rng(7)
+        n = 24000
+        k = np.where(rng.random(n) < 0.9, 0,
+                     rng.integers(1, 16, n)).astype(np.int64)
+        ctx.register_table("fact", pa.table({
+            "k": pa.array(k),
+            "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+        }))
+        ctx.register_table("dim", pa.table({
+            "k": pa.array(np.arange(16, dtype=np.int64)),
+            "w": pa.array(rng.integers(0, 9, 16).astype(np.int64)),
+        }))
+        ctx.sql("select f.k, count(*) as c, sum(f.v) as s "
+                "from fact f join dim d on f.k = d.k "
+                "group by f.k order by f.k").to_pandas()
+
+        diag = ctx.doctor()
+        assert _rules(diag) == ["partition-skew"], diag["text"]
+        f = diag["findings"][0]
+        ev = f["evidence"]
+        assert ev["skew_coefficient"] >= SKEW_COEFFICIENT_MIN
+        assert ev["output_rows"] == n
+        assert ev["hot_partition_rows"] > n // 2, \
+            "the cited hot partition must carry the skewed key"
+        assert "aqe" in f["remedy"]
+        # the skewed stage is the fact-side map stage in the bundle
+        st = next(s for s in ctx.forensics()["stages"]
+                  if s["stage_id"] == f["stage_id"])
+        assert st["skew"] == ev["skew_coefficient"]
+    finally:
+        ctx.shutdown()
+
+
+def test_retrace_storm_alias_churn_diagnosed():
+    """Alias churn re-keys the shared pack wrapper on every statement —
+    genuine retraces measured by the device observatory.  A single toy
+    job cannot accumulate a storm (shape bucketing exists precisely to
+    prevent that), so the measured churn is folded into the serving
+    stage of a real bundle the way a long-lived process accumulates it
+    across stage re-runs; the diagnosis must be exactly a retrace storm
+    citing the retrace/compile ratio."""
+    ctx = _standalone()
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(5), 4000))
+        ctx.sql("select g, sum(v) as s from t group by g order by g"
+                ).to_pandas()  # warm: plan-shape wrappers compile here
+        before = dev.STATS.snapshot()
+        for i in range(RETRACE_STORM_MIN + 2):
+            ctx.sql(f"select g, sum(v) as churn_{i} from t "
+                    "group by g order by g").to_pandas()
+        after = dev.STATS.snapshot()
+        retraces = int(after["jit_retraces"] - before["jit_retraces"])
+        assert retraces >= RETRACE_STORM_MIN, \
+            "every churned alias must re-trace the shared pack wrapper"
+
+        bundle = ctx.forensics()
+        st = bundle["stages"][0]
+        st.setdefault("device", {})
+        st["device"]["jit_retraces"] = retraces
+        st["device"]["jit_compiles"] = 1
+        # the churn loop also genuinely churns the plan cache (every alias
+        # is a new statement) — neutralize that axis here; the dedicated
+        # cache-miss test covers it e2e
+        bundle["metrics"]["plan_cache_misses"] = 0
+        diag = diagnose(bundle)
+        assert _rules(diag) == ["retrace-storm"], diag["text"]
+        f = diag["findings"][0]
+        assert f["evidence"]["jit_retraces"] == retraces
+        assert f["severity"] >= 3.0, "severity is the retrace/compile ratio"
+        assert "batch" in f["remedy"] or "fuse" in f["remedy"]
+    finally:
+        ctx.shutdown()
+
+
+def _clean_bundle_template():
+    """A real, clean bundle to mutate for bundle-level rule fixtures."""
+    ctx = _standalone(num_executors=1)
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(6), 4000))
+        ctx.sql("select g, sum(v) as s from t group by g").to_pandas()
+        bundle = ctx.forensics()
+    finally:
+        ctx.shutdown()
+    assert diagnose(bundle)["findings"] == []
+    return bundle
+
+
+def test_shuffle_hotspot_rule():
+    bundle = _clean_bundle_template()
+    st = bundle["stages"][0]
+    # max/mean imbalance is bounded by the partition count, so a ≥4x
+    # hotspot needs more than 4 partitions to be expressible at all
+    hot = 6 << 20
+    st["partition_bytes"] = {"0": hot,
+                             **{str(p): 1 << 16 for p in range(1, 8)}}
+    diag = diagnose(bundle)
+    assert _rules(diag) == ["shuffle-hotspot"], diag["text"]
+    f = diag["findings"][0]
+    assert f["evidence"]["max_partition_bytes"] == hot
+    assert f["evidence"]["bytes_imbalance"] >= HOTSPOT_IMBALANCE_MIN
+    assert "ballista.shuffle.partitions" in f["remedy"]
+
+
+def test_cache_miss_churn_diagnosed_e2e():
+    """Every statement unique -> the plan cache misses on all of them;
+    the scheduler's own counters carry the evidence into the bundle."""
+    ctx = _standalone(num_executors=1)
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(9), 4000))
+        for i in range(CACHE_MISS_MIN + 4):
+            ctx.sql(f"select g, sum(v) as s from t where v < {90 - i} "
+                    "group by g").to_pandas()
+        diag = ctx.doctor()
+        assert _rules(diag) == ["cache-miss-churn"], diag["text"]
+        ev = diag["findings"][0]["evidence"]
+        assert ev["plan_cache_misses"] >= CACHE_MISS_MIN
+        assert ev["plan_cache_hits"] == 0
+        assert "cache" in diag["findings"][0]["remedy"]
+        # journal records each miss as a cache.miss event on the serving path
+        misses = [e for e in journal.snapshot()
+                  if e["kind"] == "cache.miss"]
+        assert len(misses) >= CACHE_MISS_MIN
+    finally:
+        ctx.shutdown()
+
+
+def test_control_plane_churn_rule():
+    bundle = _clean_bundle_template()
+    bundle["journal"].append({"seq": 999, "ts_ms": 1, "kind": "lease.adopt",
+                              "job_id": bundle["job_id"], "epoch": 2,
+                              "attrs": {"prev_owner": "scheduler-dead"}})
+    bundle["journal"].append({"seq": 1000, "ts_ms": 2,
+                              "kind": "quarantine.enter",
+                              "job_id": bundle["job_id"],
+                              "attrs": {"executor_id": "exec-1"}})
+    diag = diagnose(bundle)
+    assert _rules(diag) == ["control-plane-churn"], diag["text"]
+    ev = diag["findings"][0]["evidence"]
+    assert ev["lease_adoptions"] == 1 and ev["quarantines"] == 1
+    assert "lease" in diag["findings"][0]["remedy"]
+
+
+def test_diagnose_is_deterministic_and_ranked():
+    bundle = _clean_bundle_template()
+    st = bundle["stages"][0]
+    st["partition_bytes"] = {"0": 6 << 20,
+                             **{str(p): 1 << 16 for p in range(1, 8)}}
+    bundle["metrics"]["plan_cache_misses"] = 100
+    bundle["metrics"]["plan_cache_hits"] = 0
+    d1 = diagnose(copy.deepcopy(bundle))
+    d2 = diagnose(copy.deepcopy(bundle))
+    assert d1 == d2, "equal bundles must produce equal output"
+    sev = [f["severity"] for f in d1["findings"]]
+    assert sev == sorted(sev, reverse=True), "findings rank by severity"
+    assert set(_rules(d1)) == {"shuffle-hotspot", "cache-miss-churn"}
+
+
+# --------------------------------------------------------------------------
+# fleet failover (chaos): one timeline across adoption, epoch marked
+# --------------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.slow  # exercised by run_checks.sh stage 4 (-m chaos)
+def test_failover_forensics_single_timeline_with_epochs(tmp_path):
+    from .test_fleet import (
+        SQL,
+        _AsyncQuery,
+        _fleet_client,
+        _make_fleet,
+        _teardown_fleet,
+        _wait_for,
+    )
+
+    kv, shards, executors = _make_fleet(tmp_path, concurrent_tasks=1)
+    try:
+        eps = [("127.0.0.1", s.port) for s in shards]
+        c = _fleet_client(eps)
+        plan = faults.FaultPlan.from_obj({"seed": 5, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 400, "times": -1}]})
+        with faults.use_plan(plan):
+            q = _AsyncQuery(c, SQL)
+            q.start()
+            _wait_for(lambda: shards[0].server._leases, 10.0,
+                      "primary shard should claim the job lease at submit")
+            job_id = next(iter(shards[0].server._leases))
+            dead_sid = shards[0].server.scheduler_id
+            shards[0].kill()  # in-process kill -9: no release, no goodbye
+            q.join(timeout=60.0)
+        assert not q.is_alive() and q.error is None, f"failover: {q.error}"
+
+        survivor = shards[1].server
+        bundle = assemble_forensics(survivor, job_id)
+        assert bundle is not None and validate_bundle(bundle) == []
+        tl = bundle["journal"]
+        kinds = [e["kind"] for e in tl]
+        assert "job.submitted" in kinds, "pre-failover history survives"
+        acquire = next(e for e in tl if e["kind"] == "lease.acquire")
+        adopt = next(e for e in tl if e["kind"] == "lease.adopt")
+        assert acquire["epoch"] == 1
+        assert adopt["epoch"] >= 2, "takeover must bump the fencing epoch"
+        assert adopt["attrs"]["prev_owner"] == dead_sid
+        assert adopt["attrs"]["scheduler_id"] == survivor.scheduler_id
+        # every post-adoption decision is stamped with the new epoch
+        after = tl[tl.index(adopt) + 1:]
+        assert any(e["kind"] == "job.successful" for e in after)
+        for e in after:
+            if e["kind"].startswith(("job.", "lease.", "task.finish")):
+                assert e.get("epoch", 0) >= adopt["epoch"], \
+                    f"unfenced post-adoption event: {e}"
+        # ... and the doctor calls out the control-plane churn, citing it
+        diag = diagnose(bundle)
+        assert "control-plane-churn" in _rules(diag)
+        churn = next(f for f in diag["findings"]
+                     if f["rule"] == "control-plane-churn")
+        assert churn["evidence"]["lease_adoptions"] == 1
+        c.shutdown()
+    finally:
+        _teardown_fleet(kv, shards, executors)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # exercised by run_checks.sh stage 4 (-m chaos)
+def test_checkpoint_carries_timeline_for_adoption(tmp_path):
+    """The persisted graph embeds the journal timeline (epoch-tagged), so
+    an adopter in a FRESH process — which has none of the dead owner's
+    in-memory ring — still reconstructs the pre-failover record."""
+    from arrow_ballista_tpu.scheduler.persistence import FileJobStateBackend
+
+    ctx = _standalone({"ballista.shuffle.partitions": "2"},
+                      num_executors=1)
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(8), 2000))
+        sched = ctx._standalone.scheduler
+        sched.job_backend = FileJobStateBackend(str(tmp_path / "state"))
+        ctx.sql("select g, sum(v) as s from t group by g").to_pandas()
+        job_id = ctx._standalone.last_job_id
+        graph = sched.jobs.get_graph(job_id)
+        assert graph.journal, "checkpointed graphs must carry the timeline"
+        kinds = [e["kind"] for e in graph.journal]
+        assert "job.submitted" in kinds and "job.successful" in kinds, \
+            "terminal events are journaled before the final checkpoint"
+
+        # a blank journal (new process) seeded from the checkpoint serves
+        # the identical timeline under the same job id
+        persisted = [dict(e) for e in graph.journal]
+        journal.reset()
+        journal.seed_job(job_id, persisted)
+        assert journal.job_timeline(job_id) == persisted
+    finally:
+        ctx.shutdown()

@@ -1,0 +1,131 @@
+"""Log <-> trace correlation (utils/logsetup.py).
+
+The executor's task wrapper and the scheduler's event dispatch enter
+``log_scope(job_id=..., ...)``; ``ContextFilter`` stamps the ambient ids
+onto every record, the text format appends a ``[job=...]`` suffix and
+``ballista.log.format=json`` switches to one-JSON-object-per-line output
+— so ``grep job-42`` over daemon logs lines up with the flight-recorder
+timeline and the span store (see docs/user-guide/doctor.md).
+"""
+import io
+import json
+import logging
+
+import pytest
+
+from arrow_ballista_tpu.utils.logsetup import (
+    ContextFilter,
+    JsonFormatter,
+    TextFormatter,
+    _FORMAT,
+    _make_formatter,
+    init_logging,
+    log_scope,
+)
+
+
+def _capture_logger(formatter):
+    """A throwaway logger wired like init_logging wires the root."""
+    buf = io.StringIO()
+    h = logging.StreamHandler(buf)
+    h.setFormatter(formatter)
+    h.addFilter(ContextFilter())
+    logger = logging.getLogger(f"corr-{id(buf)}")
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    logger.addHandler(h)
+    return logger, buf
+
+
+def test_filter_stamps_ambient_scope_and_restores_on_exit():
+    f = ContextFilter()
+
+    def record():
+        r = logging.LogRecord("n", logging.INFO, "p", 1, "m", (), None)
+        f.filter(r)
+        return r
+
+    # outside any scope the attributes exist (formatters rely on that)
+    # but are empty
+    r = record()
+    assert (r.job_id, r.trace_id, r.span_id) == ("", "", "")
+    with log_scope(job_id="job-42", trace_id="t" * 32, span_id="s" * 16):
+        r = record()
+        assert r.job_id == "job-42"
+        assert r.trace_id == "t" * 32
+        assert r.span_id == "s" * 16
+        with log_scope(job_id="job-43"):  # nested scope wins...
+            assert record().job_id == "job-43"
+        assert record().job_id == "job-42"  # ...and the outer is restored
+    assert record().job_id == ""
+
+
+def test_text_format_appends_job_suffix_only_inside_scope():
+    logger, buf = _capture_logger(TextFormatter(_FORMAT))
+    logger.info("plain")
+    with log_scope(job_id="job-7", trace_id="abc123"):
+        logger.info("scoped")
+    plain, scoped = buf.getvalue().strip().splitlines()
+    assert "plain" in plain and "[job=" not in plain
+    assert scoped.endswith("[job=job-7 trace=abc123]")
+
+
+def test_json_format_one_object_per_line_with_correlation_fields():
+    logger, buf = _capture_logger(JsonFormatter())
+    logger.info("hello %s", "world")
+    with log_scope(job_id="job-9", trace_id="t" * 32, span_id="s" * 16):
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            logger.exception("task failed")
+    lines = [json.loads(ln) for ln in buf.getvalue().strip().splitlines()]
+    assert len(lines) == 2
+    plain, scoped = lines
+    assert plain["message"] == "hello world"
+    assert plain["level"] == "INFO"
+    # fields are omitted (not empty-valued) outside a scope: aggregators
+    # index what exists
+    assert "job_id" not in plain and "trace_id" not in plain
+    assert scoped["job_id"] == "job-9"
+    assert scoped["trace_id"] == "t" * 32
+    assert scoped["span_id"] == "s" * 16
+    assert "ValueError: boom" in scoped["exc"]
+
+
+def test_make_formatter_selects_and_rejects():
+    assert isinstance(_make_formatter("json"), JsonFormatter)
+    assert isinstance(_make_formatter("text"), TextFormatter)
+    with pytest.raises(ValueError, match="unknown log format"):
+        _make_formatter("yaml")
+
+
+def test_init_logging_reads_env_when_fmt_unset(monkeypatch):
+    root = logging.getLogger()
+    saved = list(root.handlers)
+    try:
+        monkeypatch.setenv("BALLISTA_LOG_FORMAT", "json")
+        init_logging("INFO")
+        assert isinstance(root.handlers[0].formatter, JsonFormatter)
+        # explicit fmt beats env (daemons pass --log-format through)
+        init_logging("INFO", fmt="text")
+        assert isinstance(root.handlers[0].formatter, TextFormatter)
+        for h in root.handlers:
+            assert any(isinstance(f, ContextFilter) for f in h.filters)
+    finally:
+        for h in list(root.handlers):
+            root.removeHandler(h)
+        for h in saved:
+            root.addHandler(h)
+
+
+def test_ambient_scope_is_entered_by_task_and_dispatch_paths():
+    """The correlation contract: the executor's task wrapper and the
+    scheduler's per-job event dispatch actually enter log_scope, so job
+    logs correlate without per-call plumbing."""
+    import inspect
+
+    from arrow_ballista_tpu.executor import executor as executor_mod
+    from arrow_ballista_tpu.scheduler import scheduler as scheduler_mod
+
+    assert "log_scope(" in inspect.getsource(executor_mod)
+    assert "log_scope(" in inspect.getsource(scheduler_mod)

@@ -17,6 +17,7 @@ import pytest
 from arrow_ballista_tpu import serde
 from arrow_ballista_tpu.models import expr as E
 from arrow_ballista_tpu.models.schema import INT64, Field, Schema
+from arrow_ballista_tpu.obs.journal import JournalEvent
 from arrow_ballista_tpu.obs.tracing import Span
 from arrow_ballista_tpu.ops.physical import MemoryScanExec, Partitioning
 from arrow_ballista_tpu.ops.shuffle import (
@@ -132,6 +133,14 @@ SAMPLES = {
         JobLease("job-2", owner="scheduler-a1b2", epoch=7, ts=1700000000.25,
                  endpoint="10.0.0.7:50050"),
     ],
+    JournalEvent: [
+        JournalEvent(seq=1, ts_ms=1700000000123, kind="job.submitted"),
+        JournalEvent(seq=9, ts_ms=1700000000456, kind="task.finish",
+                     actor="scheduler-a1b2", job_id="job-1", epoch=3,
+                     parent=4, attrs={"stage_id": 2, "partition": 0,
+                                      "attempt": 1, "state": "success",
+                                      "executor_id": "exec-1"}),
+    ],
 }
 
 
@@ -229,3 +238,20 @@ def test_device_stats_key_absent_when_empty():
                           device_stats={"h2d_bytes": 1024})
     assert serde.status_to_obj(carrying)["device_stats"] == \
         {"h2d_bytes": 1024}
+
+
+def test_journal_key_absent_when_empty():
+    """Flight-recorder-off statuses and checkpoints must be byte-identical
+    to the pre-journal wire format: the journal key only appears when
+    events actually ride along (same contract as device_stats)."""
+    bare = TaskStatus(TaskId("job-1", 4, 0), "exec-1", "success")
+    obj = serde.status_to_obj(bare)
+    assert "journal" not in obj
+    assert serde.status_from_obj(obj).journal == []
+    events = [{"seq": 3, "ts_ms": 1700000000789, "kind": "task.run",
+               "actor": "exec-1", "job_id": "job-1",
+               "attrs": {"stage_id": 4, "partition": 0}}]
+    carrying = TaskStatus(TaskId("job-1", 4, 1), "exec-1", "success",
+                          journal=[dict(e) for e in events])
+    wired = json.loads(json.dumps(serde.status_to_obj(carrying)))
+    assert serde.status_from_obj(wired).journal == events

@@ -3,13 +3,14 @@
 #   1. the static-analysis lint suite (AST rules + metrics-docs),
 #   2. generated-docs freshness (docs/user-guide/configs.md),
 #   3. the static-analysis + concurrency + wire-serde + speculation +
-#      observability + adaptive-execution test files (rule fixtures,
-#      plan-validator cases, seeded-interleaving stress + lock-order shim
-#      units, exhaustive wire round-trips, speculation policy math and
-#      attempt-dedup races, runtime-stats folding / EXPLAIN ANALYZE /
-#      cluster history, device observatory: jit compile/retrace
-#      accounting, transfer bytes, watermarks, fusion advisor,
-#      AQE rewrites + rollback + serde),
+#      observability + adaptive-execution + doctor test files (rule
+#      fixtures, plan-validator cases, seeded-interleaving stress +
+#      lock-order shim units, exhaustive wire round-trips, speculation
+#      policy math and attempt-dedup races, runtime-stats folding /
+#      EXPLAIN ANALYZE / cluster history, device observatory: jit
+#      compile/retrace accounting, transfer bytes, watermarks, fusion
+#      advisor, AQE rewrites + rollback + serde, flight-recorder journal
+#      + forensics bundles + seeded-pathology diagnosis),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
 #      quarantine, straggler speculation, corrupt-shuffle checksums) plus
@@ -21,15 +22,20 @@
 #      every real lock acquisition is checked against the static
 #      concurrency model, and any inversion or unpredicted nesting fails
 #      the leg,
-#   5. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
+#   5. the doctor smoke: one standalone query with the flight recorder
+#      on — the forensics bundle must validate against the
+#      ballista.forensics/v1 schema, carry a complete journal timeline,
+#      and the query doctor must return zero findings on the healthy
+#      run,
+#   6. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
 #      sessions of repeated q6 variants through the prepared-plan +
 #      result caches — zero errors and a nonzero plan-cache hit rate,
 #      also under the runtime lock-order validator,
-#   6. the fleet serving smoke (--smoke --shards 2): the same workload
+#   7. the fleet serving smoke (--smoke --shards 2): the same workload
 #      against a 2-shard scheduler fleet behind a shared KV, then a
 #      failover leg that crash-kills shard 0 mid-run — both legs must
 #      complete every query with zero errors,
-#   7. the perf gate (tools/perf_gate.py): newest BENCH_r*.json round vs
+#   8. the perf gate (tools/perf_gate.py): newest BENCH_r*.json round vs
 #      the previous clean round, per-query wall time and throughput —
 #      warn-only here because container bench numbers are noisy.
 # tests/test_static_analysis.py also runs the lint suite inside tier-1, so
@@ -50,12 +56,53 @@ echo "== analysis + concurrency + serde + speculation + observability + aqe test
 python -m pytest tests/test_static_analysis.py tests/test_concurrency.py \
     tests/test_serde_wire.py tests/test_speculation.py \
     tests/test_observatory.py tests/test_device_obs.py tests/test_aqe.py \
-    -q -p no:cacheprovider
+    tests/test_doctor.py \
+    -q -p no:cacheprovider -m 'not chaos'
 
 echo "== chaos recovery + fleet HA suites (-m chaos, runtime lock-order validation on) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 \
     python -m pytest tests/test_chaos.py tests/test_fleet.py \
+    tests/test_doctor.py \
     -q -m chaos -p no:cacheprovider
+
+echo "== doctor smoke (flight recorder on: bundle validates, clean run diagnoses clean) =="
+python - <<'EOF'
+import json
+
+import numpy as np
+import pyarrow as pa
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.obs import journal
+from arrow_ballista_tpu.obs.doctor import diagnose, validate_bundle
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+ctx = BallistaContext.standalone(
+    BallistaConfig({"ballista.journal.enabled": "true",
+                    "ballista.shuffle.partitions": "4"}),
+    concurrent_tasks=2, num_executors=2)
+try:
+    rng = np.random.default_rng(7)
+    ctx.register_table("t", pa.table({
+        "g": pa.array(rng.integers(0, 7, 4000), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 100, 4000), type=pa.int64())}))
+    ctx.sql("select g, sum(v) as s from t group by g order by g").collect()
+    bundle = ctx.forensics()
+    problems = validate_bundle(bundle)
+    assert not problems, f"forensics bundle invalid: {problems}"
+    kinds = [e["kind"] for e in bundle["journal"]]
+    assert "job.submitted" in kinds and "job.successful" in kinds, kinds
+    json.dumps(bundle)  # the bundle is a self-contained JSON artifact
+    diag = diagnose(bundle)
+    assert not diag["findings"], \
+        f"doctor found pathologies on a clean run: {diag['text']}"
+    emitted, dropped = journal.counters()
+    assert emitted > 0 and dropped == 0, (emitted, dropped)
+    print(f"doctor smoke ok: {len(bundle['journal'])} journal events, "
+          f"{len(diag['rules_evaluated'])} rules evaluated clean")
+finally:
+    ctx.shutdown()
+EOF
 
 echo "== serving smoke (8 sessions x q6, caches on, runtime lock-order validation on) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 python -m benchmarks.serving --smoke
