@@ -51,7 +51,8 @@ class HotPathPurityRule(Rule):
                    "modules")
 
     FILES = (f"{PKG}/ops/kernels.py", f"{PKG}/ops/operators.py",
-             f"{PKG}/ops/expressions.py")
+             f"{PKG}/ops/expressions.py", f"{PKG}/compile/fused.py",
+             f"{PKG}/compile/chains.py", f"{PKG}/compile/fuse.py")
     BANNED_MODULE_CALLS = {("numpy", "asarray"), ("jax", "device_get"),
                            ("jax", "device_put")}
     BANNED_METHODS = {"block_until_ready", "tolist"}
@@ -111,7 +112,7 @@ class SpanCoverageRule(Rule):
     name = "span-coverage"
     description = "operator execute() overrides wrapped via ctx.op_span"
 
-    DIR = f"{PKG}/ops/"
+    DIR = (f"{PKG}/ops/", f"{PKG}/compile/")
     METHODS = ("execute", "execute_write")
     # record_transfer feeds the device observatory's per-operator transfer
     # accounting; calling it outside ctx.op_span(self) silently drops the

@@ -1,0 +1,20 @@
+"""Whole-stage compiler (ROADMAP item 2, Flare precedent in PAPERS.md).
+
+At stage-plan resolution time the scheduler detects maximal single-child
+chains of fusable operators (``chains.py`` — the same walk the stage-fusion
+advisor ranks candidates with) and replaces each allowlisted run with one
+:class:`~arrow_ballista_tpu.compile.fused.FusedStageExec` whose body is a
+single jitted program composing the constituent operators' own compute
+closures (``fused.py``).  ``fuse.py`` holds the scheduler-side rewrite:
+policy from ``ballista.compile.*`` config keys, recording like an AQE
+rewrite, and re-validation through the plan-checks machinery.
+
+Fusion is a pure performance rewrite: the fused program calls the exact
+per-operator compute functions the interpreted path would, in the same
+order, inside one trace — bit-identical by construction — and ANY doubt
+(host-mode operators, UDFs, scalar subqueries, multi-child operators,
+clustered aggregates) leaves the stage interpreted.
+"""
+from .chains import UNFUSABLE, dict_chains, plan_chains  # noqa: F401
+from .fuse import CompilePolicy, fuse_resolved_stages, fuse_stage  # noqa: F401
+from .fused import FusedStageExec  # noqa: F401

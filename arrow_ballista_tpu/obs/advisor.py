@@ -22,51 +22,46 @@ function of the report: usable offline on a saved JSON, behind
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
-# operators that cannot be fused into a single XLA program today: their
-# execute crosses the device boundary (shuffle materialization) or runs
-# host-side; a chain breaks at them
-_UNFUSABLE = {
-    "ShuffleWriterExec", "ShuffleReaderExec", "UnresolvedShuffleExec",
-}
+# ONE candidate finder shared with the whole-stage compiler
+# (compile/chains.py): the advisor and compile/fuse.py walk the same
+# chains, so every advised chain is one the compiler actually considered
+from ..compile.chains import STATIC_REASONS, UNFUSABLE, dict_chains
+
+# backward-compat aliases (the walk used to live here)
+_UNFUSABLE = UNFUSABLE
+_chains = dict_chains
 
 
-def _chains(tree: List[Dict]) -> List[List[Dict]]:
-    """Maximal single-child chains of fusable operators in one stage's
-    pre-order ``operator_tree`` (paths are dotted child indexes, so
-    ``a.b`` is a child of ``a``)."""
-    by_path = {op["path"]: op for op in tree}
-    children: Dict[str, List[Dict]] = {}
-    for op in tree:
-        if "." in op["path"]:
-            parent = op["path"].rsplit(".", 1)[0]
-            children.setdefault(parent, []).append(op)
-
-    def fusable(op):
-        return op["op"] not in _UNFUSABLE
-
-    def single_child(op) -> Optional[Dict]:
-        ch = children.get(op["path"], ())
-        return ch[0] if len(ch) == 1 else None
-
-    chains = []
-    consumed = set()
-    for op in tree:  # pre-order: chain heads come first
-        if op["path"] in consumed or not fusable(op):
+def _fusion_status(chain: List[Dict],
+                   fusion_records) -> Tuple[bool, Optional[str]]:
+    """Did the whole-stage compiler actually fuse this chain?  ``(fused,
+    reason_if_not)`` — a chain whose operator_tree already contains a
+    ``FusedStageExec`` ran compiled; otherwise the stage's recorded
+    fusion decisions (compile/fuse.py verdicts, matched by pre-fusion
+    path) carry the exact rejection reasons; with no record at all (policy
+    off, local engine) fall back to the static per-operator reasons."""
+    ops = [op["op"] for op in chain]
+    if "FusedStageExec" in ops:
+        return True, None
+    paths = {op["path"] for op in chain}
+    for rec in fusion_records or ():
+        if not (paths & set(rec.get("paths", ()))):
             continue
-        chain = [op]
-        nxt = single_child(op)
-        while nxt is not None and fusable(nxt):
-            chain.append(nxt)
-            nxt = single_child(nxt)
-        if len(chain) > 1:
-            chains.append(chain)
-            consumed.update(c["path"] for c in chain)
-    return chains
+        if rec.get("fused"):
+            return True, None
+        reasons = [f"{r['op']}: {r['reason']}"
+                   for r in rec.get("rejected") or ()]
+        return False, "; ".join(reasons) or "rejected by compile policy"
+    for op in ops:
+        if op in STATIC_REASONS:
+            return False, STATIC_REASONS[op]
+    return False, "no fusion decision recorded (compiler not enabled)"
 
 
-def _candidate(stage_id: int, chain: List[Dict]) -> Dict:
+def _candidate(stage_id: int, chain: List[Dict],
+               fusion_records=()) -> Dict:
     device_ms = sum(op.get("device_ms", 0.0) for op in chain)
     host_ms = sum(op.get("host_ms", 0.0) for op in chain)
     transfer = sum(op.get("transfer_bytes", 0) for op in chain)
@@ -98,7 +93,13 @@ def _candidate(stage_id: int, chain: List[Dict]) -> Dict:
     if not reasons:
         reasons.append("no measured overhead; fusion would only save "
                        "per-operator dispatch")
+    fused, reject_reason = _fusion_status(chain, fusion_records)
     return {
+        # convergence with the whole-stage compiler: did this chain
+        # actually run as one kernel, and if not, why it was left
+        # interpreted (exact per-operator verdicts from the stage record)
+        "fused": fused,
+        "reason": reject_reason,
         "stage_id": stage_id,
         "operators": [op["op"] for op in chain],
         "labels": [op["label"].splitlines()[0] for op in chain],
@@ -121,8 +122,9 @@ def advise_report(report: Dict, min_savings_ms: float = 0.0) -> Dict:
     candidates = []
     for stage in report.get("stages", ()):
         sid = stage.get("stage_id", 0)
-        for chain in _chains(stage.get("operator_tree") or []):
-            cand = _candidate(sid, chain)
+        recs = stage.get("fusion") or ()
+        for chain in dict_chains(stage.get("operator_tree") or []):
+            cand = _candidate(sid, chain, recs)
             if cand["est_savings_ms"] >= min_savings_ms:
                 candidates.append(cand)
     candidates.sort(key=lambda c: (-c["est_savings_ms"], c["stage_id"],
@@ -155,8 +157,9 @@ def render_advice(advice: Dict) -> str:
         lines.append("no operator chain shows measurable materialization "
                      "or recompilation overhead")
     for i, c in enumerate(advice["candidates"], 1):
+        mark = "FUSED" if c.get("fused") else "advised"
         lines.append(
-            f"{i}. stage {c['stage_id']}: fuse "
+            f"{i}. stage {c['stage_id']} [{mark}]: "
             + " -> ".join(c["operators"])
             + f"  (~{c['est_savings_ms']:.1f} ms, overhead ratio "
               f"{c['overhead_ratio']:.0%})")
@@ -164,6 +167,8 @@ def render_advice(advice: Dict) -> str:
                      f"{c['host_ms']:.1f} ms · {c['transfer_bytes']} "
                      f"transfer bytes · {c['compiles']} compiles"
                      f"/{c['retraces']} retraces")
+        if not c.get("fused") and c.get("reason"):
+            lines.append(f"   not fused: {c['reason']}")
         for r in c["reasons"]:
             lines.append(f"   - {r}")
     return "\n".join(lines)

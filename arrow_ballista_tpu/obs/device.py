@@ -342,7 +342,8 @@ class ObservedJit:
                  "_seen", "__wrapped__")
 
     def __init__(self, sig: str, fn, static_argnums: Iterable[int] = (),
-                 static_argnames: Iterable[str] = ()):
+                 static_argnames: Iterable[str] = (),
+                 donate_argnums: Iterable[int] = ()):
         import jax
 
         self.sig = sig
@@ -353,6 +354,11 @@ class ObservedJit:
             kw["static_argnums"] = tuple(static_argnums)
         if static_argnames:
             kw["static_argnames"] = tuple(static_argnames)
+        if donate_argnums:
+            # buffer donation (fused whole-stage programs): the caller
+            # promises the donated inputs are dead after the call; XLA may
+            # alias them into the outputs, eliding the copy
+            kw["donate_argnums"] = tuple(donate_argnums)
         self._jfn = jax.jit(fn, **kw)
         idx = set(static_argnums or ())
         names = set(static_argnames or ())
@@ -398,11 +404,14 @@ class ObservedJit:
 
 
 def observed_jit(sig: str, fn=None, *, static_argnums: Iterable[int] = (),
-                 static_argnames: Iterable[str] = ()):
+                 static_argnames: Iterable[str] = (),
+                 donate_argnums: Iterable[int] = ()):
     """Drop-in for ``jax.jit(fn, ...)`` with compile/retrace accounting
     under operator signature ``sig``.  Usable inline
     (``observed_jit("filter", fn)``) or as a decorator
     (``@observed_jit("kernels.pack_for_host", static_argnames=(...))``)."""
     if fn is None:
-        return lambda f: ObservedJit(sig, f, static_argnums, static_argnames)
-    return ObservedJit(sig, fn, static_argnums, static_argnames)
+        return lambda f: ObservedJit(sig, f, static_argnums, static_argnames,
+                                     donate_argnums)
+    return ObservedJit(sig, fn, static_argnums, static_argnames,
+                       donate_argnums)

@@ -50,6 +50,12 @@ CACHE_HIT_RATE_MAX = 0.5
 #: lease adoption / quarantine recorded in the job's journal
 LAG_MEAN_MIN_S = 0.05
 LAG_MAX_MIN_S = 0.25
+#: fusion missed: the whole-stage compiler REJECTED a chain whose
+#: downstream operators still paid at least this much measured
+#: transfer/compile dispatch (the advisor's savings estimate, ms) — a
+#: clean small query stays far under it, so the rule only fires when the
+#: interpreter tax was real
+FUSION_MISSED_MIN_SAVINGS_MS = 50.0
 
 
 def assemble_forensics(server, job_id: str) -> Optional[Dict]:
@@ -214,6 +220,55 @@ def _stage_findings(bundle: Dict) -> List[Dict]:
                           "or fuse the chain (stage-fusion advisor, "
                           "ROADMAP item 2: /api/job/<id>/advise)",
             })
+        # -- fusion missed -------------------------------------------------
+        # a fused=False record means the compiler considered the chain and
+        # left it interpreted; charge the measured host-side dispatch of
+        # the non-head operators that WOULD have been inside the kernel
+        # (fusable classes only — the scan feeding the chain keeps its
+        # transfer cost either way)
+        from ..compile.fuse import DEFAULT_OPERATORS as _fusable_classes
+        opm = st.get("operators") or {}
+        for rec in st.get("fusion") or []:
+            if rec.get("fused"):
+                continue
+            saved = 0.0
+            for path, op in zip((rec.get("paths") or [])[1:],
+                                (rec.get("operators") or [])[1:]):
+                if op not in _fusable_classes:
+                    continue
+                mm = opm.get(f"{path}:{op}") or {}
+                # transfer dispatch + the RETRACE share of compile time:
+                # the first compile is paid once either way (a fused
+                # kernel compiles too), so cold-start cost never counts
+                compiles = int(mm.get("jit_compiles", 0) or 0)
+                retraces = int(mm.get("jit_retraces", 0) or 0)
+                events = compiles + retraces
+                retrace_s = (float(mm.get("jit_compile_time", 0.0) or 0.0)
+                             * retraces / events) if events else 0.0
+                saved += (float(mm.get("h2d_time", 0.0) or 0.0)
+                          + float(mm.get("d2h_time", 0.0) or 0.0)
+                          + retrace_s) * 1000.0
+            if saved < FUSION_MISSED_MIN_SAVINGS_MS:
+                continue
+            reasons = [f"{r.get('op')}: {r.get('reason')}"
+                       for r in rec.get("rejected") or []]
+            out.append({
+                "rule": "fusion-missed",
+                "severity": round(saved / 100.0, 3),
+                "stage_id": sid,
+                "summary": f"stage {sid}: chain "
+                           + " -> ".join(rec.get("operators") or [])
+                           + f" ran interpreted — ~{saved:.0f} ms of "
+                             "inter-operator dispatch one fused kernel "
+                             "would not pay",
+                "evidence": {"est_savings_ms": round(saved, 3),
+                             "rejected": reasons,
+                             "chain": list(rec.get("operators") or [])},
+                "remedy": "address the rejection reasons (see evidence), "
+                          "or widen ballista.compile.operators / lower "
+                          "ballista.compile.min.ops; compare fused=true "
+                          "chains in /api/job/<id>/advise",
+            })
         # -- shuffle hotspot -----------------------------------------------
         pbytes = [int(v) for v in (st.get("partition_bytes") or {}).values()]
         total_bytes = sum(pbytes)
@@ -326,8 +381,8 @@ def diagnose(bundle: Dict) -> Dict:
         "state": (bundle.get("status") or {}).get("state", ""),
         "findings": findings,
         "rules_evaluated": ["partition-skew", "straggler", "retrace-storm",
-                            "shuffle-hotspot", "cache-miss-churn",
-                            "control-plane-churn"],
+                            "fusion-missed", "shuffle-hotspot",
+                            "cache-miss-churn", "control-plane-churn"],
     }
     out["text"] = render_diagnosis(out)
     return out

@@ -170,6 +170,10 @@ def stage_summary(stage) -> Dict:
         # coalesce / skew-split / broadcast records with before/after
         # partition counts
         "aqe": [dict(r) for r in getattr(stage, "aqe_rewrites", [])],
+        # whole-stage compilation decisions (compile/fuse.py): which
+        # operator chains fused into one kernel and which were rejected,
+        # with the rejection reason per operator
+        "fusion": [dict(r) for r in getattr(stage, "fusion_rewrites", [])],
     }
 
 
@@ -300,6 +304,11 @@ def _stage_header(s: Dict) -> str:
                         f"{r['partitions_after']}")
         else:
             bits.append(f"aqe {kinds}")
+    for r in s.get("fusion") or ():
+        if r.get("fused"):
+            for run in r.get("fused_ops") or ():
+                bits.append("fused " + "+".join(run)
+                            + (" (donated)" if r.get("donate") else ""))
     if dur.get("count"):
         bits.append(f"task p50 {dur['p50']:.3f}s p95 {dur['p95']:.3f}s "
                     f"max {dur['max']:.3f}s")
@@ -409,6 +418,7 @@ def local_explain_report(plan, wall_time_ms: float = 0.0,
         "operators": op_metrics,
         "device": {k: device_stats[k] for k in sorted(device_stats)},
         "aqe": [],
+        "fusion": [],
         "operator_tree": annotate_plan(plan, op_metrics),
     }
     report = {

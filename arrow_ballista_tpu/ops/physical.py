@@ -43,7 +43,10 @@ class MetricsSet:
 
     def __init__(self):
         self.values: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        # RLock: deferred resolvers run under the lock in to_dict and may
+        # themselves record metrics (e.g. a fused aggregate latching its
+        # passthrough fallback once the output row count becomes host-known)
+        self._lock = threading.RLock()
         self._deferred = []  # [(name, fn)] resolved lazily in to_dict
 
     def add(self, name: str, v: float):

@@ -115,6 +115,10 @@ class ExecutionStage:
         # AQE rewrite records applied to this stage (scheduler/aqe.py);
         # append-only, entries carry their stage_attempt epoch
         self.aqe_rewrites: List[dict] = []
+        # whole-stage-fusion decisions for this stage (compile/fuse.py):
+        # one record per detected chain — fused or rejected, with reasons;
+        # append-only, entries carry their stage_attempt epoch
+        self.fusion_rewrites: List[dict] = []
 
     # --- attempt bookkeeping ---------------------------------------------
     def new_attempt(self, partition: int, executor_id: str,
@@ -378,6 +382,12 @@ class ExecutionGraph:
         self.aqe = AqePolicy()
         self.aqe_log: List[dict] = []
         self.aqe_events: List[Tuple[str, int]] = []
+        # whole-stage compiler (compile/fuse.py): per-job policy installed
+        # by the scheduler AFTER build (None = fusion off, so the leaf
+        # stages resolved by the revive() below stay interpreted until the
+        # scheduler decides), plus the flat decision log (REST/serde)
+        self.compiler = None
+        self.compile_log: List[dict] = []
         self._task_id_gen = itertools.count()
         self.revive()
 
@@ -410,6 +420,14 @@ class ExecutionGraph:
                         stage.maybe_coalesce()
                 stage.state = RUNNING
                 changed = True
+                if self.compiler is not None and self.compiler.enabled:
+                    # whole-stage fusion rides the resolve: applied to the
+                    # freshly resolved plan (after AQE), before any task
+                    # launches — so rollbacks re-resolve AND re-fuse, and
+                    # speculative duplicates share the fused kernel
+                    from ..compile.fuse import fuse_stage
+
+                    fuse_stage(self, stage)
                 if journal.enabled():
                     journal.emit("stage.resolved", job_id=self.job_id,
                                  stage_id=stage.stage_id,

@@ -64,6 +64,12 @@ def graph_to_dot(graph: ExecutionGraph) -> str:
                           f"{r['partitions_after']}")
             else:
                 extra += f" · aqe {kinds}"
+        # whole-stage compilation decisions (compile/fuse.py): chains the
+        # compiler replaced with one jitted kernel
+        for r in getattr(stage, "fusion_rewrites", ()):
+            if r.get("fused"):
+                for run in r.get("fused_ops", ()):
+                    extra += " · fused " + "+".join(run)
         lines.append(f"  subgraph cluster_{sid} {{")
         lines.append(f'    label="stage {sid} [{stage.state}] '
                      f'{done}/{stage.partitions} tasks '

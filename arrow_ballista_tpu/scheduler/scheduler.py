@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .. import faults
 from ..admission import AdmissionController, AdmissionRequest
 from ..analysis.plan_checks import validate_graph
+from ..compile.fuse import CompilePolicy, fuse_resolved_stages
 from ..obs import journal
 from ..utils.config import ANALYSIS_PLAN_CHECKS
 from .aqe import AqePolicy
@@ -666,6 +667,12 @@ class SchedulerServer:
                 # runtime re-optimization knobs for this job's lifetime
                 # (ballista.aqe.*, defaults apply when no session config)
                 graph.aqe = AqePolicy.from_config(cfg)
+                # whole-stage compiler (ballista.compile.*): the policy
+                # arms revive()-time fusion for downstream stages; the
+                # leaf stages that resolved during graph build are fused
+                # here, after validation and before any task launches
+                graph.compiler = CompilePolicy.from_config(cfg)
+                fuse_resolved_stages(graph)
                 graph.scalars = scalars
                 graph.addr_resolver = self._resolve_addr
                 if serving is not None and serving.subplan:

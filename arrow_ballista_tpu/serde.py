@@ -320,6 +320,13 @@ def plan_to_obj(p: P.ExecutionPlan) -> dict:
     if isinstance(p, SH.RepartitionExec):
         return {"t": "repart", "input": plan_to_obj(p.input),
                 "partitioning": partitioning_to_obj(p.partitioning)}
+    from .compile.fused import FusedStageExec
+    if isinstance(p, FusedStageExec):
+        # the chain head already encodes the whole chain recursively
+        # (ops[i].input is ops[i+1]); "n" says how many linked operators
+        # the deserializer re-wraps into the fused node
+        return {"t": "fusedstage", "n": len(p.ops), "donate": p.donate,
+                "chain": plan_to_obj(p.ops[0])}
     raise InternalError(f"cannot serialize plan node {type(p).__name__}")
 
 
@@ -428,6 +435,14 @@ def plan_from_obj(o: dict) -> P.ExecutionPlan:
     if t == "repart":
         return SH.RepartitionExec(plan_from_obj(o["input"]),
                                   partitioning_from_obj(o["partitioning"]))
+    if t == "fusedstage":
+        from .compile.fused import FusedStageExec
+
+        head = plan_from_obj(o["chain"])
+        ops = [head]
+        for _ in range(o["n"] - 1):
+            ops.append(ops[-1].input)
+        return FusedStageExec(ops, donate=o.get("donate", False))
     raise InternalError(f"cannot deserialize plan tag {t!r}")
 
 
@@ -458,6 +473,8 @@ def graph_to_obj(graph) -> dict:
             "partitions": s.partitions,
             "orig_partitions": getattr(s, "_orig_partitions", None),
             "aqe_rewrites": [dict(r) for r in getattr(s, "aqe_rewrites", [])],
+            "fusion_rewrites": [dict(r) for r in
+                                getattr(s, "fusion_rewrites", [])],
             "successes": {
                 str(p): {"executor_id": ex,
                          "writes": [vars(w) for w in writes]}
@@ -469,6 +486,8 @@ def graph_to_obj(graph) -> dict:
            "error": graph.error, "scalars": dict(graph.scalars),
            "aqe": _dc.asdict(aqe) if aqe is not None else None,
            "aqe_log": [dict(r) for r in getattr(graph, "aqe_log", [])],
+           "compile_log": [dict(r) for r in
+                           getattr(graph, "compile_log", [])],
            # task-propagation trace context: an adopting shard continues
            # the original trace, so a failed-over job's Chrome trace
            # shows both shards on one timeline (obs/profile.on_adopted)
@@ -516,6 +535,7 @@ def graph_from_obj(o: dict):
         from .scheduler.aqe import AqePolicy
         graph.aqe = AqePolicy(**o["aqe"])
     graph.aqe_log = [dict(r) for r in o.get("aqe_log", [])]
+    graph.compile_log = [dict(r) for r in o.get("compile_log", [])]
     graph.trace = dict(o.get("trace", {}))
     graph.journal = [dict(e) for e in o.get("journal", [])]
     for sid, (st, plan_resolved) in meta.items():
@@ -534,6 +554,8 @@ def graph_from_obj(o: dict):
         if st.get("orig_partitions") is not None:
             stage._orig_partitions = st["orig_partitions"]
         stage.aqe_rewrites = [dict(r) for r in st.get("aqe_rewrites", [])]
+        stage.fusion_rewrites = [dict(r) for r in
+                                 st.get("fusion_rewrites", [])]
         stage.task_infos = [None] * stage.partitions
         if len(stage.task_attempts) < stage.partitions:
             stage.task_attempts.extend(

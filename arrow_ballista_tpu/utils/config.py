@@ -122,6 +122,12 @@ FLEET_LEASE_TTL_S = "ballista.fleet.lease.ttl.seconds"
 FLEET_LEASE_RENEW_S = "ballista.fleet.lease.renew.seconds"
 FLEET_ADOPT_INTERVAL_S = "ballista.fleet.adopt.interval.seconds"
 FLEET_REGISTRY_STALE_S = "ballista.fleet.registry.stale.seconds"
+# whole-stage compiler (compile/): fuse allowlisted operator chains into
+# one jitted program at stage-plan resolution time
+COMPILE_ENABLED = "ballista.compile.enabled"
+COMPILE_MIN_OPS = "ballista.compile.min.ops"
+COMPILE_OPERATORS = "ballista.compile.operators"
+COMPILE_DONATE = "ballista.compile.donate"
 
 
 @dataclasses.dataclass
@@ -523,6 +529,29 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "shard-registry entries older than this are ignored "
                     "when aggregating the /api/autoscale signal and when "
                     "re-resolving a job's owner for client failover"),
+        ConfigEntry(COMPILE_ENABLED, True, _parse_bool,
+                    "whole-stage compiler: fuse maximal single-child "
+                    "chains of allowlisted operators into one jitted "
+                    "program per chain at stage-plan resolution time "
+                    "(compile/; a pure performance rewrite — any doubt "
+                    "leaves the stage interpreted; see "
+                    "docs/user-guide/compilation.md)"),
+        ConfigEntry(COMPILE_MIN_OPS, 2, int,
+                    "minimum operators in an allowlisted run before the "
+                    "compiler fuses it (shorter runs stay interpreted: "
+                    "one operator fused alone saves nothing)"),
+        ConfigEntry(COMPILE_OPERATORS, "FilterExec,ProjectionExec,"
+                    "RenameExec,HashAggregateExec", str,
+                    "comma-separated operator allowlist for whole-stage "
+                    "fusion; operators outside the list (and host-mode / "
+                    "scalar-subquery / clustered instances of listed "
+                    "ones) always run interpreted"),
+        ConfigEntry(COMPILE_DONATE, True, _parse_bool,
+                    "donate the input column buffers of a fused row-only "
+                    "program to XLA when the chain reads a shuffle (fresh "
+                    "per-task buffers); a no-op on the CPU backend and "
+                    "for agg-headed chains (the capacity-retry ladder "
+                    "re-reads the input)"),
     ]
 }
 

@@ -340,6 +340,24 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
         except Exception as e:  # noqa: BLE001 — profiling must never kill a bench
             return [{"error": str(e)}]
 
+    def _fusion_decisions(ctx):
+        """The most recent job's whole-stage-compilation decisions
+        (compile/fuse.py's graph.compile_log): which chains fused into one
+        kernel, and which were rejected with what reason — the evidence
+        that a fusion-leg delta comes from the compiler, not noise."""
+        try:
+            sa = ctx._standalone
+            graph = sa.scheduler.jobs.get_graph(sa.last_job_id)
+            if graph is None:
+                return []
+            return [{"stage": r["stage_id"],
+                     "fused": [list(run) for run in r.get("fused_ops", ())],
+                     "rejected": len(r.get("rejected", ()))}
+                    for r in getattr(graph, "compile_log", [])
+                    if r.get("fused")]
+        except Exception as e:  # noqa: BLE001 — profiling must never kill a bench
+            return [{"error": str(e)}]
+
     def run_queries(ctx, queries, label, dest, iters=ITERS, rows=None,
                     sf_label=None, min_slack_s=60.0):
         # min_slack_s: don't START a query with less than this left on the
@@ -362,6 +380,9 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
                 dest[f"q{q}_ms"] = round(min(per) * 1000, 1)
                 dest[f"q{q}_stages"] = _stage_breakdown(ctx)
                 dest[f"q{q}_aqe"] = _aqe_decisions(ctx)
+                fused = _fusion_decisions(ctx)
+                if fused:
+                    dest[f"q{q}_fused"] = fused
                 print(f"[worker] {label} q{q} metrics: "
                       f"{json.dumps(_job_metrics(ctx))}", file=sys.stderr)
             except Exception as e:  # noqa: BLE001 — record, keep benching
@@ -401,6 +422,44 @@ def _worker(platform: str, gate_file: str | None, deadline: float) -> None:
                 ctx_off.shutdown()
         except Exception as e:  # noqa: BLE001 — A/B leg must not kill the run
             result["engine_aqe_off"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # --- fusion A/B leg: whole-stage compiler OFF ------------------------
+    # q1/q18 reuse the main engine leg's fusion-ON numbers; q21 (deep
+    # multi-join with a fusable filter+partial-agg leaf pipeline) gets its
+    # ON number here first.  Same caveat as the AQE leg: the OFF leg runs
+    # in a warm process, so the ratio is a recorded observation, not a
+    # controlled claim — the stage breakdown and compile_log land next to
+    # it so deltas are attributable to the fused stages specifically.
+    if time.time() < deadline - 120:
+        fusion_qs = [1, 18, 21]
+        try:
+            extra_on = [q for q in fusion_qs if not engine.get(f"q{q}_ms")]
+            if extra_on:
+                ctx_fon = BallistaContext.standalone(
+                    BallistaConfig(dict(base_config)), concurrent_tasks=4)
+                try:
+                    register_tables(ctx_fon, DATA_DIR)
+                    run_queries(ctx_fon, extra_on, "fusion-on", engine)
+                finally:
+                    ctx_fon.shutdown()
+            ctx_foff = BallistaContext.standalone(
+                BallistaConfig({**base_config,
+                                "ballista.compile.enabled": "false"}),
+                concurrent_tasks=4)
+            try:
+                register_tables(ctx_foff, DATA_DIR)
+                fus_off = result.setdefault("engine_fusion_off", {})
+                run_queries(ctx_foff, fusion_qs, "fusion-off", fus_off)
+                for q in fusion_qs:
+                    on = engine.get(f"q{q}_ms")
+                    off = fus_off.get(f"q{q}_ms")
+                    if on and off:
+                        fus_off[f"q{q}_fusion_off_over_on"] = round(off / on, 3)
+            finally:
+                ctx_foff.shutdown()
+            emit("fusion-ab")
+        except Exception as e:  # noqa: BLE001 — A/B leg must not kill the run
+            result["engine_fusion_off"] = {"error": f"{type(e).__name__}: {e}"}
 
     if not engine.get("q1_ms"):
         # a 0.0 headline must be distinguishable from a measured zero

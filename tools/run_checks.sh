@@ -10,7 +10,9 @@
 #      EXPLAIN ANALYZE / cluster history, device observatory: jit
 #      compile/retrace accounting, transfer bytes, watermarks, fusion
 #      advisor, AQE rewrites + rollback + serde, flight-recorder journal
-#      + forensics bundles + seeded-pathology diagnosis),
+#      + forensics bundles + seeded-pathology diagnosis, whole-stage
+#      compiler: chain detection, allowlist verdicts, fused-vs-interpreted
+#      equality, fusion serde + rollback/speculation/chaos interplay),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
 #      quarantine, straggler speculation, corrupt-shuffle checksums) plus
@@ -52,17 +54,17 @@ python -m arrow_ballista_tpu.analysis
 echo "== generated docs up to date =="
 python docs/gen_configs.py --check
 
-echo "== analysis + concurrency + serde + speculation + observability + aqe test files =="
+echo "== analysis + concurrency + serde + speculation + observability + aqe + compile test files =="
 python -m pytest tests/test_static_analysis.py tests/test_concurrency.py \
     tests/test_serde_wire.py tests/test_speculation.py \
     tests/test_observatory.py tests/test_device_obs.py tests/test_aqe.py \
-    tests/test_doctor.py \
+    tests/test_doctor.py tests/test_compile.py \
     -q -p no:cacheprovider -m 'not chaos'
 
 echo "== chaos recovery + fleet HA suites (-m chaos, runtime lock-order validation on) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 \
     python -m pytest tests/test_chaos.py tests/test_fleet.py \
-    tests/test_doctor.py \
+    tests/test_doctor.py tests/test_compile.py \
     -q -m chaos -p no:cacheprovider
 
 echo "== doctor smoke (flight recorder on: bundle validates, clean run diagnoses clean) =="
