@@ -664,15 +664,16 @@ def _build_edges(model: ConcurrencyModel) -> None:
 # rules
 # --------------------------------------------------------------------------
 
-_MODEL_CACHE: Dict[int, ConcurrencyModel] = {}
+# One (project, model) pair at a time.  Keyed by the live Project object
+# itself, not id(): a collected project's id() can be reused by a new
+# Project, which would serve a model built from another tree's sources.
+_MODEL_CACHE: List[Tuple[Project, ConcurrencyModel]] = []
 
 
 def _model_for(project: Project) -> ConcurrencyModel:
-    key = id(project)
-    if key not in _MODEL_CACHE:
-        _MODEL_CACHE.clear()  # one project at a time; avoid unbounded growth
-        _MODEL_CACHE[key] = build_model(project)
-    return _MODEL_CACHE[key]
+    if not (_MODEL_CACHE and _MODEL_CACHE[0][0] is project):
+        _MODEL_CACHE[:] = [(project, build_model(project))]
+    return _MODEL_CACHE[0][1]
 
 
 @register

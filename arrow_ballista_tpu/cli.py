@@ -48,8 +48,38 @@ HELP = """\
               (device-observatory overhead ranked per operator chain)
 \\doctor [JOB] run the query doctor on JOB (default: the last job):
               ranked pathology findings with evidence + config remedies
+\\watch [JOB]  live view of JOB (default: the last job): journal events
+              as they happen + a progress bar with rows/s and ETA
 anything else is executed as SQL.
 """
+
+
+def _watch_command(ctx, job_id) -> None:
+    """Render a ctx.watch() stream: events as one-liners, progress as a
+    redrawn bar on one line, the terminal frame as the closing line."""
+    bar_active = False
+    for frame in ctx.watch(job_id):
+        if frame["t"] == "event":
+            if bar_active:
+                print()
+                bar_active = False
+            ev = frame["event"]
+            attrs = ev.get("attrs") or {}
+            detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+            print(f"  {ev.get('kind')}  {detail}".rstrip())
+        elif frame["t"] == "progress":
+            from .obs.progress import render_progress_bar
+
+            print("\r" + render_progress_bar(frame["progress"]),
+                  end="", flush=True)
+            bar_active = True
+        elif frame["t"] == "end":
+            if bar_active:
+                print()
+            state = frame.get("state")
+            err = frame.get("error")
+            print(f"job {state}" + (f": {err}" if err else ""))
+            return
 
 
 def run_command(ctx, line: str, timing: bool) -> bool:
@@ -88,6 +118,10 @@ def run_command(ctx, line: str, timing: bool) -> bool:
         job_id = cmd[len("\\doctor"):].strip() or None
         diagnosis = ctx.doctor(job_id)
         print(diagnosis["text"])
+        return timing
+    if cmd == "\\watch" or cmd.startswith("\\watch "):
+        job_id = cmd[len("\\watch"):].strip() or None
+        _watch_command(ctx, job_id)
         return timing
     t0 = time.perf_counter()
     df = ctx.sql(cmd)

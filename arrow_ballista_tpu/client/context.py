@@ -421,6 +421,80 @@ class BallistaContext:
 
         return diagnose(self.forensics(job_id))
 
+    def watch(self, job_id: Optional[str] = None,
+              timeout: Optional[float] = None):
+        """Live watch stream for ``job_id`` (default: the last job this
+        session ran): a generator of frames, dicts tagged ``{"t":
+        "event"|"progress"|"end"}`` — journal events as they happen,
+        progress snapshots (monotonically non-decreasing ``fraction``,
+        rows/s, quantile ETA) on the watch poll cadence, and one terminal
+        frame.  Remote sessions long-poll the scheduler's watch_job RPC
+        and follow lease adoption across a shard failover
+        (docs/user-guide/live.md); standalone sessions subscribe to the
+        in-process journal directly.  Event frames require the flight
+        recorder (``ballista.journal.enabled``); progress and terminal
+        frames flow either way."""
+        if self._remote is not None:
+            if not job_id:
+                raise PlanningError("remote watch needs an explicit job id")
+            return self._remote.watch(job_id, timeout=timeout)
+        if self._standalone is None:
+            raise PlanningError(
+                "watch requires a standalone or remote session")
+        job_id = job_id or self._standalone.last_job_id
+        if not job_id:
+            raise PlanningError("no job has run in this session yet")
+        return self._watch_standalone(job_id, timeout)
+
+    def _watch_standalone(self, job_id: str, timeout: Optional[float]):
+        import time
+
+        from ..obs import journal
+        from ..obs.progress import job_progress, monotonic_fraction
+        from ..utils.config import LIVE_WATCH_POLL_S, LIVE_WATCH_QUEUE_EVENTS
+
+        sched = self._standalone.scheduler
+        if sched.jobs.get_status(job_id) is None:
+            raise PlanningError(f"job {job_id!r} is not known to the "
+                                "scheduler (or has aged out of retention)")
+        poll_s = float(self.config.get(LIVE_WATCH_POLL_S))
+        capacity = int(self.config.get(LIVE_WATCH_QUEUE_EVENTS))
+        deadline = time.monotonic() + (
+            timeout if timeout is not None
+            else float(self.config.job_timeout_s))
+        floor = 0.0
+        with journal.subscribe(job_id=job_id, capacity=capacity) as sub:
+            # subscribe BEFORE snapshotting the retained timeline, then
+            # dedup on (actor, seq): nothing emitted during the handoff is
+            # lost, nothing is shown twice
+            replayed = set()
+            for ev in journal.job_timeline(job_id):
+                replayed.add((ev.get("actor"), ev.get("seq")))
+                yield {"t": "event", "event": ev}
+            while time.monotonic() < deadline:
+                for ev in sub.poll(timeout=poll_s):
+                    key = (ev.get("actor"), ev.get("seq"))
+                    if ev.get("kind") != "watch.gap" and key in replayed:
+                        continue
+                    yield {"t": "event", "event": ev}
+                if replayed:
+                    replayed.clear()  # only the handoff window needs it
+                st = sched.jobs.get_status(job_id)
+                graph = sched.jobs.get_graph(job_id)
+                if graph is not None:
+                    prog = job_progress(graph)
+                    floor = monotonic_fraction(prog, floor)
+                    prog["fraction"] = floor
+                    yield {"t": "progress", "progress": prog,
+                           "state": st.state if st else None}
+                if st is not None and st.state in ("successful", "failed",
+                                                   "cancelled"):
+                    yield {"t": "end", "state": st.state, "error": st.error}
+                    return
+        from ..utils.errors import ExecutionError
+
+        raise ExecutionError(f"watch of job {job_id} timed out")
+
     def _explain_analyze_statement(self, stmt: "ast.Node") -> Dict:
         """Plan + run one SELECT and build the annotated report.  The
         standalone engine reads the retained ExecutionGraph's stats store

@@ -112,15 +112,21 @@ class RemoteCluster:
     def _call(self, method: str, payload: dict = None, binary: bytes = b""):
         payload = dict(payload or {})
         last: Optional[Exception] = None
-        for _ in range(len(self._endpoints)):
-            ep = self._endpoints[self._primary]
+        # iterate a snapshot: concurrent callers (a watch generator and a
+        # status poller share this client) may re-point _primary mid-loop,
+        # which must not make this loop retry a dead shard while a live
+        # one exists
+        eps = list(self._endpoints)
+        start = self._primary if self._primary < len(eps) else 0
+        for i in range(len(eps)):
+            ep = eps[(start + i) % len(eps)]
             try:
                 sid = self._session_for(ep)
                 p = dict(payload)
                 p.setdefault("session_id", sid)
                 return wire.call(ep[0], ep[1], method, p, binary)
             except (ConnectionError, OSError) as e:
-                if len(self._endpoints) == 1:
+                if len(eps) == 1:
                     raise  # single-scheduler surface: raw transport error
                 last = e
                 self._rotate(ep)
@@ -271,6 +277,93 @@ class RemoteCluster:
                     continue
                 batches.extend(self._fetch(loc, schema))
         return batches
+
+    # --- live watch ------------------------------------------------------
+    def watch(self, job_id: str, timeout: Optional[float] = None):
+        """Generator of live watch frames for ``job_id`` — dicts tagged
+        ``{"t": "event"|"progress"|"end"}``, the same shape the REST
+        NDJSON stream carries.  Long-polls the owning shard's watch_job
+        RPC and follows lease adoption (PR 11): a not_found redirect
+        re-sticks to the named owner, a change of answering shard resets
+        the cursor to 0 (the adopted timeline was re-seeded from the
+        checkpoint) and the (actor, seq) dedup set drops the replayed
+        prefix — so a SIGKILL failover yields ONE continuous timeline
+        with the ``lease.adopt`` marker in-band, no duplicates, and the
+        terminal frame intact."""
+        from ..obs.progress import monotonic_fraction
+        from ..utils.config import LIVE_WATCH_POLL_S
+
+        if timeout is None:
+            timeout = float(self.config.job_timeout_s)
+        poll_s = float(self.config.get(LIVE_WATCH_POLL_S))
+        deadline = time.monotonic() + timeout
+        cursor = 0
+        shard: Optional[str] = None
+        seen: set = set()
+        floor = 0.0
+        lost_since: Optional[float] = None
+        while time.monotonic() < deadline:
+            try:
+                payload, _ = self._call("watch_job",
+                                        {"job_id": job_id, "cursor": cursor,
+                                         "timeout_s": poll_s})
+            except (ConnectionError, OSError):
+                if len(self._endpoints) == 1:
+                    raise
+                # whole fleet unreachable this instant (mid-failover):
+                # keep trying for the adoption grace window
+                lost_since = lost_since if lost_since is not None \
+                    else time.monotonic()
+                if time.monotonic() - lost_since > self._adoption_grace_s:
+                    raise
+                time.sleep(POLL_INTERVAL_S)
+                continue
+            state = payload.get("state")
+            if state == "not_found":
+                if payload.get("owner") and payload.get("endpoint"):
+                    # the named owner may be a corpse whose lease has not
+                    # expired yet: pace the redirect loop like the status
+                    # poller does instead of hammering it
+                    self._point_primary(payload["endpoint"])
+                    lost_since = None
+                    time.sleep(POLL_INTERVAL_S)
+                    continue
+                lost_since = lost_since if lost_since is not None \
+                    else time.monotonic()
+                if time.monotonic() - lost_since < self._adoption_grace_s:
+                    time.sleep(POLL_INTERVAL_S)
+                    continue
+                raise ExecutionError(
+                    f"job {job_id} lost: no shard owns or remembers it")
+            lost_since = None
+            sid = payload.get("scheduler_id")
+            if shard is None:
+                shard = sid
+            elif sid != shard:
+                # failover: replay the adopted shard's timeline from the
+                # start; dedup below drops everything already shown
+                shard = sid
+                cursor = 0
+                continue
+            for ev in payload.get("events", []):
+                key = (ev.get("actor"), ev.get("seq"))
+                # watch.gap markers carry seq=0 and must never dedup
+                if ev.get("kind") != "watch.gap":
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                yield {"t": "event", "event": ev}
+            cursor = int(payload.get("cursor", cursor))
+            prog = payload.get("progress")
+            if prog:
+                floor = monotonic_fraction(prog, floor)
+                prog["fraction"] = floor
+                yield {"t": "progress", "progress": prog, "state": state}
+            if state in ("successful", "failed", "cancelled"):
+                yield {"t": "end", "state": state,
+                       "error": payload.get("error", "")}
+                return
+        raise ExecutionError(f"watch of job {job_id} timed out")
 
     def _fetch_cached(self, job_id: str) -> List[ColumnBatch]:
         """Decode a fetch_result reply: the payload lists per-partition

@@ -41,3 +41,15 @@ from .doctor import (  # noqa: F401
     render_diagnosis,
     validate_bundle,
 )
+from .progress import (  # noqa: F401
+    job_progress,
+    monotonic_fraction,
+    render_progress_bar,
+)
+from .live import LiveDoctor  # noqa: F401
+from .slo import (  # noqa: F401
+    NullSloTracker,
+    SloPolicy,
+    SloTracker,
+    tracker_from_config,
+)

@@ -30,6 +30,13 @@ Design (mirrors obs/device.py's cost discipline):
 - **Optional JSONL spill.**  ``ballista.journal.spill_path`` appends
   every event as one JSON line (file writes take a small lock; the ring
   stays lock-free).
+- **Watch subscriptions.**  ``subscribe()`` returns a bounded
+  per-subscriber queue fanned out from the emit path behind a single
+  ``if _subs:`` predicate — no subscribers means no extra work, and a
+  slow subscriber NEVER blocks ``emit()``: its queue drops the oldest
+  events and the next ``drain()`` leads with an explicit ``watch.gap``
+  event carrying the drop count.  This is the push half of the live
+  observability plane (REST NDJSON watch streams, ``ctx.watch``).
 
 Config: ``ballista.journal.enabled`` / ``.capacity`` / ``.spill_path``.
 Wire: executor events ride ``TaskStatus.journal`` only when non-empty,
@@ -73,6 +80,8 @@ _jobs: Dict[str, deque] = {}
 _job_epochs: Dict[str, int] = {}
 # causal-key registry: (job_id, ...) -> seq of the "start" event
 _causal: Dict[tuple, int] = {}
+# live watch subscribers; fan-out is one predicate check when empty
+_subs: List["Subscription"] = []
 
 _tls = threading.local()
 
@@ -150,6 +159,8 @@ def reset() -> None:
     _jobs.clear()
     _job_epochs.clear()
     _causal.clear()
+    for sub in list(_subs):
+        sub.close()
 
 
 # --------------------------------------------------------------------------
@@ -215,6 +226,8 @@ def _append(ev: Dict[str, Any], job_id: str) -> None:
         tl.append(ev)
     if _spill_path:
         _spill(ev)
+    if _subs:
+        _fanout(ev, job_id)
 
 
 def _evict_jobs() -> None:
@@ -293,6 +306,8 @@ def absorb(job_id: str, events: List[Dict[str, Any]]) -> int:
             _dropped += 1
         tl.append(ev)
         _ring.append(ev)
+        if _subs:
+            _fanout(ev, job_id)
         n += 1
     return n
 
@@ -346,6 +361,108 @@ def task_scope():
     if not _enabled:
         return _NULL_TASK
     return _TaskScope()
+
+
+# --------------------------------------------------------------------------
+# watch subscriptions (live observability plane)
+# --------------------------------------------------------------------------
+
+class Subscription:
+    """A bounded live tail of the journal for one consumer.
+
+    The emit path offers events with plain GIL-atomic deque ops and a
+    ``threading.Event`` set — it never blocks and never raises, whatever
+    the consumer is doing.  When the consumer falls behind, the OLDEST
+    queued events are discarded and the next ``drain()`` starts with one
+    synthetic ``watch.gap`` event (``attrs.dropped`` = how many); gap
+    events carry ``seq=0`` and must not be deduped on (actor, seq).
+    """
+
+    __slots__ = ("job_id", "capacity", "_q", "_gap", "_wake", "_closed")
+
+    def __init__(self, job_id: Optional[str] = None, capacity: int = 1024):
+        self.job_id = job_id or None
+        self.capacity = max(1, int(capacity))
+        self._q: deque = deque()
+        self._gap = 0
+        self._wake = threading.Event()
+        self._closed = False
+
+    def _offer(self, ev: Dict[str, Any]) -> None:
+        # emitter side: bound the queue by shedding oldest (a best-effort
+        # stale len() under a concurrent drain at worst sheds one event
+        # early — it is counted in the gap either way)
+        if self._closed:
+            return
+        if len(self._q) >= self.capacity:
+            try:
+                self._q.popleft()
+                self._gap += 1
+            except IndexError:
+                pass
+        self._q.append(ev)
+        self._wake.set()
+
+    def drain(self) -> List[Dict[str, Any]]:
+        """All queued events, oldest first; a pending gap becomes one
+        leading ``watch.gap`` event.  Never blocks."""
+        self._wake.clear()
+        out: List[Dict[str, Any]] = []
+        gap, self._gap = self._gap, 0
+        if gap:
+            out.append({"seq": 0, "ts_ms": int(time.time() * 1000),
+                        "kind": "watch.gap", "attrs": {"dropped": gap}})
+        while True:
+            try:
+                out.append(self._q.popleft())
+            except IndexError:
+                break
+        return out
+
+    def poll(self, timeout: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Wait up to ``timeout`` for at least one event, then drain."""
+        if not self._q and not self._gap and not self._closed:
+            self._wake.wait(timeout)
+        return self.drain()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            _subs.remove(self)
+        except ValueError:
+            pass
+        self._wake.set()
+
+    def __enter__(self) -> "Subscription":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def subscribe(job_id: Optional[str] = None,
+              capacity: int = 1024) -> Subscription:
+    """Attach a live subscriber (``job_id=None`` follows every event).
+    Close it (or use as a context manager) to detach; an attached
+    subscriber costs the emit path one list scan per event."""
+    sub = Subscription(job_id=job_id, capacity=capacity)
+    _subs.append(sub)
+    return sub
+
+
+def _fanout(ev: Dict[str, Any], job_id: str) -> None:
+    for sub in list(_subs):
+        if sub.job_id is None or sub.job_id == job_id:
+            sub._offer(ev)
+
+
+def watcher_count() -> int:
+    return len(_subs)
 
 
 # --------------------------------------------------------------------------

@@ -370,6 +370,11 @@ def explain_analyze_report(graph, wall_time_ms: float = 0.0,
         "total_shuffle_bytes": sum(s["output_bytes"] for s in stages),
         "stages": stages,
     }
+    # the same fraction /api/jobs and the watch stream report — one
+    # computation (obs/progress.py), every surface agrees
+    from .progress import job_progress
+
+    report["progress"] = job_progress(graph)
     report["text"] = render_explain_analyze(report)
     return report
 

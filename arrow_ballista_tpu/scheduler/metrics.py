@@ -74,6 +74,10 @@ class SchedulerMetricsCollector:
     # from the journal ring + per-job timelines
     def record_journal_events(self, n: int) -> None: ...
     def record_journal_dropped(self, n: int) -> None: ...
+    # live observability plane (obs/live.py + obs/slo.py): standing
+    # in-flight alerts and per-window SLO burn-rate gauges
+    def set_alerts_active(self, value: int) -> None: ...
+    def set_slo_burn_rate(self, window: str, value: float) -> None: ...
     def gather(self) -> str:
         return ""
 
@@ -116,6 +120,9 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.cache_evictions = 0
         self.journal_events = 0
         self.journal_dropped = 0
+        self.alerts_active = 0
+        # burn window name ("fast"/"slow") -> most recent burn rate
+        self.slo_burn_rate: Dict[str, float] = {}
         # fleet-wide device-observatory fold (TaskStatus.device_stats
         # intake): counters sum across every task the fleet absorbed,
         # watermarks keep the max any single task reported
@@ -245,6 +252,14 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.journal_dropped += n
 
+    def set_alerts_active(self, value):
+        with self._lock:
+            self.alerts_active = int(value)
+
+    def set_slo_burn_rate(self, window, value):
+        with self._lock:
+            self.slo_burn_rate[str(window)] = float(value)
+
     def counters_snapshot(self) -> Dict[str, float]:
         """Plain-dict view of the scalar counters/gauges (the forensics
         bundle embeds this so the doctor's cache/churn rules read metric
@@ -274,6 +289,9 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                 "event_loop_lag_s": self.event_loop_lag_s,
                 "journal_events": self.journal_events,
                 "journal_dropped": self.journal_dropped,
+                "alerts_active": self.alerts_active,
+                **{f"slo_burn_rate_{w}": v
+                   for w, v in sorted(self.slo_burn_rate.items())},
             }
 
     def gather(self) -> str:
@@ -390,6 +408,17 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             lines.append("# TYPE scheduler_event_loop_lag_seconds gauge")
             lines.append(
                 f"scheduler_event_loop_lag_seconds {self.event_loop_lag_s}")
+            lines.append("# HELP alerts_active standing in-flight doctor "
+                         "alerts (raised, not yet cleared) on this shard")
+            lines.append("# TYPE alerts_active gauge")
+            lines.append(f"alerts_active {self.alerts_active}")
+            lines.append("# HELP slo_burn_rate rate the latency-SLO error "
+                         "budget is being consumed per burn window "
+                         "(1.0 = exactly sustainable), shard-local")
+            lines.append("# TYPE slo_burn_rate gauge")
+            for w in sorted(self.slo_burn_rate):
+                lines.append(
+                    f'slo_burn_rate{{window="{w}"}} {self.slo_burn_rate[w]}')
             for name, h, help_ in [
                 ("planning_time_seconds", self.planning_time, "job planning time"),
                 ("job_exec_time_seconds", self.exec_time, "job execution time"),

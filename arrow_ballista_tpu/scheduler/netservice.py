@@ -213,8 +213,12 @@ class SchedulerNetService:
                 FLEET_LEASE_RENEW_S,
                 FLEET_LEASE_TTL_S,
                 FLEET_REGISTRY_STALE_S,
+                LIVE_DOCTOR_INTERVAL_S,
+                LIVE_ENABLED,
                 QUARANTINE_FAILURES,
                 QUARANTINE_PROBATION_S,
+                SLO_P99_TARGET_MS,
+                SLO_WINDOW_S,
                 SPECULATION_ENABLED,
                 SPECULATION_INTERVAL_S,
                 SPECULATION_MAX_CONCURRENT,
@@ -249,7 +253,12 @@ class SchedulerNetService:
                 speculation_max_concurrent=int(
                     self.config.get(SPECULATION_MAX_CONCURRENT)),
                 speculation_interval_s=float(
-                    self.config.get(SPECULATION_INTERVAL_S)))
+                    self.config.get(SPECULATION_INTERVAL_S)),
+                live_enabled=bool(self.config.get(LIVE_ENABLED)),
+                live_doctor_interval_s=float(
+                    self.config.get(LIVE_DOCTOR_INTERVAL_S)),
+                slo_p99_target_ms=float(self.config.get(SLO_P99_TARGET_MS)),
+                slo_window_s=float(self.config.get(SLO_WINDOW_S)))
         self.catalog = SchemaCatalog()
         launcher = NetTaskLauncher(RetryPolicy.from_config(self.config))
         job_backend = None
@@ -315,6 +324,7 @@ class SchedulerNetService:
         r("explain", self._explain)
         r("execute_query", self._execute_query)
         r("get_job_status", self._get_job_status)
+        r("watch_job", self._watch_job)
         r("fetch_result", self._fetch_result)
         r("cancel_job", self._cancel_job)
         r("register_executor", self._register_executor)
@@ -522,6 +532,51 @@ class SchedulerNetService:
             if schema is not None:
                 out["schema"] = serde.schema_to_obj(schema)
         return out, b""
+
+    def _watch_job(self, payload: dict, _bin: bytes):
+        """One long-poll watch frame: the job's journal events past
+        ``cursor`` plus a live progress snapshot and the current state.
+        The client's ``ctx.watch()`` stitches frames into a single stream
+        and follows lease adoption (PR 11): when the answering shard
+        changes it resets the cursor to 0 — the adopted shard re-seeded
+        its timeline from the checkpoint, so indices restart — and dedups
+        replayed events on (actor, seq).  Blocking here is fine: the RPC
+        server is one thread per connection."""
+        import time as _time
+
+        from ..obs import journal
+        from ..obs.progress import job_progress
+
+        job_id = payload["job_id"]
+        cursor = max(0, int(payload.get("cursor", 0)))
+        timeout_s = min(max(float(payload.get("timeout_s", 0.25)), 0.0), 5.0)
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                cached = job_id in self._cached_results
+            if cached:
+                return {"state": "successful", "cached": True,
+                        "scheduler_id": self.server.scheduler_id,
+                        "cursor": cursor, "events": [],
+                        "progress": None}, b""
+            status = self.server.get_job_status(job_id)
+            if status is None:
+                # foreign job: same redirect shape as get_job_status —
+                # the reply names the owning shard's endpoint
+                return self._resolve_foreign_status(job_id), b""
+            timeline = journal.job_timeline(job_id)
+            if cursor > len(timeline):
+                cursor = 0  # timeline restarted (adoption re-seed)
+            events = timeline[cursor:]
+            terminal = status.state in ("successful", "failed", "cancelled")
+            if events or terminal or _time.monotonic() >= deadline:
+                graph = self.server.jobs.get_graph(job_id)
+                progress = job_progress(graph) if graph is not None else None
+                return {"state": status.state, "error": status.error,
+                        "scheduler_id": self.server.scheduler_id,
+                        "cursor": cursor + len(events),
+                        "events": events, "progress": progress}, b""
+            _time.sleep(0.05)
 
     def _resolve_foreign_status(self, job_id: str) -> dict:
         """A job this shard is not driving: consult the shared KV so

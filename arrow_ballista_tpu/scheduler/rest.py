@@ -39,6 +39,15 @@ Beyond the reference surface:
     GET  /api/autoscale        KEDA-style fleet scaling signal: pending
                                tasks / utilization / queue depths summed
                                across shards via the shared-KV registry
+    GET  /api/slo              latency SLO snapshot: policy, fast/slow
+                               window counts and burn rates, fleet-merged
+                               across shards via the shared-KV registry
+    GET  /api/job/<id>/watch   live chunked-NDJSON stream: journal events
+                               + progress frames + one terminal frame
+                               (docs/user-guide/live.md for the schema)
+    GET  /api/cluster/watch    live chunked-NDJSON stream of every journal
+                               event on this shard (no terminal frame;
+                               close the connection to stop)
 """
 from __future__ import annotations
 
@@ -48,11 +57,21 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..obs import journal
 from ..obs.advisor import advise_graph
 from ..obs.doctor import assemble_forensics, diagnose
+from ..obs.progress import job_progress, monotonic_fraction
 from ..obs.stats import explain_analyze_report
+from ..utils.config import (
+    BallistaConfig,
+    LIVE_WATCH_POLL_S,
+    LIVE_WATCH_QUEUE_EVENTS,
+)
 from .graph_dot import graph_to_dot
 from .scheduler import SchedulerServer
+
+#: job states that end a watch stream
+_TERMINAL = ("successful", "failed", "cancelled")
 
 
 class RestApi:
@@ -90,6 +109,9 @@ class RestApi:
                     self._send(404, json.dumps({"error": "not found"}))
 
         self.server = server
+        # watch streams poll this so stop() does not hang on a client that
+        # keeps its NDJSON connection open  ballista: guarded-by=none
+        self._stopping = False
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -99,6 +121,7 @@ class RestApi:
         self._thread.start()
 
     def stop(self):
+        self._stopping = True
         if self._thread.is_alive():
             self._httpd.shutdown()
             self._thread.join(timeout=5.0)
@@ -132,6 +155,15 @@ class RestApi:
                 h._send(200, json.dumps(job))
         elif len(rest) == 3 and rest[0] == "job" and rest[2] == "stages":
             h._send(200, json.dumps(self._stages(rest[1])))
+        elif len(rest) == 3 and rest[0] == "job" and rest[2] == "watch":
+            if self.server.jobs.get_status(rest[1]) is None:
+                h._send(404, json.dumps({"error": "no such job"}))
+            else:
+                self._stream_watch(h, rest[1])
+        elif rest == ["cluster", "watch"]:
+            self._stream_watch(h, None)
+        elif rest == ["slo"]:
+            h._send(200, json.dumps(self.server.slo_report()))
         elif len(rest) == 3 and rest[0] == "job" and rest[2] == "profile":
             prof = self.server.obs.get_profile(
                 rest[1], self.server.jobs.get_graph(rest[1]),
@@ -207,6 +239,67 @@ class RestApi:
         else:
             h._send(404, json.dumps({"error": "not found"}))
 
+    # --- watch streams ---------------------------------------------------
+    def _stream_watch(self, h, job_id: Optional[str]) -> None:
+        """Chunk NDJSON frames at the client until the job ends (job watch)
+        or the connection drops (cluster watch).  Frames are one JSON
+        object per line, tagged ``{"t": "event"|"progress"|"end"}``; no
+        Content-Length — the stream is close-delimited.  The journal
+        subscription is bounded and never blocks ``emit()``: a slow
+        reader sees a ``watch.gap`` event instead of backpressure."""
+        defaults = BallistaConfig()
+        poll_s = float(defaults.get(LIVE_WATCH_POLL_S))
+        capacity = int(defaults.get(LIVE_WATCH_QUEUE_EVENTS))
+        h.send_response(200)
+        h.send_header("Content-Type", "application/x-ndjson")
+        h.send_header("Cache-Control", "no-cache")
+        h.end_headers()
+
+        def frame(obj: dict) -> bool:
+            try:
+                h.wfile.write((json.dumps(obj) + "\n").encode())
+                h.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return False
+
+        floor = 0.0
+        with journal.subscribe(job_id=job_id, capacity=capacity) as sub:
+            # subscribe BEFORE snapshotting the retained timeline, then
+            # dedup on (actor, seq): no event emitted during the handoff
+            # is lost, none is shown twice
+            replayed = set()
+            if job_id is not None:
+                for ev in journal.job_timeline(job_id):
+                    replayed.add((ev.get("actor"), ev.get("seq")))
+                    if not frame({"t": "event", "event": ev}):
+                        return
+            while not self._stopping:
+                for ev in sub.poll(timeout=poll_s):
+                    key = (ev.get("actor"), ev.get("seq"))
+                    # watch.gap markers carry seq=0 and must never dedup
+                    if ev.get("kind") != "watch.gap" and key in replayed:
+                        continue
+                    if not frame({"t": "event", "event": ev}):
+                        return
+                if replayed:
+                    replayed.clear()  # only the handoff window needs it
+                if job_id is None:
+                    continue
+                st = self.server.jobs.get_status(job_id)
+                graph = self.server.jobs.get_graph(job_id)
+                if graph is not None:
+                    prog = job_progress(graph)
+                    floor = monotonic_fraction(prog, floor)
+                    prog["fraction"] = floor
+                    if not frame({"t": "progress", "progress": prog,
+                                  "state": st.state if st else None}):
+                        return
+                if st is not None and st.state in _TERMINAL:
+                    frame({"t": "end", "state": st.state,
+                           "error": st.error})
+                    return
+
     # --- payloads --------------------------------------------------------
     def _state(self) -> dict:
         cluster = self.server.cluster
@@ -244,13 +337,14 @@ class RestApi:
             entry = {"job_id": job_id, "state": st.state, "error": st.error}
             graph = self.server.jobs.get_graph(job_id)
             if graph is not None:
-                total = sum(s.partitions for s in graph.stages.values())
-                done = sum(
-                    1 for s in graph.stages.values()
-                    for t in s.task_infos if t and t.state == "success")
+                # one computation for every surface: REST, watch frames and
+                # EXPLAIN ANALYZE all report obs/progress.py's fraction
+                prog = job_progress(graph)
                 entry["stages"] = len(graph.stages)
-                entry["tasks_completed"] = done
-                entry["tasks_total"] = total
+                entry["tasks_completed"] = prog["tasks_completed"]
+                entry["tasks_total"] = prog["tasks_total"]
+                entry["progress"] = prog["fraction"]
+                entry["eta_s"] = prog["eta_s"]
             out.append(entry)
         return out
 
@@ -266,6 +360,7 @@ class RestApi:
         graph = self.server.jobs.get_graph(job_id)
         if graph is None:
             return out
+        out["progress"] = job_progress(graph)
         stages = {}
         for sid in sorted(graph.stages):
             s = graph.stages[sid]
@@ -288,6 +383,9 @@ class RestApi:
         graph = self.server.jobs.get_graph(job_id)
         if graph is None:
             return []
+        # per-stage fractions come from the same obs/progress.py fold the
+        # job-level surfaces use, so the numbers always agree
+        prog = {s["stage_id"]: s for s in job_progress(graph)["stages"]}
         out = []
         for sid in sorted(graph.stages):
             s = graph.stages[sid]
@@ -295,8 +393,8 @@ class RestApi:
             out.append({
                 "stage_id": sid, "state": s.state,
                 "partitions": s.partitions,
-                "completed": sum(1 for t in s.task_infos
-                                 if t and t.state == "success"),
+                "completed": prog[sid]["tasks_completed"],
+                "fraction": prog[sid]["fraction"],
                 "attempt": s.stage_attempt,
                 "producers": s.producer_ids,
                 "consumers": s.output_links,

@@ -158,15 +158,23 @@ class SchedulerConfig:
                  fleet_lease_ttl_s: Optional[float] = None,
                  fleet_lease_renew_s: Optional[float] = None,
                  fleet_adopt_interval_s: Optional[float] = None,
-                 fleet_registry_stale_s: Optional[float] = None):
+                 fleet_registry_stale_s: Optional[float] = None,
+                 live_enabled: Optional[bool] = None,
+                 live_doctor_interval_s: Optional[float] = None,
+                 slo_p99_target_ms: Optional[float] = None,
+                 slo_window_s: Optional[float] = None):
         from ..utils.config import (BallistaConfig,
                                     CLUSTER_EXECUTOR_TIMEOUT_S,
                                     FLEET_ADOPT_INTERVAL_S,
                                     FLEET_LEASE_RENEW_S,
                                     FLEET_LEASE_TTL_S,
                                     FLEET_REGISTRY_STALE_S,
+                                    LIVE_DOCTOR_INTERVAL_S,
+                                    LIVE_ENABLED,
                                     QUARANTINE_FAILURES,
                                     QUARANTINE_PROBATION_S,
+                                    SLO_P99_TARGET_MS,
+                                    SLO_WINDOW_S,
                                     SPECULATION_ENABLED,
                                     SPECULATION_INTERVAL_S,
                                     SPECULATION_MAX_CONCURRENT,
@@ -246,6 +254,20 @@ class SchedulerConfig:
         self.fleet_registry_stale_s = float(
             fleet_registry_stale_s if fleet_registry_stale_s is not None
             else defaults.get(FLEET_REGISTRY_STALE_S))
+        # live observability plane (ballista.live.* / ballista.slo.*): the
+        # in-flight doctor cadence and the latency-SLO objective
+        self.live_enabled = bool(
+            live_enabled if live_enabled is not None
+            else defaults.get(LIVE_ENABLED))
+        self.live_doctor_interval_s = float(
+            live_doctor_interval_s if live_doctor_interval_s is not None
+            else defaults.get(LIVE_DOCTOR_INTERVAL_S))
+        self.slo_p99_target_ms = float(
+            slo_p99_target_ms if slo_p99_target_ms is not None
+            else defaults.get(SLO_P99_TARGET_MS))
+        self.slo_window_s = float(
+            slo_window_s if slo_window_s is not None
+            else defaults.get(SLO_WINDOW_S))
 
 
 class SchedulerServer:
@@ -347,6 +369,19 @@ class SchedulerServer:
         self._history_sampler: Optional[threading.Thread] = None  # ballista: guarded-by=none
         self._lease_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
         self._adopt_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        self._live_doctor_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        # live observability plane: in-flight doctor state machine (scan
+        # thread starts in init() only when ballista.live.enabled) and the
+        # latency-SLO tracker (null object when no target is configured)
+        from ..obs.live import LiveDoctor
+        from ..obs.slo import NullSloTracker, SloPolicy, SloTracker
+
+        self.live_doctor = LiveDoctor()
+        if self.config.slo_p99_target_ms > 0:
+            self.slo = SloTracker(SloPolicy(self.config.slo_p99_target_ms,
+                                            self.config.slo_window_s))
+        else:
+            self.slo = NullSloTracker()
         # cluster time series behind GET /api/cluster/history: periodic
         # utilization / queue-depth / event-loop-lag samples in a bounded
         # ring buffer (obs/stats.py)
@@ -397,6 +432,12 @@ class SchedulerServer:
                     target=self._adopt_loop, name="lease-adoption",
                     daemon=True)
                 self._adopt_thread.start()
+        if self.config.live_enabled \
+                and self.config.live_doctor_interval_s > 0:
+            self._live_doctor_thread = threading.Thread(
+                target=self._live_doctor_loop, name="live-doctor",
+                daemon=True)
+            self._live_doctor_thread.start()
 
     def shutdown(self, withdraw: bool = True) -> None:
         # withdraw=False is the chaos harness's crash-simulation: skip the
@@ -421,6 +462,8 @@ class SchedulerServer:
             self._lease_thread.join(timeout=5.0)
         if self._adopt_thread is not None:
             self._adopt_thread.join(timeout=5.0)
+        if self._live_doctor_thread is not None:
+            self._live_doctor_thread.join(timeout=5.0)
         # clean shutdown deliberately does NOT release job leases: a
         # shard stopping mid-job should look exactly like a crash so a
         # sibling adopts its jobs after one TTL.  Only the registry entry
@@ -967,7 +1010,12 @@ class SchedulerServer:
 
     def _registry_sample(self) -> Dict:
         s = self.cluster_sample()
-        return {k: s[k] for k in self._REGISTRY_KEYS}
+        out = {k: s[k] for k in self._REGISTRY_KEYS}
+        # SLO piggyback: raw (count, violations) pairs per burn window so
+        # any shard can merge a fleet-wide burn rate by summation (empty
+        # for the null tracker — wire shape unchanged when SLO is off)
+        out.update(self.slo.sample())
+        return out
 
     def _adopt_loop(self) -> None:
         while not self._stopped.wait(self.config.fleet_adopt_interval_s):
@@ -1327,8 +1375,12 @@ class SchedulerServer:
                     JobStatus(job_id, "successful", locations=payload))
                 with self._meta_lock:
                     queued_at = self._queued_at_ms.pop(job_id, 0)
-                self.metrics.record_completed(
-                    job_id, queued_at, int(time.time() * 1000))
+                done_ms = int(time.time() * 1000)
+                self.metrics.record_completed(job_id, queued_at, done_ms)
+                if queued_at:
+                    # SLO sample: queue-to-done wall time, the latency a
+                    # waiting client observed (no-op on the null tracker)
+                    self.slo.record(done_ms - queued_at, ok=True)
                 self._schedule_job_data_cleanup(graph)
             elif kind == "job_failed":
                 if journal.enabled():
@@ -1341,7 +1393,11 @@ class SchedulerServer:
                     JobStatus(job_id, "failed", error=str(payload)))
                 self.metrics.record_failed(job_id)
                 with self._meta_lock:
-                    self._queued_at_ms.pop(job_id, None)
+                    queued_at = self._queued_at_ms.pop(job_id, None)
+                # a failed job always burns SLO budget, whatever its wall time
+                self.slo.record(
+                    int(time.time() * 1000) - queued_at if queued_at else 0.0,
+                    ok=False)
                 self._cancel_running(graph)
                 self._schedule_job_data_cleanup(graph)
         self._drain_aqe_events(graph)
@@ -1550,9 +1606,49 @@ class SchedulerServer:
             + (total - avail)
         per_exec = max(1.0, total / max(1, out["executors_alive"]))
         out["desired_executors"] = int(-(-backlog // per_exec))
+        if self.slo.enabled:
+            # SLO-aware term: a burn rate above 1.0 means the latency
+            # budget is being consumed faster than it refills — ask for
+            # extra executors proportional to the overshoot even when the
+            # raw backlog alone would not scale (queueing shows up in
+            # latency before it shows up in slot arithmetic)
+            snap = self.slo.snapshot(
+                shard_samples=self._sibling_slo_samples())
+            burn = max(snap["windows"]["fast"]["burn_rate"],
+                       snap["windows"]["slow"]["burn_rate"])
+            # ceil(burn - 1), capped: a cold window with one slow job can
+            # read burn=100x, which must not demand 99 extra executors
+            boost = min(int(-(-(burn - 1.0) // 1)), 4) if burn > 1.0 else 0
+            out["desired_executors"] += boost
+            out["slo"] = {"burn_rate": burn, "scale_boost": boost,
+                          "windows": snap["windows"]}
         out["inflight_tasks"] = out["pending_tasks"]  # /api/scaler parity
         out["shards"] = shards
         return out
+
+    def _sibling_slo_samples(self) -> List[Dict]:
+        """Sibling shards' SLO (count, violations) pairs from the shard
+        registry — the fleet half of every burn-rate merge."""
+        store = getattr(self.job_backend, "store", None) \
+            if self._lease_capable else None
+        if store is None:
+            return []
+        from .kv import scheduler_registry
+
+        try:
+            reg = scheduler_registry(store,
+                                     self.config.fleet_registry_stale_s)
+        except Exception:  # noqa: BLE001 — fall back to local-only
+            log.exception("shard registry read failed")
+            return []
+        return [{k: v for k, v in (obj.get("sample") or {}).items()
+                 if k.startswith("slo_")}
+                for sid, obj in reg.items() if sid != self.scheduler_id]
+
+    def slo_report(self) -> Dict:
+        """GET /api/slo: the fleet-merged burn-rate report (or
+        ``{"enabled": false}`` when no objective is configured)."""
+        return self.slo.snapshot(shard_samples=self._sibling_slo_samples())
 
     def _history_loop(self) -> None:
         """Sampler thread: appends a cluster sample to the ring buffer and
@@ -1568,6 +1664,26 @@ class SchedulerServer:
             self.metrics.set_event_queue_depth(sample["event_queue_depth"])
             self.metrics.set_event_loop_lag(sample["event_loop_lag_s"])
             self.sync_journal_metrics()
+            if self.slo.enabled:
+                # shard-local burn gauges (fleet merge happens at
+                # /api/slo; prometheus sums/maxes across shards itself)
+                snap = self.slo.snapshot()
+                self.metrics.set_slo_burn_rate(
+                    "fast", snap["windows"]["fast"]["burn_rate"])
+                self.metrics.set_slo_burn_rate(
+                    "slow", snap["windows"]["slow"]["burn_rate"])
+
+    def _live_doctor_loop(self) -> None:
+        """In-flight doctor cadence (obs/live.py): evaluate the live rule
+        subset over running jobs, raise/clear journal alerts with
+        hysteresis, refresh the alerts_active gauge.  A sampler-style
+        thread (blocking waits allowed), never an event handler."""
+        while not self._stopped.wait(self.config.live_doctor_interval_s):
+            try:
+                self.live_doctor.scan(self)
+            except Exception:  # noqa: BLE001 — scan again next interval
+                log.exception("live doctor scan failed")
+            self.metrics.set_alerts_active(self.live_doctor.alerts_active())
 
     def sync_journal_metrics(self) -> None:
         """Fold the process-global journal counters into this collector as

@@ -81,9 +81,14 @@ class StandaloneCluster:
 
         self.launcher = InProcessTaskLauncher()
         if scheduler_config is None:
-            # honour the session's ballista.speculation.* keys (remote
-            # deployments do the same via SchedulerNetService)
-            from ..utils.config import (SPECULATION_ENABLED,
+            # honour the session's ballista.speculation.* and
+            # ballista.live./slo.* keys (remote deployments do the same
+            # via SchedulerNetService)
+            from ..utils.config import (LIVE_DOCTOR_INTERVAL_S,
+                                        LIVE_ENABLED,
+                                        SLO_P99_TARGET_MS,
+                                        SLO_WINDOW_S,
+                                        SPECULATION_ENABLED,
                                         SPECULATION_INTERVAL_S,
                                         SPECULATION_MAX_CONCURRENT,
                                         SPECULATION_MIN_RUNTIME_S,
@@ -99,7 +104,12 @@ class StandaloneCluster:
                 speculation_max_concurrent=int(
                     self.config.get(SPECULATION_MAX_CONCURRENT)),
                 speculation_interval_s=float(
-                    self.config.get(SPECULATION_INTERVAL_S)))
+                    self.config.get(SPECULATION_INTERVAL_S)),
+                live_enabled=bool(self.config.get(LIVE_ENABLED)),
+                live_doctor_interval_s=float(
+                    self.config.get(LIVE_DOCTOR_INTERVAL_S)),
+                slo_p99_target_ms=float(self.config.get(SLO_P99_TARGET_MS)),
+                slo_window_s=float(self.config.get(SLO_WINDOW_S)))
         self.scheduler = SchedulerServer(
             self.launcher, scheduler_config,
             observability=JobObservability.from_config(self.config))

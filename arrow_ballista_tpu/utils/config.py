@@ -128,6 +128,16 @@ COMPILE_ENABLED = "ballista.compile.enabled"
 COMPILE_MIN_OPS = "ballista.compile.min.ops"
 COMPILE_OPERATORS = "ballista.compile.operators"
 COMPILE_DONATE = "ballista.compile.donate"
+# live observability plane (obs/live.py + journal watch streams): in-flight
+# doctor alerts on a scheduler cadence, watch-stream subscriber bounds
+LIVE_ENABLED = "ballista.live.enabled"
+LIVE_DOCTOR_INTERVAL_S = "ballista.live.doctor.interval.seconds"
+LIVE_WATCH_QUEUE_EVENTS = "ballista.live.watch.queue.events"
+LIVE_WATCH_POLL_S = "ballista.live.watch.poll.seconds"
+# SLO tracker (obs/slo.py): declarative latency objective over completed
+# jobs, multi-window burn rates behind /api/slo and the autoscale signal
+SLO_P99_TARGET_MS = "ballista.slo.latency.p99.target.ms"
+SLO_WINDOW_S = "ballista.slo.window.seconds"
 
 
 @dataclasses.dataclass
@@ -552,6 +562,37 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "per-task buffers); a no-op on the CPU backend and "
                     "for agg-headed chains (the capacity-retry ladder "
                     "re-reads the input)"),
+        ConfigEntry(LIVE_ENABLED, False, _parse_bool,
+                    "live observability plane: run the in-flight doctor "
+                    "scan thread against running jobs (obs/live.py) and "
+                    "let the watch endpoints tail the journal; off = the "
+                    "scan thread never starts and nothing changes on the "
+                    "wire (docs/user-guide/live.md)"),
+        ConfigEntry(LIVE_DOCTOR_INTERVAL_S, 5.0, float,
+                    "cadence of the in-flight doctor scan over running "
+                    "jobs (straggler / partition-skew / shuffle-hotspot / "
+                    "control-plane-churn / journal-drops rules -> "
+                    "alert.raised / alert.cleared journal events); <= 0 "
+                    "disables the scan thread even when live is on"),
+        ConfigEntry(LIVE_WATCH_QUEUE_EVENTS, 1024, int,
+                    "bound of each watch subscriber's event queue; a "
+                    "consumer that falls behind sheds oldest events and "
+                    "receives one watch.gap event with the drop count "
+                    "(emit() never blocks on a slow watcher)"),
+        ConfigEntry(LIVE_WATCH_POLL_S, 0.25, float,
+                    "long-poll tick of the REST watch streams and "
+                    "ctx.watch(): how often a quiet stream re-checks job "
+                    "state and emits progress frames"),
+        ConfigEntry(SLO_P99_TARGET_MS, 0.0, float,
+                    "latency SLO: 99% of completed jobs must finish "
+                    "under this wall time (a failed job always counts as "
+                    "a violation); 0 disables SLO tracking entirely "
+                    "(null tracker, no samples kept)"),
+        ConfigEntry(SLO_WINDOW_S, 300.0, float,
+                    "slow burn-rate window of the SLO tracker in "
+                    "seconds; the fast window is 1/12 of it (the 1h/5m "
+                    "SRE ratio); served at /api/slo and summed into "
+                    "/api/autoscale"),
     ]
 }
 

@@ -1,0 +1,790 @@
+"""Live observability plane: watch streams, progress/ETA, in-flight
+doctor, SLO burn rates (ISSUE 17).
+
+Five layers, matching how the PR is built:
+
+  1. journal watch subscriptions: bounded per-subscriber queues, strict
+     ordering, overflow -> one leading ``watch.gap`` event (never an
+     emit()-side block), job filtering, reset/close lifecycle;
+  2. progress/ETA estimator: fraction + per-stage counts on synthetic
+     half-finished graphs, quantile ETA with the unresolved-stage
+     widening, front-loaded vs back-loaded fixtures, monotonic clamp;
+  3. in-flight doctor: a 2 s ``executor.task.slow`` straggler raises an
+     ``alert.raised`` while the job RUNS and clears on completion;
+     journal backpressure trips the standing ``journal-drops`` alert;
+  4. SLO tracker: multi-window burn-rate math, window pruning, fleet
+     sample merging, null-object wiring (and the wire-silence contract:
+     live plane off => no threads, no registry keys, no subscribers);
+  5. e2e watch: a standalone query watched end-to-end (ordered events,
+     monotone fraction, one terminal frame), the REST NDJSON stream, and
+     one chaos-marked fleet scenario — the owning shard killed mid-watch,
+     the stream continuing through lease adoption with the
+     ``lease.adopt`` marker in-band, no duplicates, no lost terminal.
+"""
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import faults
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.obs import journal
+from arrow_ballista_tpu.obs.live import CLEAR_AFTER, LiveDoctor
+from arrow_ballista_tpu.obs.progress import (
+    job_progress,
+    monotonic_fraction,
+    render_progress_bar,
+)
+from arrow_ballista_tpu.obs.slo import (
+    NullSloTracker,
+    SloPolicy,
+    SloTracker,
+    merge_samples,
+    tracker_from_config,
+)
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+
+@pytest.fixture(autouse=True)
+def _journal_on():
+    """Fresh, enabled journal per test (enable-only switch: standalone
+    cluster construction never force-disables it)."""
+    journal.reset()
+    journal.set_enabled(True)
+    journal.configure(capacity=4096)
+    faults.clear()
+    yield
+    faults.clear()
+    journal.reset()
+    journal.set_enabled(False)
+    journal.configure(capacity=4096)
+
+
+def _table(rng, n, groups=7):
+    return pa.table({
+        "g": pa.array(rng.integers(0, groups, n).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+    })
+
+
+def _standalone(conf=None, concurrent_tasks=2, num_executors=2):
+    base = {"ballista.shuffle.partitions": "4"}
+    base.update(conf or {})
+    return BallistaContext.standalone(BallistaConfig(base),
+                                      concurrent_tasks=concurrent_tasks,
+                                      num_executors=num_executors)
+
+
+def _wait_for(pred, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+SQL = "select g, sum(v) as s, count(*) as n from t group by g order by g"
+
+
+# --------------------------------------------------------------------------
+# 1. watch subscriptions
+# --------------------------------------------------------------------------
+
+def test_watch_subscription_orders_events():
+    with journal.subscribe(job_id="j1") as sub:
+        for i in range(10):
+            journal.emit("task.launch", job_id="j1", partition=i)
+        got = sub.drain()
+    assert [e["attrs"]["partition"] for e in got] == list(range(10))
+    seqs = [e["seq"] for e in got]
+    assert seqs == sorted(seqs)
+    assert all(e["kind"] == "task.launch" for e in got)
+
+
+def test_watch_subscription_filters_by_job():
+    with journal.subscribe(job_id="mine") as sub:
+        journal.emit("a", job_id="mine")
+        journal.emit("b", job_id="other")
+        journal.emit("c", job_id="mine")
+        kinds = [e["kind"] for e in sub.drain()]
+    assert kinds == ["a", "c"]
+    with journal.subscribe() as firehose:  # job_id=None follows everything
+        journal.emit("d", job_id="mine")
+        journal.emit("e", job_id="other")
+        assert [e["kind"] for e in firehose.drain()] == ["d", "e"]
+
+
+def test_watch_overflow_yields_gap_event_and_keeps_newest():
+    with journal.subscribe(job_id="j1", capacity=4) as sub:
+        for i in range(20):
+            journal.emit("ev", job_id="j1", i=i)
+        got = sub.poll(timeout=0)
+    # one leading synthetic gap event accounting for every shed event
+    assert got[0]["kind"] == "watch.gap"
+    assert got[0]["seq"] == 0  # must never dedup on (actor, seq)
+    assert got[0]["attrs"]["dropped"] == 16
+    # the queue kept the NEWEST capacity events, in order
+    assert [e["attrs"]["i"] for e in got[1:]] == [16, 17, 18, 19]
+
+
+def test_slow_subscriber_never_blocks_emit():
+    sub = journal.subscribe(job_id="j1", capacity=8)
+    try:
+        t0 = time.monotonic()
+        for i in range(5000):
+            assert journal.emit("ev", job_id="j1", i=i) is not None
+        elapsed = time.monotonic() - t0
+        # 5000 emits against a saturated, never-drained subscriber must
+        # be pure append/shed work — nothing remotely like a block
+        assert elapsed < 2.0
+        got = sub.drain()
+        assert got[0]["kind"] == "watch.gap"
+        assert got[0]["attrs"]["dropped"] == 5000 - 8
+        assert len(got) == 1 + 8
+    finally:
+        sub.close()
+    assert journal.watcher_count() == 0
+
+
+def test_closed_subscription_detaches_and_reset_closes():
+    sub = journal.subscribe()
+    assert journal.watcher_count() == 1
+    sub.close()
+    assert journal.watcher_count() == 0 and sub.closed
+    sub2 = journal.subscribe()
+    journal.reset()
+    assert sub2.closed and journal.watcher_count() == 0
+
+
+def test_disabled_journal_watch_is_zero_cost():
+    journal.set_enabled(False)
+    with journal.subscribe() as sub:
+        assert journal.emit("ev", job_id="j1") is None
+        assert sub.poll(timeout=0) == []
+    assert journal.counters() == (0, 0)
+
+
+# --------------------------------------------------------------------------
+# 2. progress / ETA estimator (synthetic graphs)
+# --------------------------------------------------------------------------
+
+class _Task:
+    def __init__(self, state, started_at=None):
+        self.state = state
+        self.started_at = started_at if started_at is not None \
+            else time.monotonic()
+
+
+class _Stage:
+    """Duck-typed ExecutionStage: enough surface for job_progress AND
+    the live doctor's stage_summary fold."""
+
+    def __init__(self, state, partitions, done=0, running=0, durations=(),
+                 stage_id=1):
+        self.state = state
+        self.partitions = partitions
+        self.task_infos = ([_Task("success")] * done
+                           + [_Task("running")] * running
+                           + [None] * (partitions - done - running))
+        self.speculative_tasks = {}
+        self.durations = list(durations)
+        self.stage_id = stage_id
+        self.stage_attempt = 0
+        self.planned_partitions = partitions
+        self.outputs = {}
+        self.attempt_log = []
+
+    def operator_metrics(self):
+        return {}
+
+
+class _Graph:
+    def __init__(self, stages, status="running", job_id="synth"):
+        self.stages = stages
+        self.status = status
+        self.job_id = job_id
+        self.stats = None
+
+
+def test_progress_half_finished_graph():
+    g = _Graph({1: _Stage("successful", 4, done=4, durations=[0.1] * 4),
+                2: _Stage("running", 4, done=0, running=2)})
+    p = job_progress(g)
+    assert p["fraction"] == 0.5
+    assert p["tasks_completed"] == 4 and p["tasks_total"] == 8
+    assert p["tasks_running"] == 2
+    assert [s["fraction"] for s in p["stages"]] == [1.0, 0.0]
+    # 4 remaining tasks x p50 0.1 s over 2 running lanes
+    assert p["eta_s"] == pytest.approx(0.2)
+
+
+def test_progress_terminal_states_clamp():
+    g = _Graph({1: _Stage("successful", 4, done=4)}, status="successful")
+    p = job_progress(g)
+    assert p["fraction"] == 1.0 and p["eta_s"] == 0.0
+    g2 = _Graph({1: _Stage("failed", 4, done=1)}, status="failed")
+    assert job_progress(g2)["eta_s"] == 0.0
+
+
+def test_progress_no_completions_no_eta():
+    g = _Graph({1: _Stage("running", 4, running=2)})
+    p = job_progress(g)
+    assert p["eta_s"] is None and p["eta_high_s"] is None
+
+
+def test_eta_widens_while_unresolved_stages_dominate():
+    # front-loaded: the remaining work is in RESOLVED stages -> the
+    # completed-duration quantiles describe it, interval stays tight
+    front = _Graph({
+        1: _Stage("successful", 8, done=8, durations=[0.2] * 8),
+        2: _Stage("running", 8, done=4, running=2, durations=[0.2] * 4),
+    })
+    # back-loaded: same counts, but the remaining tasks sit behind an
+    # UNRESOLVED stage whose operators have produced no durations yet
+    back = _Graph({
+        1: _Stage("successful", 8, done=8, durations=[0.2] * 8),
+        2: _Stage("running", 4, done=4, durations=[0.2] * 4),
+        3: _Stage("unresolved", 4),
+    })
+    pf, pb = job_progress(front), job_progress(back)
+    assert pf["tasks_total"] - pf["tasks_completed"] == \
+        pb["tasks_total"] - pb["tasks_completed"]
+    assert pf["eta_basis"]["unresolved_share"] == 0.0
+    assert pb["eta_basis"]["unresolved_share"] == 1.0
+    # identical quantiles, so only the widening separates the upper bounds
+    assert pb["eta_high_s"] > pf["eta_high_s"] * 2.0
+
+
+def test_monotonic_fraction_and_bar_render():
+    floor = 0.0
+    for frac, want in ((0.2, 0.2), (0.5, 0.5), (0.3, 0.5), (1.0, 1.0)):
+        floor = monotonic_fraction({"fraction": frac}, floor)
+        assert floor == want
+    bar = render_progress_bar({"fraction": 0.5, "tasks_completed": 4,
+                               "tasks_total": 8, "tasks_running": 2,
+                               "rows_per_sec": 1234.0, "eta_s": 1.5,
+                               "eta_high_s": 3.0, "state": "running"})
+    assert "50.0%" in bar and "4/8 tasks" in bar and "eta ~1.5s" in bar
+
+
+def test_progress_agreement_across_surfaces():
+    """One computation, every surface: /api/jobs, the stages endpoint,
+    EXPLAIN ANALYZE and a direct fold must report the same fraction."""
+    from arrow_ballista_tpu.obs.stats import explain_analyze_report
+    from arrow_ballista_tpu.scheduler.rest import RestApi
+
+    ctx = _standalone()
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(3), 4000))
+        ctx.sql(SQL).to_pandas()
+        sched = ctx._standalone.scheduler
+        job_id = ctx._standalone.last_job_id
+        graph = sched.jobs.get_graph(job_id)
+        direct = job_progress(graph)
+
+        api = RestApi(sched)
+        try:
+            entry = [j for j in api._jobs() if j["job_id"] == job_id][0]
+            assert entry["progress"] == direct["fraction"]
+            assert entry["tasks_completed"] == direct["tasks_completed"]
+            assert entry["tasks_total"] == direct["tasks_total"]
+            stages = api._stages(job_id)
+            assert [s["fraction"] for s in stages] == \
+                [s["fraction"] for s in direct["stages"]]
+            detail = api._job_detail(job_id)
+            assert detail["progress"]["fraction"] == direct["fraction"]
+        finally:
+            api._httpd.server_close()  # never started; close the socket
+        report = explain_analyze_report(graph)
+        assert report["progress"]["fraction"] == direct["fraction"]
+        assert report["progress"]["tasks_total"] == direct["tasks_total"]
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# 3. in-flight doctor
+# --------------------------------------------------------------------------
+
+def _stub_server(graphs=()):
+    jobs = types.SimpleNamespace(active_graphs=lambda: list(graphs))
+    return types.SimpleNamespace(jobs=jobs, cluster_history=lambda: {})
+
+
+def test_live_straggler_alert_raised_then_cleared():
+    """A 2 s ``executor.task.slow`` straggler must raise an in-flight
+    ``alert.raised`` WHILE the job runs, and the alert must clear once
+    the job finishes — both visible in the job's journal timeline."""
+    ctx = _standalone({
+        "ballista.live.enabled": "true",
+        "ballista.live.doctor.interval.seconds": "0.15",
+    })
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(23), 4000))
+        sched = ctx._standalone.scheduler
+        assert sched._live_doctor_thread is not None \
+            and sched._live_doctor_thread.is_alive()
+        plan = faults.FaultPlan.from_obj({"seed": 21, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 2000, "times": 1,
+            "match": {"stage_id": 1, "executor_id": "executor-0"}}]})
+        with faults.use_plan(plan):
+            ctx.sql(SQL).to_pandas()
+        assert plan.events, "the slow failpoint must actually have fired"
+        job_id = ctx._standalone.last_job_id
+
+        def kinds():
+            return [e["kind"] for e in journal.job_timeline(job_id)]
+
+        assert "alert.raised" in kinds(), \
+            "the in-flight doctor must have seen the straggler mid-run"
+        raised = [e for e in journal.job_timeline(job_id)
+                  if e["kind"] == "alert.raised"]
+        assert any(e["attrs"]["rule"] == "straggler" for e in raised)
+        f = [e for e in raised if e["attrs"]["rule"] == "straggler"][0]
+        assert f["attrs"]["evidence"]["oldest_running_task_s"] > 0.4
+        assert "speculation" in f["attrs"]["remedy"]
+        # the job left the running set -> the next scan clears the alert
+        _wait_for(lambda: "alert.cleared" in kinds(), 5.0,
+                  "standing alert must clear after the job finishes")
+        cleared = [e for e in journal.job_timeline(job_id)
+                   if e["kind"] == "alert.cleared"][0]
+        assert cleared["attrs"]["reason"] == "job-finished"
+        _wait_for(lambda: sched.live_doctor.alerts_active() == 0, 5.0,
+                  "no standing alerts after the run")
+    finally:
+        ctx.shutdown()
+
+
+def test_live_doctor_clear_hysteresis_inline():
+    """Deterministic raise/clear against a synthetic graph: the alert
+    raises on one tripping scan and needs CLEAR_AFTER clean scans."""
+    stage = _Stage("running", 4, done=2, running=1,
+                   durations=[0.05, 0.06])
+    stage.task_infos[2].started_at = time.monotonic() - 10.0  # ancient
+    g = _Graph({1: stage}, job_id="live-synth")
+    doc = LiveDoctor()
+    doc.scan(_stub_server([g]))
+    assert doc.alerts_active() == 1
+    assert doc.active_findings()[0]["rule"] == "straggler"
+    tl = journal.job_timeline("live-synth")
+    assert [e["kind"] for e in tl] == ["alert.raised"]
+    # same condition still tripping: deduped, no second raise
+    doc.scan(_stub_server([g]))
+    assert len(journal.job_timeline("live-synth")) == 1
+    # condition goes away: needs CLEAR_AFTER consecutive clean scans
+    stage.task_infos[2] = _Task("success")
+    for i in range(CLEAR_AFTER):
+        assert doc.alerts_active() == 1
+        doc.scan(_stub_server([g]))
+    assert doc.alerts_active() == 0
+    kinds = [e["kind"] for e in journal.job_timeline("live-synth")]
+    assert kinds == ["alert.raised", "alert.cleared"]
+
+
+def test_journal_drops_standing_alert():
+    """Backpressure alarm: a saturated ring trips the standing
+    ``journal-drops`` alert; a reset clears it."""
+    journal.configure(capacity=8)
+    doc = LiveDoctor()
+    doc.scan(_stub_server())
+    assert doc.alerts_active() == 0  # nothing dropped yet
+    for i in range(50):
+        journal.emit("ev", i=i)
+    assert journal.counters()[1] > 0
+    doc.scan(_stub_server())
+    assert doc.alerts_active() == 1
+    f = doc.active_findings()[0]
+    assert f["rule"] == "journal-drops" and f["job_id"] == ""
+    assert f["evidence"]["journal_events_dropped_total"] > 0
+    assert "ballista.journal.capacity" in f["remedy"]
+    drops_alert = [e for e in journal.snapshot()
+                   if e["kind"] == "alert.raised"]
+    assert drops_alert and \
+        drops_alert[-1]["attrs"]["rule"] == "journal-drops"
+    # counters reset (the operator raised capacity / restarted): clears
+    journal.reset()
+    doc.scan(_stub_server())
+    assert doc.alerts_active() == 0
+
+
+def test_journal_drops_zero_cost_when_disabled():
+    journal.set_enabled(False)
+    journal.configure(capacity=8)
+    for i in range(50):
+        journal.emit("ev", i=i)
+    assert journal.counters() == (0, 0)
+    doc = LiveDoctor()
+    doc.scan(_stub_server())
+    assert doc.alerts_active() == 0
+
+
+# --------------------------------------------------------------------------
+# 4. SLO tracker
+# --------------------------------------------------------------------------
+
+def test_slo_burn_rate_math():
+    # window 120 s -> fast window 10 s; p99 target 100 ms
+    tr = SloTracker(SloPolicy(100.0, 120.0))
+    now = 1_000_000.0
+    for i in range(98):
+        tr.record(50.0, ok=True, ts=now)
+    tr.record(500.0, ok=True, ts=now)   # over target -> violation
+    tr.record(50.0, ok=False, ts=now)   # failure -> violation
+    snap = tr.snapshot(now=now)
+    fast = snap["windows"]["fast"]
+    assert fast["count"] == 100 and fast["violations"] == 2
+    assert fast["violation_fraction"] == pytest.approx(0.02)
+    # 2% observed vs 1% allowed -> burning budget at 2x
+    assert fast["burn_rate"] == pytest.approx(2.0)
+    assert tr.max_burn_rate(now=now) == pytest.approx(2.0)
+
+
+def test_slo_window_pruning_and_fast_slow_divergence():
+    tr = SloTracker(SloPolicy(100.0, 120.0))
+    now = time.time()
+    # old violations: outside the 10 s fast window, inside the slow one
+    for _ in range(10):
+        tr.record(500.0, ok=True, ts=now - 60.0)
+    for _ in range(10):
+        tr.record(50.0, ok=True, ts=now)
+    snap = tr.snapshot()
+    assert snap["windows"]["fast"]["violations"] == 0
+    assert snap["windows"]["slow"]["violations"] == 10
+    # beyond the slow window: pruned entirely on the next record
+    tr.record(50.0, ok=True, ts=now + 121.0)
+    assert tr.snapshot()["windows"]["slow"]["count"] <= 1
+
+
+def test_slo_fleet_merge():
+    tr = SloTracker(SloPolicy(100.0, 120.0))
+    now = time.time()
+    tr.record(50.0, ok=True, ts=now)
+    sibling = {"slo_fast_count": 99, "slo_fast_violations": 3,
+               "slo_slow_count": 99, "slo_slow_violations": 3}
+    snap = tr.snapshot(shard_samples=[sibling])
+    assert snap["windows"]["fast"]["count"] == 100
+    assert snap["windows"]["fast"]["violations"] == 3
+    assert snap["windows"]["fast"]["burn_rate"] == pytest.approx(3.0)
+    merged = merge_samples([sibling, sibling])
+    assert merged["slo_fast_count"] == 198
+
+
+def test_slo_null_object_and_config_wiring():
+    null = tracker_from_config(BallistaConfig())  # target unset -> 0.0
+    assert isinstance(null, NullSloTracker) and not null.enabled
+    null.record(1e9, ok=False)
+    assert null.sample() == {} and null.max_burn_rate() == 0.0
+    assert null.snapshot() == {"enabled": False}
+    real = tracker_from_config(BallistaConfig({
+        "ballista.slo.latency.p99.target.ms": "250",
+        "ballista.slo.window.seconds": "600"}))
+    assert isinstance(real, SloTracker)
+    assert real.policy.p99_target_ms == 250.0
+    assert real.policy.fast_window_s == pytest.approx(50.0)
+
+
+def test_wire_silence_when_live_plane_off():
+    """Default config: no live-doctor thread, null SLO tracker, no
+    registry sample keys beyond the pre-PR set, no journal subscribers —
+    the plane is zero-cost and wire-silent when off."""
+    ctx = _standalone()
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(1), 1000))
+        ctx.sql(SQL).to_pandas()
+        sched = ctx._standalone.scheduler
+        assert sched._live_doctor_thread is None
+        assert isinstance(sched.slo, NullSloTracker)
+        assert set(sched._registry_sample()) == set(sched._REGISTRY_KEYS)
+        assert "slo" not in sched.autoscale_signal()
+        assert journal.watcher_count() == 0
+        # task statuses carry nothing new: the serde shape is untouched
+        from arrow_ballista_tpu import serde
+        from arrow_ballista_tpu.scheduler.types import TaskId, TaskStatus
+
+        obj = serde.status_to_obj(TaskStatus(
+            TaskId("j", 1, 0, 0), "executor-0", "success"))
+        assert not any(k.startswith(("slo", "live", "watch"))
+                       for k in obj)
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# 5. e2e watch streams
+# --------------------------------------------------------------------------
+
+def _assert_watch_frames(frames, require_events=True):
+    """Shared frame-stream contract: ordering, monotone fraction, one
+    terminal frame at the very end, no duplicate events."""
+    assert frames, "watch stream yielded nothing"
+    kinds = [f["t"] for f in frames]
+    assert kinds[-1] == "end" and kinds.count("end") == 1
+    assert kinds.count("progress") >= 1
+    if require_events:
+        assert kinds.count("event") >= 1
+    seen = set()
+    for f in frames:
+        if f["t"] != "event" or f["event"].get("kind") == "watch.gap":
+            continue
+        key = (f["event"].get("actor"), f["event"].get("seq"))
+        assert key not in seen, f"duplicate event in stream: {f['event']}"
+        seen.add(key)
+    fracs = [f["progress"]["fraction"] for f in frames
+             if f["t"] == "progress"]
+    assert all(a <= b for a, b in zip(fracs, fracs[1:])), \
+        f"fraction must be monotonically non-decreasing: {fracs}"
+    return frames[-1]
+
+
+def test_standalone_watch_stream_end_to_end():
+    ctx = _standalone()
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(5), 4000))
+        ctx.sql(SQL).to_pandas()
+        frames = list(ctx.watch())  # defaults to the last job
+        end = _assert_watch_frames(frames)
+        assert end["state"] == "successful" and not end["error"]
+        ev_kinds = {f["event"]["kind"] for f in frames
+                    if f["t"] == "event"}
+        assert "job.submitted" in ev_kinds
+        assert journal.watcher_count() == 0  # stream detached cleanly
+    finally:
+        ctx.shutdown()
+
+
+def test_standalone_watch_live_during_run():
+    """Watch a job WHILE it runs: progress frames must appear before the
+    terminal frame and the fraction must move."""
+    ctx = _standalone({"ballista.speculation.enabled": "false"},
+                      concurrent_tasks=1, num_executors=1)
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(7), 4000))
+        plan = faults.FaultPlan.from_obj({"seed": 3, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 150, "times": -1}]})
+        frames = []
+        errs = []
+
+        def run():
+            try:
+                ctx.sql(SQL).to_pandas()
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        with faults.use_plan(plan):
+            q = threading.Thread(target=run, daemon=True)
+            q.start()
+            _wait_for(lambda: ctx._standalone.last_job_id is not None,
+                      10.0, "job should be submitted")
+            for frame in ctx.watch(ctx._standalone.last_job_id,
+                                   timeout=60.0):
+                frames.append(frame)
+            q.join(timeout=30.0)
+        assert not errs, errs
+        end = _assert_watch_frames(frames)
+        assert end["state"] == "successful"
+        # a mid-run progress frame existed (not only the 1.0 snapshot)
+        fracs = [f["progress"]["fraction"] for f in frames
+                 if f["t"] == "progress"]
+        assert fracs[0] < 1.0
+    finally:
+        ctx.shutdown()
+
+
+def test_rest_watch_stream_ndjson():
+    from arrow_ballista_tpu.scheduler.rest import RestApi
+
+    ctx = _standalone()
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(9), 2000))
+        ctx.sql(SQL).to_pandas()
+        job_id = ctx._standalone.last_job_id
+        api = RestApi(ctx._standalone.scheduler)
+        api.start()
+        try:
+            base = f"http://{api.host}:{api.port}"
+            resp = urllib.request.urlopen(
+                f"{base}/api/job/{job_id}/watch", timeout=30)
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            frames = [json.loads(line) for line in resp]
+            end = _assert_watch_frames(frames)
+            assert end["state"] == "successful"
+            # 404 for a job nobody ran
+            try:
+                urllib.request.urlopen(f"{base}/api/job/nope/watch",
+                                       timeout=10)
+                raise AssertionError("unknown job must 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            # /api/slo rides the same server (null tracker here)
+            slo = json.load(urllib.request.urlopen(f"{base}/api/slo",
+                                                   timeout=10))
+            assert slo == {"enabled": False}
+        finally:
+            api.stop()
+    finally:
+        ctx.shutdown()
+
+
+def test_slo_feeds_from_completed_jobs_and_reaches_surfaces():
+    """A sub-millisecond p99 target makes every real job a violation:
+    the burn rate must move on /api/slo, the autoscale signal and the
+    prometheus families."""
+    ctx = _standalone({
+        "ballista.slo.latency.p99.target.ms": "0.001",
+        "ballista.slo.window.seconds": "300",
+    })
+    try:
+        ctx.register_table("t", _table(np.random.default_rng(11), 2000))
+        ctx.sql(SQL).to_pandas()
+        sched = ctx._standalone.scheduler
+        assert isinstance(sched.slo, SloTracker)
+        snap = sched.slo_report()
+        assert snap["enabled"] and \
+            snap["windows"]["fast"]["violations"] >= 1
+        assert snap["windows"]["fast"]["burn_rate"] > 1.0
+        sig = sched.autoscale_signal()
+        assert sig["slo"]["burn_rate"] > 1.0
+        assert 1 <= sig["slo"]["scale_boost"] <= 4
+        sched.sync_journal_metrics()
+        sched.metrics.set_slo_burn_rate(
+            "fast", snap["windows"]["fast"]["burn_rate"])
+        text = sched.metrics.gather()
+        assert "# TYPE slo_burn_rate gauge" in text
+        assert 'slo_burn_rate{window="fast"}' in text
+        assert "# TYPE alerts_active gauge" in text
+    finally:
+        ctx.shutdown()
+
+
+# --------------------------------------------------------------------------
+# chaos: SIGKILL the owning shard mid-watch -> one continuous stream
+# --------------------------------------------------------------------------
+
+FLEET_CONF = {
+    "ballista.shuffle.partitions": "4",
+    "ballista.journal.enabled": "true",
+    "ballista.rpc.connect.timeout.seconds": "1.0",
+    "ballista.rpc.read.timeout.seconds": "10.0",
+    "ballista.rpc.retry.base.seconds": "0.05",
+    "ballista.rpc.retry.cap.seconds": "0.2",
+    "ballista.rpc.retry.deadline.seconds": "1.5",
+    "ballista.shuffle.local.host_match": "false",
+    "ballista.fleet.lease.ttl.seconds": "1.5",
+    "ballista.fleet.lease.renew.seconds": "0.4",
+    "ballista.fleet.adopt.interval.seconds": "0.4",
+    "ballista.fleet.registry.stale.seconds": "5.0",
+}
+
+
+@pytest.mark.chaos
+def test_fleet_shard_killed_mid_watch_stream_continues(tmp_path):
+    """Kill the owning shard while a client watches its job: the stream
+    must continue through lease adoption as ONE timeline — the
+    ``lease.adopt`` marker in-band, no duplicate events, the terminal
+    frame delivered."""
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.kv import MemoryKv
+    from arrow_ballista_tpu.scheduler.kv_remote import KvServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+    from arrow_ballista_tpu.scheduler.scheduler import SchedulerConfig
+
+    kv = KvServer(MemoryKv(), "127.0.0.1", 0)
+    kv.start()
+    sconf = dict(task_distribution="round-robin", executor_timeout_s=3.0,
+                 reaper_interval_s=0.3, fleet_lease_ttl_s=1.5,
+                 fleet_lease_renew_s=0.4, fleet_adopt_interval_s=0.4,
+                 fleet_registry_stale_s=5.0)
+    shards, executors, c = [], [], None
+    try:
+        for _ in range(2):
+            s = SchedulerNetService(
+                "127.0.0.1", 0, config=BallistaConfig(FLEET_CONF),
+                scheduler_config=SchedulerConfig(**sconf),
+                cluster_url=f"kv://{kv.host}:{kv.port}")
+            s.start()
+            shards.append(s)
+        eps = [("127.0.0.1", s.port) for s in shards]
+        for i in range(2):
+            work = tmp_path / f"exec{i}"
+            work.mkdir()
+            ex = ExecutorServer("127.0.0.1", eps[0][1], "127.0.0.1", 0,
+                                work_dir=str(work), concurrent_tasks=1,
+                                executor_id=f"watch-exec-{i}",
+                                config=BallistaConfig(FLEET_CONF),
+                                heartbeat_interval_s=0.4,
+                                scheduler_endpoints=eps)
+            ex.start()
+            executors.append(ex)
+        c = BallistaContext.remote(config=BallistaConfig(FLEET_CONF),
+                                   endpoints=eps)
+        rng = np.random.default_rng(13)
+        c.register_table("t", _table(rng, 8000))
+
+        result, errors, frames = [], [], []
+        plan = faults.FaultPlan.from_obj({"seed": 5, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 400, "times": -1}]})
+
+        def run_query():
+            try:
+                result.append(c.sql(SQL).to_pandas())
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errors.append(e)
+
+        with faults.use_plan(plan):
+            q = threading.Thread(target=run_query, daemon=True)
+            q.start()
+            _wait_for(lambda: shards[0].server._leases, 10.0,
+                      "primary shard should claim the job lease")
+            job_id = next(iter(shards[0].server._leases))
+
+            def watch():
+                try:
+                    for frame in c._remote.watch(job_id, timeout=90.0):
+                        frames.append(frame)
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    errors.append(e)
+
+            w = threading.Thread(target=watch, daemon=True)
+            w.start()
+            _wait_for(lambda: frames, 15.0,
+                      "the watch should stream before the kill")
+            shards[0].kill()  # in-process kill -9: no goodbyes
+            q.join(timeout=90.0)
+            w.join(timeout=90.0)
+
+        assert not q.is_alive() and not w.is_alive()
+        assert not errors, f"query/watch failed across failover: {errors}"
+        end = _assert_watch_frames(frames)
+        assert end["state"] == "successful", \
+            "the terminal frame must survive the failover"
+        ev_kinds = [f["event"]["kind"] for f in frames
+                    if f["t"] == "event"]
+        assert "lease.adopt" in ev_kinds, \
+            "the adoption marker must appear in-band in the stream"
+    finally:
+        if c is not None:
+            c.shutdown()
+        for ex in executors:
+            try:
+                ex.stop(notify=False)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        for s in shards:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            kv.stop()
+        except Exception:  # noqa: BLE001
+            pass

@@ -12,7 +12,9 @@
 #      advisor, AQE rewrites + rollback + serde, flight-recorder journal
 #      + forensics bundles + seeded-pathology diagnosis, whole-stage
 #      compiler: chain detection, allowlist verdicts, fused-vs-interpreted
-#      equality, fusion serde + rollback/speculation/chaos interplay),
+#      equality, fusion serde + rollback/speculation/chaos interplay,
+#      live observability: watch-stream ordering/gap semantics, the
+#      progress/ETA estimator, in-flight doctor alerts, SLO burn rates),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
 #      quarantine, straggler speculation, corrupt-shuffle checksums) plus
@@ -29,17 +31,22 @@
 #      ballista.forensics/v1 schema, carry a complete journal timeline,
 #      and the query doctor must return zero findings on the healthy
 #      run,
-#   6. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
+#   6. the live-obs smoke: one standalone query with the live plane on,
+#      then watched via ctx.watch() — at least one progress frame with a
+#      monotonically non-decreasing fraction, a terminal frame, and zero
+#      journal drops,
+#   7. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
 #      sessions of repeated q6 variants through the prepared-plan +
 #      result caches — zero errors and a nonzero plan-cache hit rate,
 #      also under the runtime lock-order validator,
-#   7. the fleet serving smoke (--smoke --shards 2): the same workload
+#   8. the fleet serving smoke (--smoke --shards 2): the same workload
 #      against a 2-shard scheduler fleet behind a shared KV, then a
 #      failover leg that crash-kills shard 0 mid-run — both legs must
 #      complete every query with zero errors,
-#   8. the perf gate (tools/perf_gate.py): newest BENCH_r*.json round vs
+#   9. the perf gate (tools/perf_gate.py): newest BENCH_r*.json round vs
 #      the previous clean round, per-query wall time and throughput —
-#      warn-only here because container bench numbers are noisy.
+#      STRICT since PR 17: regressions past the tolerance fail; override
+#      with BALLISTA_PERF_TOLERANCE on noisy hardware.
 # tests/test_static_analysis.py also runs the lint suite inside tier-1, so
 # pytest alone still gates new violations; this script is the fast
 # standalone form for CI and pre-push hooks.
@@ -54,17 +61,17 @@ python -m arrow_ballista_tpu.analysis
 echo "== generated docs up to date =="
 python docs/gen_configs.py --check
 
-echo "== analysis + concurrency + serde + speculation + observability + aqe + compile test files =="
+echo "== analysis + concurrency + serde + speculation + observability + aqe + compile + live-obs test files =="
 python -m pytest tests/test_static_analysis.py tests/test_concurrency.py \
     tests/test_serde_wire.py tests/test_speculation.py \
     tests/test_observatory.py tests/test_device_obs.py tests/test_aqe.py \
-    tests/test_doctor.py tests/test_compile.py \
+    tests/test_doctor.py tests/test_compile.py tests/test_live_obs.py \
     -q -p no:cacheprovider -m 'not chaos'
 
 echo "== chaos recovery + fleet HA suites (-m chaos, runtime lock-order validation on) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 \
     python -m pytest tests/test_chaos.py tests/test_fleet.py \
-    tests/test_doctor.py tests/test_compile.py \
+    tests/test_doctor.py tests/test_compile.py tests/test_live_obs.py \
     -q -m chaos -p no:cacheprovider
 
 echo "== doctor smoke (flight recorder on: bundle validates, clean run diagnoses clean) =="
@@ -106,15 +113,58 @@ finally:
     ctx.shutdown()
 EOF
 
+echo "== live-obs smoke (watch a real query: progress frames, terminal frame, zero drops) =="
+python - <<'EOF'
+import numpy as np
+import pyarrow as pa
+
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.obs import journal
+from arrow_ballista_tpu.utils.config import BallistaConfig
+
+ctx = BallistaContext.standalone(
+    BallistaConfig({"ballista.journal.enabled": "true",
+                    "ballista.live.enabled": "true",
+                    "ballista.live.doctor.interval.seconds": "0.5",
+                    "ballista.shuffle.partitions": "4"}),
+    concurrent_tasks=2, num_executors=2)
+try:
+    rng = np.random.default_rng(17)
+    ctx.register_table("t", pa.table({
+        "g": pa.array(rng.integers(0, 7, 4000), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 100, 4000), type=pa.int64())}))
+    ctx.sql("select g, sum(v) as s from t group by g order by g").collect()
+    frames = list(ctx.watch())
+    kinds = [f["t"] for f in frames]
+    assert kinds.count("progress") >= 1, kinds
+    assert kinds[-1] == "end" and frames[-1]["state"] == "successful", \
+        frames[-1]
+    fracs = [f["progress"]["fraction"] for f in frames
+             if f["t"] == "progress"]
+    assert all(a <= b for a, b in zip(fracs, fracs[1:])), fracs
+    emitted, dropped = journal.counters()
+    assert emitted > 0 and dropped == 0, (emitted, dropped)
+    assert journal.watcher_count() == 0  # the stream detached cleanly
+    print(f"live-obs smoke ok: {kinds.count('event')} event frames, "
+          f"{kinds.count('progress')} progress frames, final fraction "
+          f"{fracs[-1] if fracs else 'n/a'}, 0 journal drops")
+finally:
+    ctx.shutdown()
+EOF
+
 echo "== serving smoke (8 sessions x q6, caches on, runtime lock-order validation on) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 python -m benchmarks.serving --smoke
 
 echo "== fleet serving smoke (2 shards + mid-run shard-kill failover) =="
 BALLISTA_LOCK_ORDER_RUNTIME=1 python -m benchmarks.serving --smoke --shards 2
 
-echo "== perf gate (warn-only: bench rounds vs previous clean round) =="
-# Container bench numbers are noisy; the gate reports per-query regressions
-# but never fails CI here.  Use --strict on stable hardware.
-python tools/perf_gate.py || echo "perf gate: reporting failed (non-fatal)"
+echo "== perf gate (strict: newest bench round vs previous clean round) =="
+# Strict since PR 17: a regression past the tolerance fails CI.  Container
+# bench numbers are noisy, so the tolerance is generous by default and
+# overridable per-host (BALLISTA_PERF_TOLERANCE=0.60 tools/run_checks.sh);
+# p9x tails and sub-10ms wall-time deltas are advisory-only (see the gate's
+# module docstring).
+python tools/perf_gate.py --strict \
+    --tolerance "${BALLISTA_PERF_TOLERANCE:-0.40}"
 
 echo "all checks passed"
