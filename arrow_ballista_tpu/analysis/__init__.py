@@ -14,6 +14,7 @@ from .framework import (
     json_report,
     register,
     run_lints,
+    sarif_report,
     text_report,
 )
 from .plan_checks import (
@@ -34,6 +35,7 @@ __all__ = [
     "json_report",
     "register",
     "run_lints",
+    "sarif_report",
     "text_report",
     "validate_graph",
     "validate_rewrite",

@@ -8,7 +8,8 @@ import argparse
 import os
 import sys
 
-from .framework import all_rules, json_report, run_lints, text_report
+from .framework import (all_rules, json_report, run_lints, sarif_report,
+                        text_report)
 
 
 def default_root() -> str:
@@ -27,6 +28,9 @@ def main(argv=None) -> int:
                         help="comma-separated subset of rules to run")
     parser.add_argument("--json", action="store_true",
                         help="emit a JSON report instead of text")
+    parser.add_argument("--sarif", action="store_true",
+                        help="emit a SARIF 2.1.0 log (for CI inline "
+                             "annotation); takes precedence over --json")
     parser.add_argument("--list-rules", action="store_true",
                         help="list registered rules and exit")
     args = parser.parse_args(argv)
@@ -43,7 +47,11 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
-    print(json_report(violations) if args.json else text_report(violations))
+    if args.sarif:
+        print(sarif_report(violations))
+    else:
+        print(json_report(violations) if args.json
+              else text_report(violations))
     return 1 if violations else 0
 
 

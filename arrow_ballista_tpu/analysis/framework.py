@@ -160,7 +160,7 @@ def register(cls: Type[Rule]) -> Type[Rule]:
 
 
 def all_rules() -> Dict[str, Type[Rule]]:
-    from . import concurrency, rules  # noqa: F401 — importing registers
+    from . import concurrency, jit_discipline, rules  # noqa: F401 — importing registers
 
     return dict(_REGISTRY)
 
@@ -204,6 +204,53 @@ def text_report(violations: Sequence[Violation]) -> str:
 def json_report(violations: Sequence[Violation]) -> str:
     return json.dumps({"violations": [dataclasses.asdict(v) for v in violations],
                        "count": len(violations)}, indent=2)
+
+
+def sarif_report(violations: Sequence[Violation]) -> str:
+    """SARIF 2.1.0 log for CI inline annotation (one run, one driver).
+
+    Rule metadata comes from the registry; findings synthesized by the
+    runner itself (the ``syntax`` pseudo-rule) get a minimal stub so the
+    log always validates."""
+    registry = all_rules()
+    rule_ids = sorted({v.rule for v in violations} | set(registry))
+    rules = []
+    for rid in rule_ids:
+        cls = registry.get(rid)
+        desc = (cls.description if cls is not None
+                else "file could not be parsed")
+        rules.append({"id": rid,
+                      "shortDescription": {"text": desc}})
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = [{
+        "ruleId": v.rule,
+        "ruleIndex": index[v.rule],
+        "level": "error",
+        "message": {"text": v.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": v.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(v.line, 1)},
+            },
+        }],
+    } for v in violations]
+    log = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ballista-analysis",
+                "informationUri": ("https://github.com/apache/"
+                                   "arrow-ballista"),
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+    return json.dumps(log, indent=2)
 
 
 # --------------------------------------------------------------------------

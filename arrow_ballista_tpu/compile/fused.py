@@ -170,8 +170,12 @@ class FusedStageExec(ExecutionPlan):
             if jax.default_backend() != "cpu":
                 # donation is a no-op warning on CPU; the agg path re-calls
                 # the program on the same buffers during the capacity-retry
-                # ladder, so only row-only chains donate
-                donate_kw["donate_argnums"] = (0,)
+                # ladder, so only row-only chains donate.  The mask (arg 1)
+                # rides the same donation-safety proof as the columns: both
+                # come off a fresh ShuffleReaderExec batch rebound per loop
+                # iteration and are dead after the call, so XLA can alias
+                # the output mask into the input mask buffer too.
+                donate_kw["donate_argnums"] = (0, 1)
 
         if agg is None:
             def fused_rows(cols, mask, auxs):

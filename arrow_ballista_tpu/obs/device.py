@@ -20,6 +20,13 @@ on an accelerator:
 - **Memory watermarks**: live device-buffer bytes (``jax.live_arrays``)
   and host RSS peak, sampled at task and operator boundaries.
 
+The static mirror of this runtime view is
+``analysis/jit_discipline.py``: it models every ``observed_jit`` site
+ahead of time (trace-key stability, donation safety, host/device
+boundary) and reports findings under the same operator signatures these
+counters use, so a predicted retrace storm and a measured one carry the
+same name.
+
 Attribution is scope-based and thread-local: ``TaskContext.op_span``
 enters an *op scope* (the operator's MetricsSet), the executor's
 ``run_task`` enters a *task scope* (a per-task accumulator that becomes

@@ -147,6 +147,7 @@ def _shard_batch(big: ColumnBatch, mesh, n_dev: int):
         if padded != rows:
             pad = jnp.full((padded - rows,), fill, arr.dtype)
             arr = jnp.concatenate([arr, pad])
+        # ballista: allow=host-device-boundary — mesh placement, not a host crossing: the source is already device-resident; byte accounting lands with the shard_map port (ROADMAP #1)
         return jax.device_put(arr, sharding)
 
     return ({k: shard(v) for k, v in big.columns.items()},
@@ -586,6 +587,7 @@ class MeshJoinExec(ExecutionPlan):
                 if padded != rows:
                     arr = jnp.concatenate(
                         [arr, jnp.full((padded - rows,), fill, arr.dtype)])
+                # ballista: allow=host-device-boundary — mesh placement, not a host crossing: the source is already device-resident; byte accounting lands with the shard_map port (ROADMAP #1)
                 return jax.device_put(arr, sharding)
 
             return ({k: pad(v) for k, v in cols.items()},

@@ -277,6 +277,43 @@ def test_row_chain_fused_matches_interpreted():
     assert fused.schema.names() == proj.schema.names()
 
 
+def test_row_chain_donates_columns_and_mask(monkeypatch):
+    """ROADMAP #2 via the donation-safety analyzer: the mask (arg 1) is
+    provably dead after the fused row call — same freshness proof as the
+    columns — so row-only chains donate BOTH buffers.  CPU gates donation
+    off, so force the gate and capture what _build hands observed_jit."""
+    import jax
+
+    from arrow_ballista_tpu.compile import fused as fused_mod
+
+    captured = {}
+    real = fused_mod.observed_jit
+
+    def spy(sig, fn=None, **kw):
+        captured[sig] = dict(kw)
+        return real(sig, fn, **kw)
+
+    monkeypatch.setattr(fused_mod, "observed_jit", spy)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    proj, filt, _ = _chain(n=100, partitions=1)
+    fused = FusedStageExec([proj, filt], donate=True)
+    fused._build(_ctx())
+    assert captured[fused.fused_sig()]["donate_argnums"] == (0, 1)
+
+    # the agg-headed chain must never donate: the capacity-retry ladder
+    # re-calls the program on the same buffers
+    scan = _scan(n=100, partitions=1)
+    filt_a = O.FilterExec(scan, E.BinOp(">", E.Column("x"), E.Lit(5)))
+    agg = O.HashAggregateExec(
+        filt_a, [(E.Column("y"), "y")],
+        [O.AggSpec("sum", E.Column("x"), "sx")], "partial")
+    fused_a = FusedStageExec([agg, filt_a], donate=True)
+    captured.clear()
+    fused_a._build(_ctx())
+    assert "donate_argnums" not in captured[fused_a.fused_sig()]
+
+
 def test_agg_chain_fused_matches_interpreted():
     ctx = _ctx()
 

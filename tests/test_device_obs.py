@@ -109,6 +109,57 @@ def test_observed_jit_static_args_key_by_value_positionally():
     assert d["jit_cache_hits"] == 1
 
 
+def test_alias_churn_flagged_statically_and_counted_at_runtime(tmp_path):
+    """Static/runtime agreement: the alias-churn scenario the
+    trace-key-stability lint predicts (batch-varying column names flowing
+    into a static arg) is the same one the observatory counts as
+    retraces — one per distinct alias set, under the same signature."""
+    import textwrap
+
+    import jax.numpy as jnp
+
+    from arrow_ballista_tpu.analysis import run_lints
+
+    # static half: the lint flags the churning tuple(b.columns) static
+    fixture = tmp_path / "arrow_ballista_tpu" / "ops"
+    fixture.mkdir(parents=True)
+    (fixture / "packer.py").write_text(textwrap.dedent("""\
+        from ..obs.device import observed_jit
+
+        def pack_fn(cols, names):
+            return tuple(cols[n] for n in names)
+
+        pack = observed_jit("churn.pack", pack_fn,
+                            static_argnames=("names",))
+
+        def run(batches):
+            out = []
+            for b in batches:
+                names = tuple(b.columns)
+                out.append(pack(b.columns, names))
+            return out
+        """))
+    found = run_lints(str(tmp_path), rule_names=["trace-key-stability"])
+    assert len(found) == 1
+    assert "'churn.pack'" in found[0].message
+
+    # runtime half: the identical wrapper shape, driven with churning
+    # name tuples — the observatory books a retrace per new alias set
+    def pack_fn(cols, names):
+        return tuple(cols[n] for n in names)
+
+    pack = dev.observed_jit("churn.pack", pack_fn,
+                            static_argnames=("names",))
+    arr = jnp.arange(8)
+    before = dev.STATS.snapshot()
+    for names in (("a",), ("b",), ("c",)):
+        pack({names[0]: arr}, names)
+    d = _delta(before, dev.STATS.snapshot())
+    assert d["jit_compiles"] == 1
+    assert d["jit_retraces"] == 2  # one per churned alias set
+    assert d["jit_cache_hits"] == 0
+
+
 def test_observed_jit_decorator_form_and_disabled_mode():
     import jax.numpy as jnp
 

@@ -4,6 +4,7 @@ pre-launch plan validator (good graph passes; partition/schema mismatches,
 cycles, orphans and join hash disagreements are rejected — including
 end-to-end through the scheduler).
 """
+import json
 import os
 import textwrap
 from pathlib import Path
@@ -49,7 +50,10 @@ def test_all_rules_registered():
             "config-registry", "lock-discipline",
             "no-blocking-in-event-loop", "metrics-docs",
             "recovery-path-logging", "guarded-by", "lock-order",
-            "event-loop-handoff", "thread-lifecycle"} <= names
+            "event-loop-handoff", "thread-lifecycle",
+            "trace-key-stability", "donation-safety",
+            "host-device-boundary", "fusion-verdict-consistency",
+            "deprecated-jax-api"} <= names
 
 
 # --------------------------------------------------------------------------
@@ -609,13 +613,319 @@ def test_syntax_error_reported_as_violation(tmp_path):
     assert [v.rule for v in found] == ["syntax"]
 
 
+# --------------------------------------------------------------------------
+# jit-discipline rules: trace-key stability, donation safety, host/device
+# boundary, fusion-verdict consistency, deprecated jax APIs
+# --------------------------------------------------------------------------
+
+def test_trace_key_stability_flags_batch_varying_static(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/packer.py", """\
+        from ..obs.device import observed_jit
+
+        def pack_fn(cols, names):
+            return cols
+
+        pack = observed_jit("pack", pack_fn, static_argnames=("names",))
+
+        def run(batches):
+            for b in batches:
+                names = tuple(b.columns)
+                pack(b.columns, names)
+        """)
+    found = lint(tmp_path, "trace-key-stability")
+    assert len(found) == 1
+    v = found[0]
+    assert "'pack'" in v.message and "batch-varying" in v.message
+    # reported at the tainting assignment, not the call — the fix (or a
+    # suppression with its justification) lands where the value is built
+    assert v.line == 10
+
+
+def test_trace_key_stability_accepts_sanitized_static(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/packer.py", """\
+        from ..models.batch import round_capacity
+        from ..obs.device import observed_jit
+
+        def pack_fn(cols, cap):
+            return cols
+
+        pack = observed_jit("pack", pack_fn, static_argnums=(1,))
+
+        def run(batches):
+            for b in batches:
+                cap = round_capacity(b.num_rows)
+                pack(b.columns, cap)
+        """)
+    assert lint(tmp_path, "trace-key-stability") == []
+
+
+def test_trace_key_stability_flags_wrapper_built_in_loop(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/looped.py", """\
+        from ..obs.device import observed_jit
+
+        def run(batches):
+            out = []
+            for b in batches:
+                step = observed_jit("loop.step", lambda cols: cols)
+                out.append(step(b.columns))
+            return out
+        """)
+    found = lint(tmp_path, "trace-key-stability")
+    assert len(found) == 1
+    assert "constructed inside a loop" in found[0].message
+
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/looped.py", """\
+        from ..obs.device import observed_jit
+
+        def run(batches):
+            out = []
+            for b in batches:
+                # ballista: allow=trace-key-stability — fixture exception
+                step = observed_jit("loop.step", lambda cols: cols)
+                out.append(step(b.columns))
+            return out
+        """)
+    assert lint(tmp_path, "trace-key-stability") == []
+
+
+def test_donation_safety_flags_use_after_donation(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/donated.py", """\
+        from ..obs.device import observed_jit
+
+        def step_fn(cols, mask):
+            return cols, mask
+
+        step = observed_jit("stage.rows", step_fn, donate_argnums=(0, 1))
+
+        def run(b):
+            out_cols, out_mask = step(b.columns, b.mask)
+            return b.columns, out_cols
+        """)
+    found = lint(tmp_path, "donation-safety")
+    assert len(found) == 1
+    v = found[0]
+    assert "use-after-donation" in v.message and "'b.columns'" in v.message
+    assert v.line == 10  # the offending read, not the donating call
+
+
+def test_donation_safety_advises_provably_safe_undonated(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/donated.py", """\
+        from ..obs.device import observed_jit
+
+        def step_fn(cols, mask):
+            return cols, mask
+
+        step = observed_jit("stage.rows", step_fn, donate_argnums=(0,))
+
+        def run(batches):
+            out = []
+            for b in batches:
+                cols, mask = step(b.columns, b.mask)
+                out.append(cols)
+            return out
+        """)
+    found = lint(tmp_path, "donation-safety")
+    assert len(found) == 1
+    v = found[0]
+    assert "provably-safe-but-undonated" in v.message
+    assert "argument 1" in v.message and "'b.mask'" in v.message
+
+    # donating the mask too (the fix the advisory asks for) goes clean:
+    # the loop rebinds b per iteration, so nothing reads after the call
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/donated.py", """\
+        from ..obs.device import observed_jit
+
+        def step_fn(cols, mask):
+            return cols, mask
+
+        step = observed_jit("stage.rows", step_fn, donate_argnums=(0, 1))
+
+        def run(batches):
+            out = []
+            for b in batches:
+                cols, mask = step(b.columns, b.mask)
+                out.append(cols)
+            return out
+        """)
+    assert lint(tmp_path, "donation-safety") == []
+
+
+def test_donation_safety_advises_fresh_jit_produced_input(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/fresh.py", """\
+        from ..obs.device import observed_jit
+
+        def prep_fn(cols):
+            return cols
+
+        def probe_fn(cols):
+            return cols
+
+        prep = observed_jit("j.prep", prep_fn)
+        probe = observed_jit("j.probe", probe_fn)
+
+        def run(b):
+            built = prep(b.columns)
+            return probe(built)
+        """)
+    found = lint(tmp_path, "donation-safety")
+    assert len(found) == 1
+    v = found[0]
+    assert "'j.probe'" in v.message and "freshly produced" in v.message
+    assert "donate_argnums=(0,)" in v.message
+
+
+def test_host_device_boundary_flags_host_calls_in_traced_body(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/kern.py", """\
+        import numpy as np
+
+        from ..obs.device import observed_jit
+
+        def body(cols, mask):
+            host = np.asarray(mask)
+            return host
+
+        k = observed_jit("k.body", body)
+        """)
+    found = lint(tmp_path, "host-device-boundary")
+    assert len(found) == 1
+    assert "host numpy call" in found[0].message
+    assert "'k.body'" in found[0].message
+
+
+def test_host_device_boundary_requires_transfer_accounting(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/xfer.py", """\
+        import jax
+
+        from ..obs.device import record_transfer
+
+        def fetch_bad(x):
+            return jax.device_get(x)
+
+        def fetch_ok(x):
+            out = jax.device_get(x)
+            record_transfer("d2h", out.nbytes, 0.0)
+            return out
+        """)
+    found = lint(tmp_path, "host-device-boundary")
+    assert len(found) == 1
+    v = found[0]
+    assert v.line == 6 and "'fetch_bad'" in v.message
+    assert "record_transfer" in v.message
+
+
+def test_host_device_boundary_accepts_pure_body(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/kern.py", """\
+        import jax.numpy as jnp
+
+        from ..obs.device import observed_jit
+
+        def body(cols, mask):
+            return jnp.where(mask, cols, 0)
+
+        k = observed_jit("k.body", body)
+        """)
+    assert lint(tmp_path, "host-device-boundary") == []
+
+
+def _fusion_fixture(tmp_path, allowlist):
+    write_fixture(tmp_path, "arrow_ballista_tpu/ops/operators.py", """\
+        class FilterExec:
+            def __init__(self, host_mode=False):
+                self.host_mode = host_mode
+        """)
+    write_fixture(tmp_path, "arrow_ballista_tpu/compile/fused.py", """\
+        from ..ops.operators import FilterExec
+
+        def build(op):
+            if isinstance(op, FilterExec):
+                return op
+            raise ValueError(op)
+        """)
+    write_fixture(tmp_path, "arrow_ballista_tpu/compile/fuse.py", f"""\
+        from ..ops.operators import FilterExec
+
+        DEFAULT_OPERATORS = frozenset({allowlist!r})
+
+        def _op_verdict(node):
+            if isinstance(node, FilterExec) and not node.host_mode:
+                return None
+            return "unsupported"
+        """)
+
+
+def test_fusion_verdict_consistency_flags_stale_allowlist(tmp_path):
+    _fusion_fixture(tmp_path, {"FilterExec", "GhostExec"})
+    found = lint(tmp_path, "fusion-verdict-consistency")
+    assert len(found) == 1
+    assert "'GhostExec'" in found[0].message
+    assert "stale allowlist entry" in found[0].message
+
+
+def test_fusion_verdict_consistency_accepts_consistent_tables(tmp_path):
+    _fusion_fixture(tmp_path, {"FilterExec"})
+    assert lint(tmp_path, "fusion-verdict-consistency") == []
+
+
+def test_deprecated_jax_api_flags_stale_shard_map(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/parallel/dist.py", """\
+        import jax
+
+        def launch(fn, mesh, specs):
+            return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=specs,
+                                         out_specs=specs))
+        """)
+    found = lint(tmp_path, "deprecated-jax-api")
+    assert len(found) == 1
+    assert "jax.experimental.shard_map" in found[0].message
+
+
+def test_deprecated_jax_api_accepts_experimental_namespace(tmp_path):
+    write_fixture(tmp_path, "arrow_ballista_tpu/parallel/dist.py", """\
+        from jax.experimental.shard_map import shard_map
+
+        def launch(fn, mesh, specs):
+            return shard_map(fn, mesh, in_specs=specs, out_specs=specs)
+        """)
+    assert lint(tmp_path, "deprecated-jax-api") == []
+
+
 def test_cli_runner_clean_and_json():
     from arrow_ballista_tpu.analysis.__main__ import main
 
     assert main(["--root", REPO_ROOT]) == 0
     assert main(["--root", REPO_ROOT, "--json"]) == 0
+    assert main(["--root", REPO_ROOT, "--sarif"]) == 0
     assert main(["--list-rules"]) == 0
     assert main(["--root", REPO_ROOT, "--rules", "nope"]) == 2
+
+
+def test_cli_sarif_report_structure(tmp_path, capsys):
+    from arrow_ballista_tpu.analysis.__main__ import main
+
+    write_fixture(tmp_path, "arrow_ballista_tpu/parallel/dist.py", """\
+        import jax
+
+        def launch(fn, mesh, specs):
+            return jax.shard_map(fn, mesh=mesh, in_specs=specs,
+                                 out_specs=specs)
+        """)
+    capsys.readouterr()
+    assert main(["--root", str(tmp_path), "--rules", "deprecated-jax-api",
+                 "--sarif"]) == 1  # exit semantics unchanged by --sarif
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "ballista-analysis"
+    ids = [r["id"] for r in driver["rules"]]
+    assert "deprecated-jax-api" in ids
+    (result,) = run["results"]
+    assert result["ruleId"] == "deprecated-jax-api"
+    assert ids[result["ruleIndex"]] == "deprecated-jax-api"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == \
+        "arrow_ballista_tpu/parallel/dist.py"
+    assert loc["region"]["startLine"] == 4
 
 
 # --------------------------------------------------------------------------

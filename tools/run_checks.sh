@@ -57,6 +57,9 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 echo "== static analysis (lint suite) =="
 python -m arrow_ballista_tpu.analysis
+# SARIF artifact for CI inline annotation (same findings, machine form;
+# the gating text run above already decided the exit status)
+python -m arrow_ballista_tpu.analysis --sarif > analysis.sarif || true
 
 echo "== generated docs up to date =="
 python docs/gen_configs.py --check
