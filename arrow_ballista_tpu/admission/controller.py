@@ -148,11 +148,20 @@ class AdmissionController:
                  fail_cb: Callable[[str, str], None],
                  pending_tasks_fn: Callable[[], int],
                  total_slots_fn: Callable[[], int],
+                 memory_pressure_fn: Optional[Callable[[], float]] = None,
+                 memory_shed_threshold: float = 0.0,
                  metrics=None):
         self._admit_cb = admit_cb
         self._fail_cb = fail_cb
         self._pending_tasks_fn = pending_tasks_fn
         self._total_slots_fn = total_slots_fn
+        # fleet-wide memory-pressure floor (min over alive executors'
+        # heartbeated governor pressure); at/above the threshold new jobs
+        # queue (if the tenant has a wait queue) or shed retriably —
+        # there is no executor left that could take state without
+        # spilling or OOMing.  fn None or threshold <= 0 disables.
+        self._memory_pressure_fn = memory_pressure_fn
+        self._memory_shed_threshold = float(memory_shed_threshold)
         self._metrics = metrics
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -168,6 +177,7 @@ class AdmissionController:
         self.admitted_total = 0
         self.shed_total = 0
         self.timed_out_total = 0
+        self.memory_shed_total = 0
         self._sweeper: Optional[threading.Thread] = None
         # written under _lock in stop(); _ensure_sweeper's unlocked read is
         # inside the documented caller-holds-_lock helper section
@@ -178,8 +188,20 @@ class AdmissionController:
                request: Optional[AdmissionRequest] = None) -> None:
         req = request or AdmissionRequest()
         pol = req.policy
+        saturated = self._memory_saturated()
         with self._lock:
-            if pol.pass_through and not self._queue:
+            if saturated is not None and pol.pass_through:
+                # no tenant queue to wait in: shed retriably right away
+                # (queue-configured tenants fall through and park below —
+                # _admissible holds them while the fleet is saturated)
+                self.shed_total += 1
+                self.memory_shed_total += 1
+                actions = [("memshed", job_id,
+                            f"cluster memory saturated (fleet pressure "
+                            f"floor {saturated:.2f} >= shed threshold "
+                            f"{self._memory_shed_threshold:g}); "
+                            f"retry after {pol.retry_after_s}s")]
+            elif pol.pass_through and not self._queue:
                 self._mark_running(job_id, req)
                 actions = [("admit", job_id, plan_fn, 0.0)]
             elif self._tenant_queue_full(req):
@@ -284,6 +306,7 @@ class AdmissionController:
                 "admitted_total": self.admitted_total,
                 "shed_total": self.shed_total,
                 "timed_out_total": self.timed_out_total,
+                "memory_shed_total": self.memory_shed_total,
                 "tenants": tenants,
                 "queue": queue,
             }
@@ -325,8 +348,24 @@ class AdmissionController:
                 return True
         return False
 
+    def _memory_saturated(self) -> Optional[float]:
+        """The fleet pressure floor when it is at/above the shed
+        threshold, else None.  Called OUTSIDE self._lock where possible
+        (the pressure fn reads cluster state); _admissible's in-lock call
+        mirrors how _pending_tasks_fn is already consulted there."""
+        if self._memory_pressure_fn is None \
+                or self._memory_shed_threshold <= 0:
+            return None
+        try:
+            p = float(self._memory_pressure_fn())
+        except Exception:  # noqa: BLE001 — signals are advisory
+            return None
+        return p if p >= self._memory_shed_threshold else None
+
     def _admissible(self, req: AdmissionRequest) -> bool:
         pol = req.policy
+        if self._memory_saturated() is not None:
+            return False
         if (pol.max_concurrent_jobs > 0 and
                 self._tenant_running.get(req.tenant, 0)
                 >= pol.max_concurrent_jobs):
@@ -405,9 +444,11 @@ class AdmissionController:
                         self._metrics.record_admitted(job_id, waited)
                     self._admit_cb(job_id, plan_fn)
                 else:
-                    _, job_id, message = action
+                    kind, job_id, message = action
                     if self._metrics is not None:
                         self._metrics.record_shed(job_id)
+                        if kind == "memshed":
+                            self._metrics.record_memory_shed(job_id)
                     self._fail_cb(job_id, message)
             except Exception:  # noqa: BLE001 — one job must not wedge the rest
                 log.exception("admission callback failed for %s", action[1])

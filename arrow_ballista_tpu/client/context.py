@@ -565,8 +565,11 @@ class BallistaContext:
         device_obs.set_enabled(bool(self.config.get(OBS_DEVICE_ENABLED)))
         device_obs.set_watermarks(
             bool(self.config.get(OBS_DEVICE_WATERMARKS)))
+        from ..memory import MemoryGovernor
+
         ctx = TaskContext(config=self.config, work_dir=self.work_dir,
-                          job_id=uuid.uuid4().hex[:7])
+                          job_id=uuid.uuid4().hex[:7],
+                          governor=MemoryGovernor.from_config(self.config))
         for sid, splan in planned.scalars:
             ctx.scalars[sid] = extract_scalar(splan, ctx)
         out: List[ColumnBatch] = []

@@ -200,8 +200,10 @@ def fuse_stage(graph, stage) -> int:
             ops = [node for _p, node in run]
             head_path = run[0][0]
             parent = by_path[head_path.rsplit(".", 1)[0]]
+            # agg-headed chains donate too since the plan-ahead capacity
+            # protocol (PR 19) made the aggregate a single-call program
+            # whose inputs are dead after the call
             donate = (policy.donate
-                      and not isinstance(ops[0], HashAggregateExec)
                       and type(ops[-1].input).__name__
                       == "ShuffleReaderExec")
             fused = FusedStageExec(ops, donate=donate)

@@ -41,8 +41,8 @@ from ..ops.operators import (FilterExec, HashAggregateExec, ProjectionExec,
                              RenameExec, _substitute_scalars, null_check_of)
 from ..ops.physical import (ExecutionPlan, TaskContext, deferred_rows,
                             schema_sig, shared_program)
-from ..utils.config import AGG_CAPACITY
-from ..utils.errors import CancelledError, CapacityError, InternalError
+from ..utils.errors import (CancelledError, CapacityError, IntegrityError,
+                            InternalError, MemoryExhausted)
 from .chains import chain_fingerprint
 
 _warned_fallback = set()
@@ -66,9 +66,11 @@ class FusedStageExec(ExecutionPlan):
 
     ``ops``: chain operators head-first with intact ``.input`` links
     (``ops[i].input is ops[i+1]``).  ``donate``: donate the input column
-    buffers to the fused program (row-only chains on non-CPU backends —
-    the aggregate capacity-retry ladder re-calls the program on the same
-    buffers, so agg-headed chains never donate).
+    buffers to the fused program (non-CPU backends).  Agg-headed chains
+    donate too since the plan-ahead capacity protocol (PR 19): the
+    aggregate runs as ONE call whose out_cap provably bounds the group
+    count, so the inputs are dead after the call — the donation-safety
+    analyzer (analysis/jit_discipline.py) checks the proof.
     """
 
     def __init__(self, ops: List[ExecutionPlan], donate: bool = False):
@@ -164,17 +166,17 @@ class FusedStageExec(ExecutionPlan):
         thread = [(c, d) for _t, c, d in steps]
 
         donate_kw = {}
-        if self.donate and agg is None:
+        if self.donate:
             import jax
 
             if jax.default_backend() != "cpu":
-                # donation is a no-op warning on CPU; the agg path re-calls
-                # the program on the same buffers during the capacity-retry
-                # ladder, so only row-only chains donate.  The mask (arg 1)
+                # donation is a no-op warning on CPU.  The mask (arg 1)
                 # rides the same donation-safety proof as the columns: both
                 # come off a fresh ShuffleReaderExec batch rebound per loop
                 # iteration and are dead after the call, so XLA can alias
-                # the output mask into the input mask buffer too.
+                # the output mask into the input mask buffer too.  Agg
+                # heads qualify since plan-ahead capacity (ONE call per
+                # input — no retry ladder re-reading donated buffers).
                 donate_kw["donate_argnums"] = (0, 1)
 
         if agg is None:
@@ -200,7 +202,7 @@ class FusedStageExec(ExecutionPlan):
             return raw_agg(cols, mask, auxs[-1], out_cap, key_ranges)
 
         jfn = observed_jit(self.fused_sig(), fused_agg,
-                           static_argnums=(3, 4))
+                           static_argnums=(3, 4), **donate_kw)
         return (thread, jfn, (comp_a, group_c, agg_c, tracked))
 
     def _ensure_compiled(self, ctx: TaskContext):
@@ -238,7 +240,11 @@ class FusedStageExec(ExecutionPlan):
             if self._head_agg() is not None:
                 return self._execute_agg(partition, ctx)
             return self._execute_rows(partition, ctx)
-        except (CancelledError, CapacityError):
+        except (CancelledError, CapacityError, IntegrityError,
+                MemoryExhausted):
+            # memory denials and spill-integrity failures are the
+            # governor's retry/spill protocol speaking, not a fused-path
+            # defect — never latch the fallback for them
             raise
         except Exception as exc:  # noqa: BLE001 — pure perf rewrite:
             # never let fusion be the reason a query fails; latch the
@@ -263,13 +269,36 @@ class FusedStageExec(ExecutionPlan):
 
     def _execute_agg(self, partition: int, ctx: TaskContext):
         """Mirror of HashAggregateExec._execute_device with the row
-        pipeline fused in front of the aggregate kernel (same capacity
-        ladder, dense-domain bound, hidden-valid-count NULL restore and
-        adaptive passthrough probe)."""
+        pipeline fused in front of the aggregate kernel (same plan-ahead
+        capacity, dense-domain bound, hidden-valid-count NULL restore
+        and adaptive passthrough probe)."""
         agg = self._head_agg()
-        cfg_cap = ctx.config.get(AGG_CAPACITY)
         batches = self.input.execute(partition, ctx)
         ctx.check_cancelled()
+
+        # memory governor: same reserve-before-materialize protocol as
+        # the interpreted aggregate.  A denial delegates this partition
+        # to the interpreted chain head — whose own governor check denies
+        # again and takes the per-batch spill path — WITHOUT latching
+        # _fallback: the next partition may well be granted and fuse.
+        gov = getattr(ctx, "governor", None)
+        reservation = None
+        if gov is not None:
+            from ..ops.operators import _state_bytes
+
+            est = _state_bytes(batches, self.input.schema, agg.schema)
+            reservation = gov.try_reserve(est, site="fused-agg")
+            if reservation is None:
+                self.metrics().add("fused_spill_delegations", 1)
+                return self.ops[0].execute(partition, ctx)
+        try:
+            return self._execute_agg_inmem(ctx, batches)
+        finally:
+            if reservation is not None:
+                reservation.release()
+
+    def _execute_agg_inmem(self, ctx: TaskContext, batches):
+        agg = self._head_agg()
         big = concat_batches(self.input.schema, batches).shrink()
         thread, jfn, (comp_a, group_c, agg_c, tracked) = self._compiled
 
@@ -287,26 +316,27 @@ class FusedStageExec(ExecutionPlan):
                 else:
                     key_ranges.append(None)
             key_ranges = tuple(key_ranges)
-            out_cap = min(cfg_cap, big.capacity)
-            out_cap = min(max(out_cap, getattr(self, "_cap_hint", 0)),
-                          big.capacity)
+            # plan-ahead capacity (see HashAggregateExec._execute_device):
+            # the input capacity (or the dense key domain) provably bounds
+            # the group count, so the overflow flag is statically None and
+            # the program runs EXACTLY ONCE per input — which is what
+            # makes the donated input buffers dead after the call
+            out_cap = big.capacity
             domain = K.dense_domain(key_ranges)
             if domain is not None:
                 out_cap = min(out_cap, domain)
-            while True:
-                out_keys, out_vals, out_mask, overflow = jfn(
-                    big.columns, big.mask, all_auxs, out_cap, key_ranges)
-                if overflow is None or not bool(overflow):
-                    break
-                if out_cap >= big.capacity:
-                    raise CapacityError(
-                        f"fused aggregation overflowed {out_cap} groups "
-                        f"with {big.capacity}-row input; this should be "
-                        "impossible")
-                out_cap = min(out_cap * 4, big.capacity)
-                self.metrics().add("capacity_recompiles", 1)
-        if out_cap > getattr(self, "_cap_hint", 0):
-            self._cap_hint = out_cap
+            # read host-side facts BEFORE the call: the donated column and
+            # mask buffers are dead after it, so nothing below may touch
+            # the input batch (donation-safety analyzer enforces this)
+            inp_rows, inp_cap = big._num_rows, big.capacity
+            out_keys, out_vals, out_mask, overflow = jfn(
+                big.columns, big.mask, all_auxs, out_cap, key_ranges)
+            del big
+            if overflow is not None and bool(overflow):
+                raise CapacityError(
+                    f"fused aggregation overflowed {out_cap} groups "
+                    f"with {big.capacity}-row input; this should be "
+                    "impossible")
 
         cols: Dict[str, jnp.ndarray] = {}
         dicts: Dict[str, np.ndarray] = {}
@@ -327,8 +357,7 @@ class FusedStageExec(ExecutionPlan):
         # aggregate): poor reduction on a large input latches BOTH the
         # aggregate's passthrough flag and this stage's interpreted
         # fallback, so sibling tasks emit per-row states
-        res_ref, inp_ref = weakref.ref(result), weakref.ref(big)
-        inp_cap = big.capacity
+        res_ref = weakref.ref(result)
         self_ref, agg_ref = weakref.ref(self), weakref.ref(agg)
 
         def _finish():
@@ -338,8 +367,7 @@ class FusedStageExec(ExecutionPlan):
             rn = res._num_rows
             if rn is None:
                 return None
-            inp = inp_ref()
-            bn = inp._num_rows if inp is not None else None
+            bn = inp_rows
             poor = (bn is not None and bn >= (1 << 17) and rn > 0.6 * bn) \
                 or (bn is None and inp_cap >= (1 << 17)
                     and rn > 0.6 * inp_cap)

@@ -18,14 +18,17 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
 from .. import faults
+from ..memory import MemoryGovernor
 from ..ops.physical import TaskContext
 from ..utils.config import BallistaConfig
 from ..utils.errors import (CancelledError, ExecutorKilled, FetchFailedError,
-                            IOError_)
+                            IntegrityError, IOError_, MemoryExhausted,
+                            ResourceExhausted)
 from ..scheduler.types import (
     EXECUTION_ERROR,
     FETCH_PARTITION_ERROR,
     IO_ERROR,
+    RESOURCE_EXHAUSTED,
     TASK_KILLED,
     ExecutorMetadata,
     FailedReason,
@@ -89,6 +92,10 @@ class Executor:
         from .metrics import ExecutorMetrics
 
         self.metrics = ExecutorMetrics()
+        # memory governor: operators holding unbounded state (join builds,
+        # agg state) reserve through this before materializing and spill
+        # on denial; its pressure() rides heartbeats into the scheduler
+        self.governor = MemoryGovernor.from_config(self.config)
         from ..utils.config import (OBS_DEVICE_ENABLED, OBS_DEVICE_WATERMARKS,
                                     OBS_TRACING)
 
@@ -202,7 +209,8 @@ class Executor:
                               executor_id=self.metadata.executor_id,
                               executor_host=self.metadata.host,
                               cancelled=lambda: self._is_cancelled(tid),
-                              span_recorder=recorder)
+                              span_recorder=recorder,
+                              governor=self.governor)
             start_ms = int(time.time() * 1000)
             # deterministic straggler: a 'delay' rule here stalls the task
             # mid-run, which is what the speculation monitor watches for
@@ -245,7 +253,17 @@ class Executor:
                                   map_stage_id=e.map_stage_id,
                                   map_partition_id=e.map_partition_id,
                                   executor_id=e.executor_id))
-        except (OSError, IOError_) as e:
+        except (MemoryExhausted, ResourceExhausted) as e:
+            # governor-caught denial that could not degrade to spill:
+            # retryable back-pressure, exempt from quarantine strikes —
+            # never an executor fault
+            return TaskStatus(tid, self.metadata.executor_id, "failed",
+                              failure=FailedReason(RESOURCE_EXHAUSTED,
+                                                   str(e)))
+        except (OSError, IOError_, IntegrityError) as e:
+            # IntegrityError covers spill-run read-back CRC mismatches:
+            # the retry recomputes from the (immutable) shuffle inputs —
+            # lineage recovery, not data corruption
             return TaskStatus(tid, self.metadata.executor_id, "failed",
                               failure=FailedReason(IO_ERROR, str(e)))
         except Exception as e:  # noqa: BLE001 — anything else is fatal

@@ -133,6 +133,36 @@ class ExecutorMetrics:
             lines.append("# TYPE host_rss_bytes_peak gauge")
             lines.append(
                 f"host_rss_bytes_peak {int(dsnap['host_rss_peak_bytes'])}")
+            # memory-governor process totals (memory/governor.py STATS):
+            # reservation accounting + spill volume, process-global
+            from ..memory import STATS as mem_stats
+
+            msnap = mem_stats.snapshot()
+            name = "memory_reserved_bytes"
+            lines.append(f"# HELP {name} bytes currently reserved from the "
+                         "memory governor by running operators, per pool")
+            lines.append(f"# TYPE {name} gauge")
+            for pool in ("host", "device"):
+                v = int(msnap.get(f"reserved_bytes.{pool}", 0))
+                lines.append(f'{name}{{pool="{pool}"}} {v}')
+            counter("memory_spill_bytes_total",
+                    int(msnap.get("spill_bytes_total", 0)),
+                    "bytes written to disk as Arrow IPC spill runs by "
+                    "operators the governor denied an in-memory grant")
+            counter("memory_spill_runs_total",
+                    int(msnap.get("spill_runs_total", 0)),
+                    "spill run files written (agg partial runs + join "
+                    "build partitions)")
+            counter("memory_reserve_denied_total",
+                    int(msnap.get("reserve_denied_total", 0)),
+                    "governor reservation denials (each degraded an "
+                    "operator to its spill path, or failed the task "
+                    "retriably with spill disabled)")
+            counter("memory_over_budget_grants_total",
+                    int(msnap.get("over_budget_grants_total", 0)),
+                    "forced over-budget grants to operators with a hard "
+                    "single-pass requirement (left/full outer join build "
+                    "sides)")
             lines.append("# HELP executor_active_tasks tasks currently "
                          "executing")
             lines.append("# TYPE executor_active_tasks gauge")

@@ -110,7 +110,8 @@ class SchedulerClient:
                    {"meta": serde.executor_metadata_to_obj(meta)})
 
     def heartbeat(self, executor_id: str, status: str = "active",
-                  meta: Optional[ExecutorMetadata] = None) -> None:
+                  meta: Optional[ExecutorMetadata] = None,
+                  pressure: float = 0.0) -> None:
         if faults.dropped("executor.heartbeat.send", executor_id=executor_id,
                           status=status):
             raise ConnectionError(
@@ -118,6 +119,10 @@ class SchedulerClient:
         payload = {"executor_id": executor_id, "status": status}
         if meta is not None:
             payload["meta"] = serde.executor_metadata_to_obj(meta)
+        # memory-governor pressure: 0.0 (unbudgeted) omits the key so the
+        # wire format is unchanged for unconstrained fleets
+        if pressure:
+            payload["memory_pressure"] = pressure
         self._call("heartbeat", payload)
 
     def update_task_status(self, executor_id: str,
@@ -534,11 +539,16 @@ class ExecutorServer:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval_s):
+            # memory-governor pressure rides every beat: the scheduler
+            # degrades this executor's offer ordering with it, and the
+            # fleet-wide floor feeds admission shed
+            pressure = self.executor.governor.pressure()
             try:
                 # metadata rides along so a restarted scheduler re-registers
                 # us (reference heart_beat_from_executor, grpc.rs:174-241)
                 self.scheduler.heartbeat(self.metadata.executor_id,
-                                         meta=self.metadata)
+                                         meta=self.metadata,
+                                         pressure=pressure)
                 self._mark_scheduler_up()
             except Exception:  # noqa: BLE001 — retried next interval
                 self._mark_scheduler_down("heartbeat")
@@ -551,7 +561,8 @@ class ExecutorServer:
             for ep, client in self._extra_clients():
                 try:
                     client.heartbeat(self.metadata.executor_id,
-                                     meta=self.metadata)
+                                     meta=self.metadata,
+                                     pressure=pressure)
                 except Exception:  # noqa: BLE001 — that shard may be dead
                     self._log_throttle.warning(
                         f"heartbeat-{ep[0]}:{ep[1]}",

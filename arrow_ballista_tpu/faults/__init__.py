@@ -67,13 +67,22 @@ KNOWN_SITES = frozenset({
                                       # between lease takeover and graph
                                       # resume (delay => widen the race
                                       # window against completion)
+    "executor.memory.reserve",      # memory/governor.py, per reservation
+                                    # request (raise error=resource =>
+                                    # denied grant -> operator spills;
+                                    # delay => slow grant)
+    "executor.spill.write",         # memory/spill.py, per spill-run write
+                                    # (raise => spill I/O failure;
+                                    # corrupt => flip bytes on disk so the
+                                    # read-back CRC must catch it)
 })
 
 ACTIONS = frozenset({"raise", "delay", "drop", "corrupt", "kill"})
 
 
 def _make_error(kind: str, message: str) -> Exception:
-    from ..utils.errors import ExecutionError, ExecutorKilled, IOError_
+    from ..utils.errors import (ExecutionError, ExecutorKilled, IOError_,
+                                MemoryExhausted)
 
     factories: Dict[str, Callable[[str], Exception]] = {
         "io": IOError_,
@@ -82,6 +91,7 @@ def _make_error(kind: str, message: str) -> Exception:
         "timeout": TimeoutError,
         "execution": ExecutionError,
         "killed": ExecutorKilled,
+        "resource": lambda m: MemoryExhausted("injected", 0, 0, m),
     }
     try:
         return factories[kind](message)

@@ -1,0 +1,18 @@
+"""Memory-pressure robustness plane (PR 19).
+
+- :mod:`.governor` — per-executor reserve/grant/release accounting over
+  host-RSS and device-HBM pools; denials are retryable back-pressure.
+- :mod:`.spill` — Arrow IPC spill runs with CRC-verified read-back,
+  written when a reservation is denied and merged on read.
+"""
+from .governor import POOLS, STATS, MemoryGovernor, Reservation
+from .spill import Spiller, SpillRun
+
+__all__ = [
+    "MemoryGovernor",
+    "Reservation",
+    "Spiller",
+    "SpillRun",
+    "STATS",
+    "POOLS",
+]

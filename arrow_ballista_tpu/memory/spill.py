@@ -1,0 +1,117 @@
+"""Spill-to-disk runs: Arrow IPC files written under the task work dir.
+
+When the governor denies a reservation, an operator writes its partial
+state as IPC *runs* (models/ipc.py — the same writer/reader the shuffle
+data plane uses, so dictionary pruning, int64 narrowing, and the
+unified-sorted-dictionary read path are all shared) and merges them on
+read.  Every run records the CRC-32 of the bytes it put on disk; the
+read path re-hashes before decode, so silent disk corruption surfaces
+as a *retryable* :class:`~..utils.errors.IntegrityError` — the task
+retry re-reads its shuffle inputs and recomputes, which is lineage
+recovery, not data corruption.
+
+The write is a failpoint (``executor.spill.write``): ``raise`` turns a
+spill into an I/O failure, ``corrupt`` flips bytes on disk *after* the
+CRC is recorded so the read-back check must catch it.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import faults
+from ..models.ipc import crc32_file, read_ipc_files, write_ipc_rows
+from ..models.schema import Schema
+from ..utils.errors import IntegrityError
+from .governor import STATS
+
+
+class SpillRun:
+    """One spilled IPC file + the checksum its reader must see."""
+
+    __slots__ = ("path", "crc", "num_rows", "num_bytes")
+
+    def __init__(self, path: str, crc: int, num_rows: int, num_bytes: int):
+        self.path = path
+        self.crc = crc
+        self.num_rows = num_rows
+        self.num_bytes = num_bytes
+
+    def __repr__(self):
+        return (f"SpillRun({os.path.basename(self.path)}, "
+                f"rows={self.num_rows}, bytes={self.num_bytes})")
+
+
+class Spiller:
+    """Writes/reads spill runs for one operator execution.
+
+    Files live under ``<work_dir>/<job_id>/spill/<unique>/`` so
+    concurrent tasks of the same job never collide and ``cleanup()``
+    can remove the whole directory."""
+
+    def __init__(self, work_dir: str, job_id: str = "", tag: str = "op"):
+        self.dir = os.path.join(work_dir, job_id or "_adhoc", "spill",
+                                f"{tag}-{uuid.uuid4().hex[:12]}")
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.runs: List[SpillRun] = []
+
+    # --- write ----------------------------------------------------------
+    def write_run(self, schema: Schema, data: Dict[str, np.ndarray],
+                  dicts: Dict[str, np.ndarray]) -> SpillRun:
+        """Spill already-compacted host rows as one IPC run."""
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        os.makedirs(self.dir, exist_ok=True)
+        path = os.path.join(self.dir, f"run-{seq}.arrow")
+        rule = faults.inject("executor.spill.write", path=path)
+        num_rows, num_bytes = write_ipc_rows(schema, data, dicts, path)
+        crc = crc32_file(path)
+        if rule is not None and rule.action == "corrupt":
+            # after the CRC: the reader's integrity check must catch it
+            with open(path, "rb") as fh:
+                raw = fh.read()
+            with open(path, "wb") as fh:
+                fh.write(faults.corrupt_bytes(raw))
+        run = SpillRun(path, crc, num_rows, num_bytes)
+        with self._lock:
+            self.runs.append(run)
+        STATS.add("spill_runs_total")
+        STATS.add("spill_bytes_total", num_bytes)
+        return run
+
+    def write_batch(self, batch) -> SpillRun:
+        """Spill a device batch's live rows (one packed device->host
+        transfer via ``compacted_numpy``)."""
+        return self.write_run(batch.schema, batch.compacted_numpy(),
+                              batch.dicts)
+
+    # --- read -----------------------------------------------------------
+    def read(self, schema: Schema, runs: Optional[Sequence[SpillRun]] = None,
+             capacity: Optional[int] = None) -> List:
+        """Read runs back into device batches (unified sorted
+        dictionaries across runs, exactly the shuffle read path).
+        Verifies every run's CRC first; a mismatch is retryable — the
+        retry recomputes from shuffle inputs (lineage), so corruption
+        on disk never becomes corruption in results."""
+        runs = self.runs if runs is None else list(runs)
+        for run in runs:
+            actual = crc32_file(run.path)
+            if actual != run.crc:
+                raise IntegrityError(
+                    "executor.spill.read",
+                    "spill run failed CRC verification on read-back",
+                    path=run.path, expected=run.crc, actual=actual)
+        return read_ipc_files([r.path for r in runs], schema,
+                              capacity=capacity)
+
+    def cleanup(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+        with self._lock:
+            self.runs = []

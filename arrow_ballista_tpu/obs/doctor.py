@@ -50,6 +50,10 @@ CACHE_HIT_RATE_MAX = 0.5
 #: lease adoption / quarantine recorded in the job's journal
 LAG_MEAN_MIN_S = 0.05
 LAG_MAX_MIN_S = 0.25
+#: memory pressure: any spill run means the governor denied an in-memory
+#: grant and an operator degraded to disk — correct but slower, so the
+#: doctor points at the budget knob.  A clean unbudgeted run never spills.
+MEMORY_SPILL_MIN_RUNS = 1
 #: fusion missed: the whole-stage compiler REJECTED a chain whose
 #: downstream operators still paid at least this much measured
 #: transfer/compile dispatch (the advisor's savings estimate, ms) — a
@@ -269,6 +273,30 @@ def _stage_findings(bundle: Dict) -> List[Dict]:
                           "ballista.compile.min.ops; compare fused=true "
                           "chains in /api/job/<id>/advise",
             })
+        # -- memory pressure (spill-to-disk) -------------------------------
+        spill_runs = int(st.get("spill_runs", 0) or 0)
+        spill_bytes = int(st.get("spill_bytes", 0) or 0)
+        if spill_runs >= MEMORY_SPILL_MIN_RUNS:
+            out.append({
+                "rule": "memory-pressure",
+                "severity": round(spill_bytes / float(1 << 20), 3),
+                "stage_id": sid,
+                "summary": f"stage {sid}: operators spilled "
+                           f"{spill_bytes:,} bytes to disk over "
+                           f"{spill_runs} run(s) — the memory governor "
+                           "denied in-memory grants",
+                "evidence": {"spill_bytes": spill_bytes,
+                             "spill_runs": spill_runs,
+                             "spilled_operators":
+                                 sorted(name for name, mm in
+                                        (st.get("operators") or {}).items()
+                                        if int((mm or {})
+                                               .get("spill_runs", 0) or 0))},
+                "remedy": "raise ballista.memory.host.budget.bytes (or "
+                          ".device.) if the host has headroom; otherwise "
+                          "the spill is the correct degradation — reduce "
+                          "build-side/group cardinality or add executors",
+            })
         # -- shuffle hotspot -----------------------------------------------
         pbytes = [int(v) for v in (st.get("partition_bytes") or {}).values()]
         total_bytes = sum(pbytes)
@@ -340,6 +368,21 @@ def _global_findings(bundle: Dict) -> List[Dict]:
                       "ballista.result.cache.max.bytes, or parameterize "
                       "statements so templates actually repeat",
         })
+    # -- cluster-wide memory shed -------------------------------------------
+    sheds = int(m.get("memory_pressure_sheds_total", 0) or 0)
+    if sheds:
+        out.append({
+            "rule": "memory-pressure",
+            "severity": round(float(sheds), 3),
+            "summary": f"admission shed/deferred {sheds} job(s) because "
+                       "every alive executor's memory pressure crossed "
+                       "the shed threshold",
+            "evidence": {"memory_pressure_sheds_total": sheds},
+            "remedy": "add executors or raise per-executor "
+                      "ballista.memory.*.budget.bytes; clients saw a "
+                      "retriable ResourceExhausted and should back off "
+                      "and resubmit",
+        })
     # -- control-plane churn -----------------------------------------------
     samples = (bundle.get("cluster_history") or {}).get("samples") or []
     lags = [float(s.get("event_loop_lag_s", 0.0) or 0.0) for s in samples]
@@ -381,8 +424,9 @@ def diagnose(bundle: Dict) -> Dict:
         "state": (bundle.get("status") or {}).get("state", ""),
         "findings": findings,
         "rules_evaluated": ["partition-skew", "straggler", "retrace-storm",
-                            "fusion-missed", "shuffle-hotspot",
-                            "cache-miss-churn", "control-plane-churn"],
+                            "fusion-missed", "memory-pressure",
+                            "shuffle-hotspot", "cache-miss-churn",
+                            "control-plane-churn"],
     }
     out["text"] = render_diagnosis(out)
     return out

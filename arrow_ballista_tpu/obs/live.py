@@ -48,7 +48,7 @@ from .stats import nearest_rank_quantile, stage_summary
 #: rules the live scanner evaluates (the budget cap: the full catalog's
 #: retrace/fusion/cache rules stay post-hoc)
 LIVE_RULES = ("straggler", "partition-skew", "shuffle-hotspot",
-              "control-plane-churn", "journal-drops")
+              "memory-pressure", "control-plane-churn", "journal-drops")
 #: consecutive tripping scans before an alert raises
 RAISE_AFTER = 1
 #: consecutive clean scans before a standing alert clears

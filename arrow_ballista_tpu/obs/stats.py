@@ -144,6 +144,11 @@ def stage_summary(stage) -> Dict:
                 part_bytes.get(w.output_partition, 0) + int(w.num_bytes)
     rows_list = [part_rows[p] for p in sorted(part_rows)]
     launches = list(getattr(stage, "attempt_log", ()))
+    operators = stage.operator_metrics()
+    spill_bytes = sum(int(m.get("spill_bytes", 0))
+                      for m in operators.values())
+    spill_runs = sum(int(m.get("spill_runs", 0))
+                     for m in operators.values())
     return {
         "stage_id": stage.stage_id,
         "state": stage.state,
@@ -162,7 +167,12 @@ def stage_summary(stage) -> Dict:
         "skew": round(skew_coefficient(rows_list), 4),
         "row_histogram": row_histogram(rows_list),
         "task_duration_s": duration_quantiles(list(stage.durations)),
-        "operators": stage.operator_metrics(),
+        "operators": operators,
+        # memory-governor spill totals across this stage's operators
+        # (memory/spill.py): nonzero means reservations were denied and
+        # joins/aggs degraded to disk
+        "spill_bytes": spill_bytes,
+        "spill_runs": spill_runs,
         # device-observatory fold (obs/device.py): jit compile/retrace
         # counts, transfer bytes/seconds, memory watermark peaks
         "device": device_summary(stage),
@@ -231,7 +241,10 @@ def _walk_plan(node, path="0", depth=0, out=None):
 
 def _op_entry(path: str, depth: int, node, mm: Dict[str, float]) -> Dict:
     time_ms = sum(v for k, v in mm.items() if k.endswith("_time")) * 1000.0
-    nbytes = sum(v for k, v in mm.items() if k.endswith("_bytes"))
+    # spill bytes are disk traffic, reported separately — not part of the
+    # operator's data-flow byte total
+    nbytes = sum(v for k, v in mm.items()
+                 if k.endswith("_bytes") and k != "spill_bytes")
     # device-observatory split (obs/device.py): host_ms is the accounted
     # non-compute wall time inside this operator — transfer dispatch +
     # jit compiles — and device_ms the remainder of its timed work.
@@ -253,6 +266,10 @@ def _op_entry(path: str, depth: int, node, mm: Dict[str, float]) -> Dict:
         "transfer_bytes": int(mm.get("h2d_bytes", 0) + mm.get("d2h_bytes", 0)),
         "compiles": int(mm.get("jit_compiles", 0)),
         "retraces": int(mm.get("jit_retraces", 0)),
+        # memory-governor spill (memory/spill.py): disk bytes + run files
+        # this operator wrote after a reservation denial
+        "spill_bytes": int(mm.get("spill_bytes", 0)),
+        "spill_runs": int(mm.get("spill_runs", 0)),
         "metrics": {k: round(v, 6) for k, v in sorted(mm.items())},
     }
 
@@ -283,6 +300,9 @@ def _op_suffix(op: Dict) -> str:
         parts.append(f"{op['time_ms']:.1f} ms")
     if op["bytes"]:
         parts.append(_fmt_bytes(op["bytes"]))
+    if op.get("spill_bytes"):
+        parts.append(f"spilled {_fmt_bytes(op['spill_bytes'])} "
+                     f"({op.get('spill_runs', 0)} runs)")
     return f"  [{' · '.join(parts)}]" if parts else ""
 
 
@@ -297,6 +317,9 @@ def _stage_header(s: Dict) -> str:
     ]
     if s.get("speculative_launches"):
         bits.append(f"{s['speculative_launches']} speculative")
+    if s.get("spill_bytes"):
+        bits.append(f"spilled {_fmt_bytes(s['spill_bytes'])} "
+                    f"({s.get('spill_runs', 0)} runs)")
     for r in s.get("aqe") or ():
         kinds = "+".join(r.get("kinds", ())) or "rewrite"
         if "partitions_before" in r:

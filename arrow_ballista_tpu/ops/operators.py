@@ -355,6 +355,28 @@ class FilterExec(ExecutionPlan):
 # --------------------------------------------------------------------------
 
 
+class _SchemaSource:
+    """Schema-only plan stub for ephemeral operators (the spill-merge
+    aggregation) whose input is never executed."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+
+    def output_partition_count(self):
+        return 1
+
+
+def _state_bytes(batches: Sequence[ColumnBatch], *schemas: Schema) -> int:
+    """Reservation estimate for materializing ``batches`` plus the
+    derived state the given schemas describe: total capacity x physical
+    row width (sub-4-byte columns still occupy padded device lanes, so
+    4 bytes is the per-column floor; +1 for the mask)."""
+    cap = sum(b.capacity for b in batches)
+    width = sum(1 + sum(max(f.dtype.np_dtype.itemsize, 4) for f in s)
+                for s in schemas)
+    return cap * width
+
+
 @dataclasses.dataclass
 class AggSpec:
     func: str  # sum | count | min | max
@@ -433,6 +455,31 @@ class HashAggregateExec(ExecutionPlan):
         cfg_cap = ctx.config.get(AGG_CAPACITY)
         batches = self.input.execute(partition, ctx)
         in_schema = self.input.schema
+
+        # memory governor (memory/governor.py): reserve the concatenated
+        # input + group-state footprint before materializing it.  A denial
+        # degrades to the spill path — per-batch partial runs on disk,
+        # merged by a final-mode pass on read — instead of an OOM.  The
+        # clustered/presorted paths are exempt (their early-filter
+        # correctness depends on seeing the whole partition at once, and
+        # their state is bounded by the overlap windows).
+        gov = getattr(ctx, "governor", None)
+        reservation = None
+        if gov is not None and getattr(self, "clustered", None) is None \
+                and not getattr(self, "_passthrough", False):
+            est = _state_bytes(batches, in_schema, self._schema)
+            reservation = gov.try_reserve(est, site=f"agg:{self.mode}")
+            if reservation is None:
+                return self._execute_spilled(ctx, cfg_cap, batches,
+                                             in_schema)
+        try:
+            return self._execute_inmem(partition, ctx, cfg_cap, batches,
+                                       in_schema)
+        finally:
+            if reservation is not None:
+                reservation.release()
+
+    def _execute_inmem(self, partition, ctx, cfg_cap, batches, in_schema):
         big = concat_batches(in_schema, batches).shrink()
 
         if self.mode == "partial" and self.group_exprs \
@@ -499,6 +546,61 @@ class HashAggregateExec(ExecutionPlan):
                             for b in out]
             out = filtered
         return out
+
+    def _execute_spilled(self, ctx, cfg_cap, batches, in_schema):
+        """Reservation denied: bound the state to one input batch at a
+        time.  Each batch is aggregated independently (its state is
+        capped by the batch capacity — the engine's functional floor),
+        the per-batch result spills to disk as an Arrow IPC run, and the
+        runs are merged on read by ONE final-mode pass (the MERGE ops
+        are exactly the partial-state merge semantics, NULL sentinels
+        included) — the sort-merge finalize.
+
+        Bit-identical to the in-memory path: group emission order is
+        ascending key order in both grouping kernels (ops/kernels.py),
+        dictionaries are sorted everywhere (spill read included), and
+        the decimal columns TPC-H aggregates are int64-stored, so the
+        partial merges are exact and associative."""
+        from ..memory.spill import Spiller
+
+        with self.xla_lock():
+            self._ensure_compiled(ctx, in_schema)
+        spiller = Spiller(ctx.work_dir, ctx.job_id, tag="agg")
+        try:
+            for b in batches:
+                ctx.check_cancelled()
+                out, _ = self._execute_device(ctx, cfg_cap, b)
+                for r in out:
+                    spiller.write_batch(r)
+            self.metrics().add("spill_runs", len(spiller.runs))
+            self.metrics().add("spill_bytes",
+                               sum(r.num_bytes for r in spiller.runs))
+            merged = concat_batches(self._schema,
+                                    spiller.read(self._schema)).shrink()
+            mop = self._merge_op()
+            with mop.xla_lock():
+                mop._ensure_compiled(ctx, self._schema)
+            out, _ = mop._execute_device(ctx, cfg_cap, merged)
+            if out[0]._num_rows is not None:
+                self.metrics().add("output_rows", out[0]._num_rows)
+            else:
+                deferred_rows(self.metrics(), "output_rows", out[0])
+            return out
+        finally:
+            spiller.cleanup()
+
+    def _merge_op(self) -> "HashAggregateExec":
+        """Ephemeral final-mode aggregation over this operator's OWN
+        output schema: merging per-run states is the same computation
+        for every mode (sum of sums, min of mins; final counts merge by
+        summing), and idempotent over already-final states."""
+        with self.xla_lock():
+            if getattr(self, "_merge", None) is None:
+                self._merge = HashAggregateExec(
+                    _SchemaSource(self._schema),
+                    [(E.Column(n), n) for _, n in self.group_exprs],
+                    self.aggs, "final")
+            return self._merge
 
     def _latch_sorted_fallback(self, ctx, in_schema, cfg_cap, big):
         """Row groups lied about ordering (runtime disorder detection):
@@ -806,55 +908,42 @@ class HashAggregateExec(ExecutionPlan):
             else:
                 key_ranges.append(None)
         key_ranges = tuple(key_ranges)
-        # adaptive capacity: AGG_CAPACITY is the *initial* guess; on
-        # overflow retry at 4x (two pow2 buckets per step, bounded by the
-        # input capacity — groups can never exceed live rows).  Mirrors
-        # the join's bucketed recompilation; static shapes stay static per
-        # bucket.
-        out_cap = min(cfg_cap, big.capacity)
-        # same-stage tasks see similar cardinality and share this operator
-        # instance: once one task discovers the real group count, the rest
-        # start at that capacity instead of re-paying the overflow-retry
-        # ladder (observed: 24 full kernel re-runs for q17's group-by on
-        # l_partkey at SF1 without this)
-        out_cap = min(max(out_cap, getattr(self, "_cap_hint", 0)),
-                      big.capacity)
-        # dense domain bounds distinct groups exactly: don't allocate (or
-        # device->host transfer) a 64k-row output for 12 possible groups
+        # plan-ahead capacity: the group count is bounded a priori — by
+        # the dense key domain when the ranges are static, else by the
+        # input capacity (distinct groups can never exceed live rows) —
+        # so out_cap provably holds every group and the kernel's overflow
+        # flag is statically None (kernels.py returns None whenever
+        # out_cap covers the bound).  ONE kernel call per input: the old
+        # overflow-retry ladder re-ran the whole kernel on the same
+        # buffers at growing capacities, which is what blocked donation
+        # on agg-headed fused chains (ROADMAP #2; compile/fused.py now
+        # donates).  State that outgrows memory is the governor's problem
+        # (reserve -> spill), not a recompile loop's.
+        out_cap = big.capacity
         domain = K.dense_domain(key_ranges)
         if domain is not None:
+            # dense domain bounds distinct groups exactly: don't allocate
+            # (or device->host transfer) a 64k-row output for 12 groups
             out_cap = min(out_cap, domain)
         disorder = None
         with self.metrics().timer("agg_time"):
             aux = comp.aux_arrays(big.dicts)
-            while True:
-                res = jfn(big.columns, big.mask, aux, out_cap, key_ranges)
-                if len(res) == 5:  # presorted path carries a disorder flag
-                    # NOT synced here: the clustered filter fetches it
-                    # together with its live count in one roundtrip
-                    out_keys, out_vals, out_mask, overflow, disorder = res
-                else:
-                    out_keys, out_vals, out_mask, overflow = res
-                # overflow None == statically impossible (kernel proved
-                # out_cap bounds the group count): skip the flag check — a
-                # scalar sync costs ~75 ms per task on remote devices
-                if overflow is None or not bool(overflow):
-                    break
-                if out_cap >= big.capacity:
-                    raise CapacityError(
-                        f"aggregation overflowed {out_cap} groups with "
-                        f"{big.capacity}-row input; this should be impossible"
-                    )
-                # 4x jumps: every retry is a full kernel re-run and the
-                # overflow flag says nothing about the shortfall, so take
-                # half as many retries at the price of a final buffer up to
-                # 2x larger than the 2x ladder's (e.g. 230k groups from a
-                # 64k start: one 4x retry to 256k vs two 2x retries; 460k
-                # groups: two retries to 1M vs three to 512k)
-                out_cap = min(out_cap * 4, big.capacity)
-                self.metrics().add("capacity_recompiles", 1)
-        if out_cap > getattr(self, "_cap_hint", 0):
-            self._cap_hint = out_cap
+            res = jfn(big.columns, big.mask, aux, out_cap, key_ranges)
+            if len(res) == 5:  # presorted path carries a disorder flag
+                # NOT synced here: the clustered filter fetches it
+                # together with its live count in one roundtrip
+                out_keys, out_vals, out_mask, overflow, disorder = res
+            else:
+                out_keys, out_vals, out_mask, overflow = res
+            # overflow is None == statically impossible (the kernel
+            # proved out_cap bounds the group count) on every reachable
+            # shape here; the check is a pure backstop against a future
+            # kernel change and costs a scalar sync only if one happens
+            if overflow is not None and bool(overflow):
+                raise CapacityError(
+                    f"aggregation overflowed {out_cap} groups with "
+                    f"{big.capacity}-row input; this should be impossible"
+                )
 
         cols: Dict[str, jnp.ndarray] = {}
         dicts: Dict[str, np.ndarray] = {}
@@ -946,6 +1035,9 @@ def _window_mask(mask, lo, hi):
 
 
 _mask_or = observed_jit("join.mask_or", lambda a, b: a | b)
+# spilled semi/anti accumulate verdict masks across build partitions:
+# semi ORs hit masks, anti ANDs the surviving masks (pmask & ~hit_p)
+_mask_and = observed_jit("join.mask_and", lambda a, b: a & b)
 
 
 class JoinExec(ExecutionPlan):
@@ -1031,17 +1123,46 @@ class JoinExec(ExecutionPlan):
                     self._build_cache = cached
                     _register_build_cache(ctx.job_id, self)
                 build = cached[1]
+            reservation = None
         else:
-            build = concat_batches(self.right.schema, self.right.execute(partition, ctx)).shrink()
+            bparts = self.right.execute(partition, ctx)
+            lsch, rsch = self.left.schema, self.right.schema
+            # memory governor: reserve the build-side footprint before
+            # concatenating it.  On denial, inner/semi/anti degrade to a
+            # partitioned-build spill (hash-range partitions on disk,
+            # rehydrated one at a time); left/full need every build row
+            # live for their single-pass unmatched-row append, so they
+            # take an over-budget grant instead (visible in the pressure
+            # signal — the doctor points at the query shape).  Broadcast
+            # builds are exempt: the job-scoped cache outlives this task,
+            # and the device pool's watermark sampler accounts for it.
+            gov = getattr(ctx, "governor", None)
+            reservation = None
+            if gov is not None:
+                est = _state_bytes(bparts, rsch)
+                if self.join_type in ("inner", "semi", "anti"):
+                    reservation = gov.try_reserve(
+                        est, site=f"join:{self.join_type}")
+                    if reservation is None:
+                        return self._join_spilled(ctx, probe, bparts,
+                                                  lsch, rsch)
+                else:
+                    reservation = gov.force_reserve(
+                        est, site=f"join:{self.join_type}")
+            build = concat_batches(self.right.schema, bparts).shrink()
 
         lsch, rsch = self.left.schema, self.right.schema
 
-        # lock covers only the jit-closure build (see HashAggregateExec):
-        # concurrent reduce tasks dispatch outside it so transfers overlap
-        # device compute
-        with self.xla_lock():
-            self._ensure_compiled(ctx, lsch, rsch)
-        return self._join_device(ctx, probe, build, lsch, rsch)
+        try:
+            # lock covers only the jit-closure build (see
+            # HashAggregateExec): concurrent reduce tasks dispatch outside
+            # it so transfers overlap device compute
+            with self.xla_lock():
+                self._ensure_compiled(ctx, lsch, rsch)
+            return self._join_device(ctx, probe, build, lsch, rsch)
+        finally:
+            if reservation is not None:
+                reservation.release()
 
     def _ensure_compiled(self, ctx, lsch, rsch):
         if self._compiled is None:
@@ -1157,6 +1278,14 @@ class JoinExec(ExecutionPlan):
                     for n in out_cols
                 }
                 out_mask = jnp.concatenate([out_mask, miss_b])
+            if jt == "inner":
+                # probe-row index per output pair rides along for the
+                # spilled path's order-restoring merge (all matches of
+                # one probe row share one hash, hence one build
+                # partition; a stable host sort on pi reconstructs the
+                # exact single-build emission order).  Device-resident
+                # unless the spill path fetches it.
+                return out_cols, out_mask, total, pi.astype(jnp.int32)
             return out_cols, out_mask, total
 
         def count_fn(pcols, pmask, bh_sorted, laux):
@@ -1289,10 +1418,12 @@ class JoinExec(ExecutionPlan):
                 return self._join_chunked(
                     ctx, probe, build, bh_sorted, border,
                     laux, raux, faux, budget, ceiling, out_cap)
+            # inner joins return a 4th element (pi, for the spilled
+            # path's merge) — every in-memory caller slices it off
             out_cols, out_mask, total = jfn(
                 probe.columns, probe.mask, build.columns, build.mask,
                 bh_sorted, border, laux, raux, faux, out_cap
-            )
+            )[:3]
             # out_cap >= total_est by construction, and the join's own count
             # uses the same hi-lo arithmetic as the count pass, so this
             # retry can only fire if something drifts between the two
@@ -1321,7 +1452,7 @@ class JoinExec(ExecutionPlan):
                 out_cols, out_mask, total = jfn(
                     probe.columns, probe.mask, build.columns, build.mask,
                     bh_sorted, border, laux, raux, faux, need
-                )
+                )[:3]
                 out_cap = need
             if not remote_device() and out_cap == max(64, probe.capacity // 64):
                 # latch ONLY the selective low bucket: that is where the
@@ -1343,6 +1474,180 @@ class JoinExec(ExecutionPlan):
         else:
             deferred_rows(self.metrics(), "output_rows", result)
         return [result]
+
+    #: hash-range partitions a spilled build splits into; each rehydrates
+    #: alone, so peak build memory is ~1/8th of the in-memory path
+    _SPILL_PARTS = 8
+
+    def _join_spilled(self, ctx, probe, build_parts, lsch, rsch):
+        """Reservation denied: partitioned-build spill for
+        inner/semi/anti.  Build batches are split by the TOP BITS OF THE
+        JOIN-KEY HASH into ``_SPILL_PARTS`` disk partitions (IPC runs),
+        then each partition rehydrates alone and the full probe runs
+        against it.
+
+        Bit-identity with the single in-memory build:
+
+        - every candidate match of a probe row shares that row's key
+          hash, so ALL of its matches live in exactly one partition;
+        - build rows keep their original relative order within a
+          partition (batches split in order, runs read in write order),
+          and ``build_side_sort`` breaks equal-hash ties by position, so
+          the per-probe-row match order equals the single build's;
+        - inner outputs carry the probe-row index ``pi``: a stable host
+          sort on pi re-interleaves the per-partition outputs into
+          exactly the single-build emission order;
+        - semi/anti are mask algebra over the probe (hit = OR of
+          per-partition hits), order-free by construction.
+        """
+        from ..memory.spill import Spiller
+
+        with self.xla_lock():
+            self._ensure_compiled(ctx, lsch, rsch)
+            if getattr(self, "_spill_pfn", None) is None:
+                rcomp = self._compiled[1]
+                rkeys = [rcomp.compile_key(re_) for _, re_ in self.on]
+                bits = (self._SPILL_PARTS - 1).bit_length()
+
+                def part_fn(bcols, bmask, raux):
+                    h = K.hash64([c.fn(bcols, raux) for c in rkeys])
+                    # arithmetic shift + mask = top ``bits`` bits
+                    return ((h >> (64 - bits))
+                            & (self._SPILL_PARTS - 1)).astype(jnp.int32)
+
+                self._spill_pfn = observed_jit("join.spill_part", part_fn)
+        lcomp, rcomp, fcomp, jfn, cfn, pfn, _ = self._compiled
+        nparts = self._SPILL_PARTS
+        spiller = Spiller(ctx.work_dir, ctx.job_id, tag="join")
+        runs: List[list] = [[] for _ in range(nparts)]
+        try:
+            with self.metrics().timer("join_time"):
+                for b in build_parts:
+                    ctx.check_cancelled()
+                    part = self._spill_pfn(b.columns, b.mask,
+                                           rcomp.aux_arrays(b.dicts))
+                    cols, _n = b.packed_numpy(extra32={"__part": part})
+                    pids = cols.pop("__part")
+                    for p in range(nparts):
+                        sel = pids == p
+                        if not sel.any():
+                            continue
+                        runs[p].append(spiller.write_run(
+                            rsch,
+                            {f.name: cols[f.name][sel] for f in rsch},
+                            b.dicts))
+                self.metrics().add("spill_runs", len(spiller.runs))
+                self.metrics().add(
+                    "spill_bytes",
+                    sum(r.num_bytes for r in spiller.runs))
+
+                laux = lcomp.aux_arrays(probe.dicts)
+                ceiling = ctx.config.get(JOIN_MAX_CAPACITY)
+                low_floor = max(64, probe.capacity // 64)
+                grand_total = 0
+                inner_parts = []  # (packed cols incl __pi, partition dicts)
+                mask_acc = None
+                for p in range(nparts):
+                    if not runs[p]:
+                        # no build rows hash here: inner/semi add nothing,
+                        # anti keeps pmask (AND identity) — skip
+                        continue
+                    ctx.check_cancelled()
+                    build_p = concat_batches(
+                        rsch, spiller.read(rsch, runs=runs[p])).shrink()
+                    raux = rcomp.aux_arrays(build_p.dicts)
+                    faux = (fcomp.aux_arrays({**probe.dicts,
+                                              **build_p.dicts})
+                            if fcomp is not None else {})
+                    bh_sorted, border = pfn(build_p.columns, build_p.mask,
+                                            raux)
+                    # exact per-partition candidate count sizes the
+                    # output; the cross-join guard sees the partition SUM
+                    total_est = int(cfn(probe.columns, probe.mask,
+                                        bh_sorted, laux))
+                    grand_total += total_est
+                    if grand_total > ceiling:
+                        raise CapacityError(
+                            f"join produced {grand_total}+ candidate "
+                            f"pairs, above the {ceiling}-row ceiling; "
+                            f"likely an accidental near-cross join — "
+                            f"check join keys, or raise "
+                            f"{JOIN_MAX_CAPACITY}")
+                    out_cap = max(low_floor,
+                                  1 << max(0, total_est - 1).bit_length())
+                    res = jfn(probe.columns, probe.mask, build_p.columns,
+                              build_p.mask, bh_sorted, border, laux, raux,
+                              faux, out_cap)
+                    if self.join_type in ("semi", "anti"):
+                        new_mask = res[1]
+                        if mask_acc is None:
+                            mask_acc = new_mask
+                        elif self.join_type == "semi":
+                            mask_acc = _mask_or(mask_acc, new_mask)
+                        else:
+                            mask_acc = _mask_and(mask_acc, new_mask)
+                        continue
+                    out_cols, out_mask, _total, pi = res
+                    pb = ColumnBatch(self._schema, dict(out_cols),
+                                     out_mask,
+                                     {**probe.dicts, **build_p.dicts})
+                    cols, _n = pb.packed_numpy(extra32={"__pi": pi})
+                    inner_parts.append((cols, build_p.dicts))
+            if self.join_type in ("semi", "anti"):
+                if mask_acc is None:  # empty build side
+                    mask_acc = probe.mask if self.join_type == "anti" \
+                        else jnp.zeros_like(probe.mask)
+                out = ColumnBatch(self._schema, dict(probe.columns),
+                                  mask_acc, dict(probe.dicts))
+                deferred_rows(self.metrics(), "output_rows", out)
+                return [out]
+            return [self._merge_spilled_inner(probe, inner_parts, rsch)]
+        finally:
+            spiller.cleanup()
+
+    def _merge_spilled_inner(self, probe, inner_parts, rsch):
+        """Order-restoring merge of per-partition inner outputs: remap
+        each partition's build-side dictionary codes onto the sorted
+        union dictionary, concatenate, stable-sort by probe-row index."""
+        rstr = [f.name for f in rsch if f.dtype.is_string]
+        union: Dict[str, np.ndarray] = {}
+        for n in rstr:
+            vals = [d.get(n) for _c, d in inner_parts
+                    if d.get(n) is not None and len(d.get(n))]
+            union[n] = (np.unique(np.concatenate(vals)) if vals
+                        else np.array([], dtype=object))
+        cols: Dict[str, list] = {f.name: [] for f in self._schema}
+        pis = []
+        for cols_np, dicts_p in inner_parts:
+            for n in rstr:
+                dic = dicts_p.get(n)
+                codes = cols_np[n]
+                if dic is not None and len(dic):
+                    idx = np.searchsorted(union[n], dic).astype(np.int32)
+                    live = codes >= 0
+                    codes = codes.copy()
+                    codes[live] = idx[codes[live]]
+                    cols_np[n] = codes
+            for f in self._schema:
+                cols[f.name].append(cols_np[f.name])
+            pis.append(cols_np["__pi"])
+        pi = np.concatenate(pis) if pis else np.array([], dtype=np.int32)
+        if pi.size == 0:
+            out = ColumnBatch.empty(self._schema, 64)
+            self.metrics().add("output_rows", 0)
+            return out
+        order = np.argsort(pi, kind="stable")
+        data = {n: np.concatenate(v)[order] for n, v in cols.items()}
+        dicts = {}
+        for f in self._schema:
+            if not f.dtype.is_string:
+                continue
+            dicts[f.name] = union[f.name] if f.name in union \
+                else probe.dicts.get(f.name)
+        dicts = {n: d for n, d in dicts.items() if d is not None}
+        out = ColumnBatch.from_numpy(self._schema, data, dicts=dicts)
+        self.metrics().add("output_rows", int(pi.size))
+        return out
 
     def _join_chunked(self, ctx, probe, build, bh_sorted, border,
                       laux, raux, faux, budget: int, ceiling: int,
@@ -1405,7 +1710,7 @@ class JoinExec(ExecutionPlan):
                 out_cap = max(total_c, 64)
             out_cols, out_mask, total = jfn(
                 probe.columns, pmask_c, build.columns, build.mask,
-                bh_sorted, border, laux, raux, faux, out_cap)
+                bh_sorted, border, laux, raux, faux, out_cap)[:3]
             if not remote_device() and int(total) > out_cap:
                 need = 1 << (int(total) - 1).bit_length()
                 if need > ceiling:
@@ -1416,7 +1721,7 @@ class JoinExec(ExecutionPlan):
                 self.metrics().add("capacity_recompiles", 1)
                 out_cols, out_mask, total = jfn(
                     probe.columns, pmask_c, build.columns, build.mask,
-                    bh_sorted, border, laux, raux, faux, need)
+                    bh_sorted, border, laux, raux, faux, need)[:3]
             if self.join_type in ("semi", "anti"):
                 mask_acc = out_mask if mask_acc is None \
                     else _mask_or(mask_acc, out_mask)

@@ -238,6 +238,9 @@ class TaskContext:
     cancelled: Optional[Callable[[], bool]] = None
     # obs.tracing.TaskSpanRecorder for the running task; None = tracing off
     span_recorder: Optional[object] = None
+    # memory.MemoryGovernor of the executing node; None = ungoverned
+    # (operators then materialize unbounded state without reservations)
+    governor: Optional[object] = None
 
     def check_cancelled(self) -> None:
         if self.cancelled is not None and self.cancelled():

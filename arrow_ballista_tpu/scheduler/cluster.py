@@ -106,6 +106,30 @@ class ClusterState:
                     if hb.status == "active" and now - hb.timestamp <= cutoff
                     and eid in self._executors]
 
+    def memory_pressure(self, executor_id: str) -> float:
+        """Last heartbeated memory-governor pressure (0.0 for unknown or
+        unbudgeted executors)."""
+        with self._lock:
+            hb = self._heartbeats.get(executor_id)
+            return hb.memory_pressure if hb is not None else 0.0
+
+    def min_alive_pressure(self, timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S
+                           ) -> float:
+        """The LEAST-pressured alive executor's memory pressure — the
+        admission signal: while any executor has headroom new work can
+        land somewhere, so only the fleet-wide floor crossing the shed
+        threshold means the cluster's memory is saturated.  0.0 when no
+        executor is alive (an empty cluster queues on slots, not memory)."""
+        alive = self.alive_executors(timeout_s)
+        if not alive:
+            return 0.0
+        with self._lock:
+            return min(self._pressure_locked(eid) for eid in alive)
+
+    def _pressure_locked(self, executor_id: str) -> float:
+        hb = self._heartbeats.get(executor_id)
+        return hb.memory_pressure if hb is not None else 0.0
+
     def expired_executors(self, timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S
                           ) -> List[str]:
         """'terminating' executors are NOT expired while they still
@@ -129,16 +153,23 @@ class ClusterState:
             pool = [e for e in pool if e in self._available]
             out: List[ExecutorReservation] = []
             if self.task_distribution == "bias":
-                # pack: drain one executor before touching the next
-                for eid in sorted(pool, key=lambda e: -self._available[e]):
+                # pack: drain one executor before touching the next.
+                # Memory pressure (heartbeated, bucketed to dampen jitter)
+                # degrades the ordering: a near-OOM executor is offered
+                # work only after every calmer one is full
+                for eid in sorted(pool, key=lambda e: (
+                        round(self._pressure_locked(e), 1),
+                        -self._available[e])):
                     take = min(n - len(out), self._available[eid])
                     self._available[eid] -= take
                     out.extend(ExecutorReservation(eid) for _ in range(take))
                     if len(out) >= n:
                         break
             else:
-                # round-robin: one slot per executor per cycle
-                pool = sorted(pool)
+                # round-robin: one slot per executor per cycle; pressured
+                # executors cycle last so partial rounds favor calm hosts
+                pool = sorted(pool, key=lambda e: (
+                    round(self._pressure_locked(e), 1), e))
                 while len(out) < n and pool:
                     progressed = False
                     for i in range(len(pool)):

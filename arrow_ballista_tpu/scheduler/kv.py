@@ -596,16 +596,40 @@ class KvClusterState:
         ])
 
     def save_heartbeat(self, hb) -> None:
+        row = {"ts": hb.timestamp, "status": hb.status}
+        # same omit-when-zero contract as the heartbeat wire format
+        if getattr(hb, "memory_pressure", 0.0):
+            row["mp"] = hb.memory_pressure
         self.store.put(HEARTBEATS, hb.executor_id,
-                       json.dumps({"ts": hb.timestamp, "status": hb.status}))
+                       json.dumps(row))
 
     def touch_heartbeat(self, executor_id: str) -> None:
         """Timestamp-only refresh preserving the status (see
         cluster.ClusterState.touch_heartbeat)."""
         val = self.store.get(HEARTBEATS, executor_id)
-        status = json.loads(val)["status"] if val else "active"
-        self.store.put(HEARTBEATS, executor_id,
-                       json.dumps({"ts": time.time(), "status": status}))
+        prev = json.loads(val) if val else {}
+        row = {"ts": time.time(), "status": prev.get("status", "active")}
+        if prev.get("mp"):
+            row["mp"] = prev["mp"]
+        self.store.put(HEARTBEATS, executor_id, json.dumps(row))
+
+    def memory_pressure(self, executor_id: str) -> float:
+        val = self.store.get(HEARTBEATS, executor_id)
+        return float(json.loads(val).get("mp", 0.0)) if val else 0.0
+
+    def min_alive_pressure(self, timeout_s: float = 60.0) -> float:
+        """Fleet-wide memory-pressure floor over alive executors (see
+        cluster.ClusterState.min_alive_pressure)."""
+        now = time.time()
+        known = {k for k, _ in self.store.scan(EXECUTORS)}
+        floor = None
+        for eid, v in self.store.scan(HEARTBEATS):
+            hb = json.loads(v)
+            if eid in known and hb["status"] == "active" \
+                    and now - hb["ts"] <= timeout_s:
+                p = float(hb.get("mp", 0.0))
+                floor = p if floor is None else min(floor, p)
+        return floor or 0.0
 
     def executors(self):
         from ..serde import executor_metadata_from_obj
@@ -654,12 +678,18 @@ class KvClusterState:
         reserve_slots txn, cluster/kv.rs + storage/mod.rs apply_txn)."""
         from .types import ExecutorReservation
 
+        # heartbeated memory pressure degrades the pick order the same way
+        # the in-memory ClusterState does (bucketed to dampen jitter)
+        mp = {eid: round(float(json.loads(v).get("mp", 0.0)), 1)
+              for eid, v in self.store.scan(HEARTBEATS)}
         for _ in range(16):  # optimistic retries under contention
             snapshot = {k: v for k, v in self.store.scan(SLOTS)}
             if executors is not None:
                 snapshot = {k: v for k, v in snapshot.items() if k in executors}
-            order = sorted(snapshot, key=lambda k: -int(snapshot[k])) \
-                if self.task_distribution == "bias" else sorted(snapshot)
+            order = sorted(snapshot,
+                           key=lambda k: (mp.get(k, 0.0), -int(snapshot[k]))) \
+                if self.task_distribution == "bias" \
+                else sorted(snapshot, key=lambda k: (mp.get(k, 0.0), k))
             picks: List[str] = []
             remaining = n
             if self.task_distribution == "bias":

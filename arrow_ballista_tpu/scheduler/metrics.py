@@ -45,6 +45,7 @@ class SchedulerMetricsCollector:
     # admission control (arrow_ballista_tpu/admission/)
     def record_admitted(self, job_id: str, queue_wait_s: float) -> None: ...
     def record_shed(self, job_id: str) -> None: ...
+    def record_memory_shed(self, job_id: str) -> None: ...
     def set_admission_queue_depth(self, value: int) -> None: ...
     # executor quarantine (scheduler/quarantine.py)
     def record_quarantined(self, executor_id: str) -> None: ...
@@ -100,6 +101,7 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.exec_time = Histogram()
         self.admitted = 0
         self.shed = 0
+        self.memory_sheds = 0
         self.admission_queue_depth = 0
         self.admission_queue_depth_max = 0
         self.admission_wait = Histogram([0.001, 0.01, 0.1, 0.5, 1.0, 5.0,
@@ -164,6 +166,10 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
     def record_shed(self, job_id):
         with self._lock:
             self.shed += 1
+
+    def record_memory_shed(self, job_id):
+        with self._lock:
+            self.memory_sheds += 1
 
     def set_admission_queue_depth(self, value):
         with self._lock:
@@ -276,6 +282,7 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                 "cache_evictions": self.cache_evictions,
                 "speculative_launched": self.speculative_launched,
                 "speculative_wins": self.speculative_wins,
+                "memory_pressure_sheds_total": self.memory_sheds,
                 "quarantined_total": self.quarantined_total,
                 "quarantined_executors": self.quarantined_executors,
                 "integrity_failures": self.integrity_failures,
@@ -311,6 +318,10 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                     "jobs admitted by admission control")
             counter("job_shed_total", self.shed,
                     "jobs shed by admission control (queue full / timeout)")
+            counter("memory_pressure_sheds_total", self.memory_sheds,
+                    "jobs shed or deferred because every alive executor's "
+                    "memory-governor pressure exceeded "
+                    "ballista.memory.pressure.shed.threshold")
             counter("executor_quarantined_total", self.quarantined_total,
                     "executors quarantined after consecutive retryable "
                     "task failures")

@@ -653,7 +653,8 @@ class SchedulerNetService:
         meta = payload.get("meta")
         self.server.heartbeat(ExecutorHeartbeat(
             payload["executor_id"], status=payload.get("status", "active"),
-            metadata=serde.executor_metadata_from_obj(meta) if meta else None))
+            metadata=serde.executor_metadata_from_obj(meta) if meta else None,
+            memory_pressure=float(payload.get("memory_pressure", 0.0))))
         return {}, b""
 
     def _update_task_status(self, payload: dict, _bin: bytes):

@@ -38,6 +38,7 @@ from .quarantine import ExecutorQuarantine
 from .speculation import SpeculationPolicy, find_candidates
 from .types import (
     FETCH_PARTITION_ERROR,
+    RESOURCE_EXHAUSTED,
     ExecutorHeartbeat,
     ExecutorMetadata,
     ExecutorReservation,
@@ -162,7 +163,8 @@ class SchedulerConfig:
                  live_enabled: Optional[bool] = None,
                  live_doctor_interval_s: Optional[float] = None,
                  slo_p99_target_ms: Optional[float] = None,
-                 slo_window_s: Optional[float] = None):
+                 slo_window_s: Optional[float] = None,
+                 memory_shed_threshold: Optional[float] = None):
         from ..utils.config import (BallistaConfig,
                                     CLUSTER_EXECUTOR_TIMEOUT_S,
                                     FLEET_ADOPT_INTERVAL_S,
@@ -171,6 +173,7 @@ class SchedulerConfig:
                                     FLEET_REGISTRY_STALE_S,
                                     LIVE_DOCTOR_INTERVAL_S,
                                     LIVE_ENABLED,
+                                    MEM_PRESSURE_SHED,
                                     QUARANTINE_FAILURES,
                                     QUARANTINE_PROBATION_S,
                                     SLO_P99_TARGET_MS,
@@ -268,6 +271,14 @@ class SchedulerConfig:
         self.slo_window_s = float(
             slo_window_s if slo_window_s is not None
             else defaults.get(SLO_WINDOW_S))
+        # memory backpressure (ballista.memory.pressure.shed.threshold):
+        # when every alive executor heartbeats governor pressure at or
+        # above this, admission queues/sheds new jobs with a retriable
+        # ResourceExhausted instead of piling work onto a fleet about
+        # to spill or OOM.  <= 0 disables the admission feed.
+        self.memory_shed_threshold = float(
+            memory_shed_threshold if memory_shed_threshold is not None
+            else defaults.get(MEM_PRESSURE_SHED))
 
 
 class SchedulerServer:
@@ -402,6 +413,8 @@ class SchedulerServer:
             fail_cb=self._admission_reject,
             pending_tasks_fn=self.pending_task_count,
             total_slots_fn=self.cluster.total_slots,
+            memory_pressure_fn=self._fleet_memory_pressure,
+            memory_shed_threshold=self.config.memory_shed_threshold,
             metrics=self.metrics)
         # terminal transitions release the tenant's concurrency reservation
         # and pull the next admissible job out of the wait queue
@@ -1283,17 +1296,31 @@ class SchedulerServer:
                           f"{type(e).__name__}: {e}"))
                 self.metrics.record_failed(job_id)
 
+    def _fleet_memory_pressure(self) -> float:
+        """Fleet-wide memory-pressure floor (admission's shed signal);
+        0.0 for cluster backends without pressure tracking."""
+        fn = getattr(self.cluster, "min_alive_pressure", None)
+        return fn(self.config.executor_timeout_s) if fn is not None else 0.0
+
     def _record_quarantine_signals(self, executor_id: str,
                                    statuses: List[TaskStatus]) -> None:
         """Feed the quarantine counter: a success clears the reporting
         executor's streak; a *retryable* failure (IOError/ExecutorLost/
         ResultLost) extends it.  Fetch failures blame the producer's data
         and fatal ExecutionErrors fail the job outright — neither says this
-        executor is sick, so neither counts."""
+        executor is sick, so neither counts.  ResourceExhausted is
+        retryable but ALSO exempt: a governor denial means the executor
+        protected itself from OOM — blaming it into quarantine would
+        quarantine the whole fleet exactly when memory is tight."""
         for st in statuses:
             eid = st.executor_id or executor_id
             if st.state == "success":
                 self.quarantine.record_success(eid)
+            elif (st.state == "failed" and st.failure is not None
+                  and st.failure.kind == RESOURCE_EXHAUSTED):
+                # no strike, no streak reset: memory back-pressure says
+                # nothing about this executor's health either way
+                pass
             elif (st.state == "failed" and st.failure is not None
                   and st.failure.kind == FETCH_PARTITION_ERROR
                   and "integrity check failed" in st.failure.message):

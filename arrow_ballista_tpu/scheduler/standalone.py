@@ -135,8 +135,9 @@ class StandaloneCluster:
         # reference executors heartbeat every 60 s (executor_server.rs:465)
         while not self._hb_stop.wait(10.0):
             for ex in self.executors:
-                self.scheduler.heartbeat(
-                    ExecutorHeartbeat(ex.metadata.executor_id))
+                self.scheduler.heartbeat(ExecutorHeartbeat(
+                    ex.metadata.executor_id,
+                    memory_pressure=ex.governor.pressure()))
 
     # --- query execution -------------------------------------------------
     def execute_sql(self, sql_text: str, catalog,

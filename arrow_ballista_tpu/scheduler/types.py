@@ -22,6 +22,10 @@ IO_ERROR = "IOError"                    # retryable on another executor
 EXECUTOR_LOST = "ExecutorLost"          # retryable
 RESULT_LOST = "ResultLost"              # retryable, outputs discarded
 TASK_KILLED = "TaskKilled"              # cancellation
+# memory-governor denial that could not degrade to spill: retryable
+# back-pressure (ideally on a less-loaded executor) and NEVER a
+# quarantine strike — an executor protecting itself from OOM is healthy
+RESOURCE_EXHAUSTED = "ResourceExhausted"
 
 
 @dataclasses.dataclass
@@ -67,11 +71,15 @@ class FailedReason:
 
     @property
     def retryable(self) -> bool:
-        return self.kind in (IO_ERROR, EXECUTOR_LOST, RESULT_LOST)
+        return self.kind in (IO_ERROR, EXECUTOR_LOST, RESULT_LOST,
+                             RESOURCE_EXHAUSTED)
 
     @property
     def count_to_failures(self) -> bool:
-        return self.kind == IO_ERROR
+        # RESOURCE_EXHAUSTED counts toward task attempts (bounding retry
+        # loops against a saturated cluster) but is exempted from
+        # quarantine strikes (scheduler._record_quarantine_signals)
+        return self.kind in (IO_ERROR, RESOURCE_EXHAUSTED)
 
 
 @dataclasses.dataclass
@@ -126,6 +134,11 @@ class ExecutorHeartbeat:
     # carried so a restarted scheduler can auto re-register unknown
     # heartbeaters (reference heart_beat_from_executor, grpc.rs:174-241)
     metadata: Optional[ExecutorMetadata] = None
+    # memory governor pressure in [0, 1] (fraction of the most-loaded
+    # budgeted pool in use): degrades this executor's offer ordering and,
+    # past ballista.memory.pressure.shed.threshold, feeds admission shed.
+    # 0.0 (the unbudgeted default) is omitted on the wire.
+    memory_pressure: float = 0.0
 
 
 @dataclasses.dataclass

@@ -666,17 +666,23 @@ def executor_metadata_from_obj(o: dict) -> ExecutorMetadata:
 
 
 def executor_heartbeat_to_obj(h: ExecutorHeartbeat) -> dict:
-    return {"executor_id": h.executor_id, "timestamp": h.timestamp,
-            "status": h.status,
-            "metadata": (executor_metadata_to_obj(h.metadata)
-                         if h.metadata is not None else None)}
+    out = {"executor_id": h.executor_id, "timestamp": h.timestamp,
+           "status": h.status,
+           "metadata": (executor_metadata_to_obj(h.metadata)
+                        if h.metadata is not None else None)}
+    # pressure 0.0 (the unbudgeted default) omits the key — old-wire
+    # peers and idle fleets pay nothing
+    if h.memory_pressure:
+        out["memory_pressure"] = h.memory_pressure
+    return out
 
 
 def executor_heartbeat_from_obj(o: dict) -> ExecutorHeartbeat:
     meta = o.get("metadata")
     return ExecutorHeartbeat(
         o["executor_id"], o.get("timestamp", 0.0), o.get("status", "active"),
-        executor_metadata_from_obj(meta) if meta else None)
+        executor_metadata_from_obj(meta) if meta else None,
+        memory_pressure=float(o.get("memory_pressure", 0.0)))
 
 
 def executor_reservation_to_obj(r: ExecutorReservation) -> dict:

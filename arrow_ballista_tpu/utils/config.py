@@ -34,6 +34,13 @@ BROADCAST_THRESHOLD = "ballista.join.broadcast_threshold"  # rows; build sides s
 JOB_TIMEOUT_S = "ballista.job.timeout.seconds"  # client-side wait_for_job deadline
 SCAN_CACHE_BYTES = "ballista.scan.cache.bytes"  # HBM-resident scan cache budget ('auto' | bytes | 0=off)
 MEM_TASK_BUDGET = "ballista.memory.task.budget.bytes"  # per-task device working-set bound ('auto' | bytes | 0=unlimited)
+# memory governor (arrow_ballista_tpu/memory/): reserve->grant->release
+# accounting over a host-RSS pool and a device-HBM pool; operators that
+# hold unbounded state reserve before materializing and spill on denial
+MEM_HOST_BUDGET = "ballista.memory.host.budget.bytes"
+MEM_DEVICE_BUDGET = "ballista.memory.device.budget.bytes"
+MEM_SPILL_ENABLED = "ballista.memory.spill.enabled"
+MEM_PRESSURE_SHED = "ballista.memory.pressure.shed.threshold"
 # admission control / multi-tenancy (arrow_ballista_tpu/admission/) — all
 # default to 0/"" = pass-through, the subsystem activates only when set
 ADMISSION_TENANT = "ballista.admission.tenant"
@@ -240,6 +247,32 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "partition counts scale to keep task state under it.  "
                     "'auto' = 4 GiB on accelerator backends, unlimited on "
                     "CPU; 0 = unlimited"),
+        ConfigEntry(MEM_HOST_BUDGET, "0", str,
+                    "memory governor: host-RSS pool budget in bytes for "
+                    "operator state (join build sides, aggregation "
+                    "groups).  Reservations beyond the budget are denied "
+                    "and the operator spills its state to disk as Arrow "
+                    "IPC runs (bit-identical results).  'auto' = 16 GiB; "
+                    "0 = unlimited (governor grants everything, spill "
+                    "never triggers)"),
+        ConfigEntry(MEM_DEVICE_BUDGET, "0", str,
+                    "memory governor: device-HBM pool budget in bytes, "
+                    "checked against the live-buffer watermark sampler "
+                    "(obs/device.py).  'auto' = 12 GiB on accelerator "
+                    "backends, unlimited on CPU; 0 = unlimited"),
+        ConfigEntry(MEM_SPILL_ENABLED, True, _parse_bool,
+                    "degrade to disk spill when the governor denies a "
+                    "reservation (aggs: partial runs + sort-merge "
+                    "finalize; joins: partitioned build rehydrate).  "
+                    "False = a denial raises retryable MemoryExhausted "
+                    "instead of spilling"),
+        ConfigEntry(MEM_PRESSURE_SHED, 0.95, float,
+                    "executor memory pressure (reserved/budget, max over "
+                    "pools, reported via heartbeat) at or above which the "
+                    "scheduler stops offering that executor tasks and "
+                    "admission sheds new jobs with retriable "
+                    "ResourceExhausted; >= 1.0 still degrades offer "
+                    "ordering but never sheds"),
         ConfigEntry(ADMISSION_TENANT, "", str,
                     "tenant identity for admission control; empty = the "
                     "session id (each session is its own tenant)"),
@@ -619,6 +652,25 @@ def resolve_task_budget(cfg: "BallistaConfig") -> int:
             from ..models.batch import _platform_remote
 
             return (4 << 30) if _platform_remote() else 0
+        v = int(v)
+    return int(v)
+
+
+def resolve_pool_budget(cfg: "BallistaConfig", key: str) -> int:
+    """MEM_HOST_BUDGET / MEM_DEVICE_BUDGET -> bytes (0 = unlimited).
+
+    'auto' picks a conservative default: 16 GiB for the host pool, and
+    for the device pool 12 GiB on accelerator backends (under every
+    shipping HBM size) / unlimited on CPU, mirroring the
+    resolve_task_budget platform keying."""
+    v = cfg.get(key)
+    if isinstance(v, str):
+        if v.strip().lower() == "auto":
+            if key == MEM_DEVICE_BUDGET:
+                from ..models.batch import _platform_remote
+
+                return (12 << 30) if _platform_remote() else 0
+            return 16 << 30
         v = int(v)
     return int(v)
 

@@ -69,6 +69,34 @@ class ResourceExhausted(BallistaError):
     retryable = True
 
 
+class MemoryExhausted(BallistaError):
+    """The memory governor (arrow_ballista_tpu/memory/) denied a
+    reservation and the operator could not degrade to spill (spill
+    disabled, or the denial hit a non-spillable allocation).
+
+    Retryable back-pressure, **never** an executor fault: the scheduler
+    retries the task (ideally on a less-loaded executor) and the
+    quarantine tracker is explicitly exempted — an executor that protects
+    itself by denying memory must not be blamed into quarantine for it.
+    Pickle-safe (crosses the executor -> scheduler boundary)."""
+
+    retryable = True
+
+    def __init__(self, pool: str, requested: int, available: int,
+                 message: str = ""):
+        super().__init__(pool, requested, available, message)
+        self.pool = pool
+        self.requested = requested
+        self.available = available
+        self.message = message
+
+    def __str__(self):
+        return (
+            f"memory exhausted on pool {self.pool!r}: requested "
+            f"{self.requested} bytes, {self.available} available"
+            + (f" ({self.message})" if self.message else ""))
+
+
 class FetchFailedError(BallistaError):
     """A shuffle fetch from ``executor_id`` failed.
 

@@ -362,16 +362,20 @@ CHAOS_CONF = {
 SQL = "select g, sum(v) as s, count(*) as n from t group by g order by g"
 
 
-def _make_cluster(tmp_path, n_executors=2, concurrent_tasks=4):
+def _make_cluster(tmp_path, n_executors=2, concurrent_tasks=4, conf=None,
+                  **sched_kw):
     from arrow_ballista_tpu.executor.server import ExecutorServer
     from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
     from arrow_ballista_tpu.scheduler.scheduler import SchedulerConfig
 
+    conf_d = dict(CHAOS_CONF)
+    conf_d.update(conf or {})
     sched = SchedulerNetService(
-        "127.0.0.1", 0, config=BallistaConfig(CHAOS_CONF),
+        "127.0.0.1", 0, config=BallistaConfig(conf_d),
         scheduler_config=SchedulerConfig(task_distribution="round-robin",
                                          executor_timeout_s=3.0,
-                                         reaper_interval_s=0.3))
+                                         reaper_interval_s=0.3,
+                                         **sched_kw))
     sched.start()
     executors = []
     for i in range(n_executors):
@@ -381,7 +385,7 @@ def _make_cluster(tmp_path, n_executors=2, concurrent_tasks=4):
                             work_dir=str(work),
                             concurrent_tasks=concurrent_tasks,
                             executor_id=f"chaos-exec-{i}",
-                            config=BallistaConfig(CHAOS_CONF),
+                            config=BallistaConfig(conf_d),
                             heartbeat_interval_s=0.4)
         ex.start()
         executors.append(ex)
@@ -943,6 +947,133 @@ def test_mid_stream_producer_loss_rolls_back_and_recovers(tmp_path):
         assert any(s.stage_attempt >= 1 for g in graphs
                    for s in g.stages.values()), "no producer re-run recorded"
         _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario: memory governor denies every grant -> forced spill, results
+# bit-identical to the in-memory run
+# --------------------------------------------------------------------------
+
+def test_forced_spill_results_bit_identical(tmp_path):
+    from arrow_ballista_tpu.memory import STATS as mem_stats
+
+    sched, executors = _make_cluster(tmp_path)
+    try:
+        c = _client(sched.port)
+        baseline = c.sql(SQL).to_pandas()
+
+        mem_stats.reset()
+        plan = faults.FaultPlan.from_obj({"seed": 19, "rules": [{
+            "site": "executor.memory.reserve", "action": "raise",
+            "error": "resource", "times": -1}]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        assert plan.schedule(), "the deny rule must actually have fired"
+        snap = mem_stats.snapshot()
+        assert snap.get("spill_runs_total", 0) > 0, \
+            "denied grants must have degraded operators to the spill path"
+        assert snap.get("reserved_bytes.host", 0) == 0, "no reservation leaks"
+        assert sched.server.quarantine.count() == 0, \
+            "governor denials must never quarantine an executor"
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario: spill run corrupted on disk -> read-back CRC catches it, the
+# task retry recomputes from shuffle inputs (lineage), results identical
+# --------------------------------------------------------------------------
+
+def test_spill_corruption_heals_via_lineage(tmp_path):
+    from arrow_ballista_tpu.memory import STATS as mem_stats
+
+    sched, executors = _make_cluster(tmp_path)
+    try:
+        c = _client(sched.port)
+        baseline = c.sql(SQL).to_pandas()
+
+        mem_stats.reset()
+        plan = faults.FaultPlan.from_obj({"seed": 23, "rules": [
+            # every reservation denied: all operators take the spill path,
+            # including the retried task attempt
+            {"site": "executor.memory.reserve", "action": "raise",
+             "error": "resource", "times": -1},
+            # the first spill run rots on disk after its CRC is recorded
+            {"site": "executor.spill.write", "action": "corrupt",
+             "times": 1},
+        ]})
+        with faults.use_plan(plan):
+            got = c.sql(SQL).to_pandas()
+
+        fired = {(site, rule) for site, rule, _hit, _a in plan.schedule()}
+        assert ("executor.spill.write", 1) in fired, \
+            "the corrupt rule must have fired"
+        graphs = list(sched.server.jobs._graphs.values())
+        assert any(f >= 1 for g in graphs for s in g.stages.values()
+                   for f in s.task_failures), \
+            "the CRC mismatch must have failed a task attempt (retryably)"
+        assert sched.server.quarantine.count() == 0, \
+            "one integrity retry must not quarantine anything"
+        _frames_equal(got, baseline)
+        c.shutdown()
+    finally:
+        _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# scenario: every executor's governor saturated -> admission sheds new
+# jobs with a retriable ResourceExhausted; draining pressure re-admits
+# --------------------------------------------------------------------------
+
+def test_memory_shed_surfaces_retriable_and_recovers(tmp_path):
+    from arrow_ballista_tpu.utils.config import MEM_HOST_BUDGET
+    from arrow_ballista_tpu.utils.errors import ResourceExhausted
+
+    budget = 1 << 20
+    sched, executors = _make_cluster(
+        tmp_path, conf={MEM_HOST_BUDGET: str(budget)},
+        memory_shed_threshold=0.95)
+    try:
+        c = _client(sched.port)
+        baseline = c.sql(SQL).to_pandas()
+
+        # saturate every executor's governor (simulated resident state);
+        # the pressure floor only rises when NO executor has headroom
+        held = [ex.executor.governor.force_reserve(int(budget * 0.99))
+                for ex in executors]
+
+        def floor():
+            return sched.server.cluster.min_alive_pressure(3.0)
+
+        deadline = time.monotonic() + 10.0
+        while floor() < 0.95 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert floor() >= 0.95, "heartbeats must carry the pressure in"
+
+        with pytest.raises(ResourceExhausted) as exc:
+            c.sql(SQL).to_pandas()
+        assert exc.value.retryable
+        assert "memory saturated" in str(exc.value)
+        assert "retry after" in str(exc.value)
+        assert sched.server.metrics.counters_snapshot()[
+            "memory_pressure_sheds_total"] == 1
+        assert sched.server.quarantine.count() == 0, \
+            "shedding is back-pressure, never an executor fault"
+
+        # drain the pressure: the next heartbeat re-opens admission
+        for r in held:
+            r.release()
+        deadline = time.monotonic() + 10.0
+        while floor() >= 0.95 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert floor() < 0.95
+        _frames_equal(c.sql(SQL).to_pandas(), baseline)
         c.shutdown()
     finally:
         _teardown(sched, executors)

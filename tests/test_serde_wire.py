@@ -117,6 +117,7 @@ SAMPLES = {
         ExecutorHeartbeat("exec-1", timestamp=123.5),
         ExecutorHeartbeat("exec-2", timestamp=124.0, status="terminating",
                           metadata=ExecutorMetadata("exec-2", port=7000)),
+        ExecutorHeartbeat("exec-3", timestamp=125.0, memory_pressure=0.7),
     ],
     ExecutorReservation: [
         ExecutorReservation("exec-1"),
@@ -193,6 +194,20 @@ def test_heartbeat_nested_metadata_round_trips():
     decoded = from_obj(json.loads(json.dumps(to_obj(hb))))
     assert decoded.metadata == hb.metadata
     assert from_obj(to_obj(SAMPLES[ExecutorHeartbeat][0])).metadata is None
+
+
+def test_heartbeat_memory_pressure_omitted_when_zero():
+    """Pressure 0.0 (the unbudgeted default) must stay off the wire so
+    idle fleets and old-wire peers pay nothing; a nonzero value round
+    trips exactly."""
+    to_obj, from_obj = serde.WIRE_TYPES[ExecutorHeartbeat]
+    calm = to_obj(SAMPLES[ExecutorHeartbeat][0])
+    assert "memory_pressure" not in calm
+    assert from_obj(calm).memory_pressure == 0.0
+    hot = to_obj(SAMPLES[ExecutorHeartbeat][2])
+    assert hot["memory_pressure"] == pytest.approx(0.7)
+    assert from_obj(json.loads(json.dumps(hot))).memory_pressure == \
+        pytest.approx(0.7)
 
 
 def test_scalarref_carries_dtype_for_planless_substitution():

@@ -1,0 +1,142 @@
+"""Memory-governor oracle sweep: all 22 TPC-H queries, twice — once with
+unlimited memory, once under a budget tiny enough that the governor
+denies **every** join-build and aggregation-state reservation — results
+compared **bit-identically** between the legs.
+
+The memory plane (docs/user-guide/memory.md) promises that spilling is
+invisible to results: agg partial runs + sort-merge finalize, join
+partitioned-build rehydrate, both emitting exactly what the in-memory
+path emits.  This sweep is the oracle for that promise, and it also
+asserts the negative space: the budget leg must actually have denied
+reservations and written spill runs (a sweep where nothing spilled
+proves nothing), and every reservation must be released by the end
+(leak check: reserved bytes return to zero).
+
+    python -m tools.memory_sweep            # writes MEMORY_SWEEP.json
+
+Legs:
+
+- ``unlimited``: shipped defaults (budget 0) — the bit-identity baseline
+- ``budget``:    ``ballista.memory.host.budget.bytes=MEMSWEEP_BUDGET``
+                 (default 1 MiB: below any SF1 build/agg footprint)
+
+Env knobs: ``BENCH_DATA`` (default ``.bench_data/tpch-sf1``; when the
+directory is missing the sweep generates SF ``MEMSWEEP_SCALE`` tables
+in-process instead), ``SWEEP_QUERIES``, ``SWEEP_OUT``,
+``MEMSWEEP_BUDGET``, ``MEMSWEEP_SCALE`` (default 0.01).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DATA_DIR = os.environ.get(
+    "BENCH_DATA", os.path.join(REPO, ".bench_data", "tpch-sf1"))
+OUT = os.environ.get("SWEEP_OUT", os.path.join(REPO, "MEMORY_SWEEP.json"))
+BUDGET = int(os.environ.get("MEMSWEEP_BUDGET", str(1 << 20)))
+SCALE = float(os.environ.get("MEMSWEEP_SCALE", "0.01"))
+
+LEGS = {
+    "unlimited": {},
+    "budget": {"ballista.memory.host.budget.bytes": str(BUDGET)},
+}
+
+
+def _register(ctx):
+    from benchmarks.tpch import register_tables
+
+    if os.path.exists(os.path.join(DATA_DIR, "lineitem.parquet")):
+        register_tables(ctx, DATA_DIR)
+        return DATA_DIR
+    from benchmarks.datagen import generate_tables
+
+    for name, table in generate_tables(SCALE, seed=1).items():
+        ctx.register_table(name, table)
+    return f"generated sf{SCALE}"
+
+
+def _run_leg(leg: str, overrides: dict, queries, artifact: dict):
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.memory.governor import STATS as MEM_STATS
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+    from benchmarks.queries import QUERIES
+
+    conf = {"ballista.batch.size": str(1 << 20), **overrides}
+    ctx = BallistaContext.local(BallistaConfig(dict(conf)))
+    frames = {}
+    MEM_STATS.reset()
+    try:
+        artifact["data"] = _register(ctx)
+        for q in queries:
+            t0 = time.time()
+            frames[q] = ctx.sql(QUERIES[q]).to_pandas()
+            artifact.setdefault(f"q{q}", {})[f"{leg}_s"] = round(
+                time.time() - t0, 1)
+            print(f"[memsweep] {leg} q{q}: {time.time()-t0:.1f}s "
+                  f"({len(frames[q])} rows)", flush=True)
+    finally:
+        ctx.shutdown()
+    snap = MEM_STATS.snapshot()
+    artifact[f"{leg}_governor"] = snap
+    # leak check: every reservation a leg took must have been released
+    for key, n in snap.items():
+        if key.startswith("reserved_bytes."):
+            assert n == 0, f"{leg}: {n} bytes leaked in {key}"
+    return frames
+
+
+def main() -> None:
+    import pandas as pd
+
+    from benchmarks.queries import QUERIES
+
+    queries = sorted(
+        int(x) for x in os.environ.get(
+            "SWEEP_QUERIES", ",".join(map(str, sorted(QUERIES)))).split(",")
+        if x.strip())
+
+    t_all = time.time()
+    artifact: dict = {"legs": list(LEGS), "budget_bytes": BUDGET}
+    baseline = _run_leg("unlimited", LEGS["unlimited"], queries, artifact)
+    frames = _run_leg("budget", LEGS["budget"], queries, artifact)
+
+    gov = artifact["budget_governor"]
+    assert gov.get("reserve_denied_total", 0) > 0, \
+        f"budget leg denied nothing — sweep proved nothing: {gov}"
+    assert gov.get("spill_runs_total", 0) > 0, \
+        f"budget leg wrote no spill runs: {gov}"
+
+    ok, mismatches = 0, []
+    for q in queries:
+        entry = artifact.setdefault(f"q{q}", {})
+        try:
+            # bit-identical: exact dtypes, exact values, exact order
+            pd.testing.assert_frame_equal(
+                baseline[q].reset_index(drop=True),
+                frames[q].reset_index(drop=True), check_exact=True)
+            entry["identical"] = True
+            ok += 1
+        except Exception as e:  # noqa: BLE001 — record and continue
+            entry["identical"] = False
+            entry["error"] = str(e)[:500]
+            mismatches.append(q)
+    artifact["identical"] = ok
+    artifact["total"] = len(queries)
+    artifact["wall_s"] = round(time.time() - t_all, 1)
+    with open(OUT, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"[memsweep] {ok}/{len(queries)} bit-identical under a "
+          f"{BUDGET}-byte budget ({gov['spill_runs_total']} spill runs, "
+          f"{gov['spill_bytes_total']} bytes, "
+          f"{gov['reserve_denied_total']} denials) -> {OUT}", flush=True)
+    if mismatches:
+        raise SystemExit(f"spill-path mismatch on queries: {mismatches}")
+
+
+if __name__ == "__main__":
+    main()

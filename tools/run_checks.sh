@@ -26,24 +26,29 @@
 #      every real lock acquisition is checked against the static
 #      concurrency model, and any inversion or unpredicted nesting fails
 #      the leg,
-#   5. the doctor smoke: one standalone query with the flight recorder
+#   5. the memory-governor oracle sweep (tools/memory_sweep.py): the
+#      TPC-H suite twice — unlimited memory vs a budget tiny enough that
+#      the governor denies every join-build and aggregation-state
+#      reservation — every query bit-identical between the legs, spills
+#      proven to have happened, zero reservation leaks,
+#   6. the doctor smoke: one standalone query with the flight recorder
 #      on — the forensics bundle must validate against the
 #      ballista.forensics/v1 schema, carry a complete journal timeline,
 #      and the query doctor must return zero findings on the healthy
 #      run,
-#   6. the live-obs smoke: one standalone query with the live plane on,
+#   7. the live-obs smoke: one standalone query with the live plane on,
 #      then watched via ctx.watch() — at least one progress frame with a
 #      monotonically non-decreasing fraction, a terminal frame, and zero
 #      journal drops,
-#   7. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
+#   8. the serving smoke (benchmarks/serving.py --smoke): 8 concurrent
 #      sessions of repeated q6 variants through the prepared-plan +
 #      result caches — zero errors and a nonzero plan-cache hit rate,
 #      also under the runtime lock-order validator,
-#   8. the fleet serving smoke (--smoke --shards 2): the same workload
+#   9. the fleet serving smoke (--smoke --shards 2): the same workload
 #      against a 2-shard scheduler fleet behind a shared KV, then a
 #      failover leg that crash-kills shard 0 mid-run — both legs must
 #      complete every query with zero errors,
-#   9. the perf gate (tools/perf_gate.py): newest BENCH_r*.json round vs
+#  10. the perf gate (tools/perf_gate.py): newest BENCH_r*.json round vs
 #      the previous clean round, per-query wall time and throughput —
 #      STRICT since PR 17: regressions past the tolerance fail; override
 #      with BALLISTA_PERF_TOLERANCE on noisy hardware.
@@ -76,6 +81,9 @@ BALLISTA_LOCK_ORDER_RUNTIME=1 \
     python -m pytest tests/test_chaos.py tests/test_fleet.py \
     tests/test_doctor.py tests/test_compile.py tests/test_live_obs.py \
     -q -m chaos -p no:cacheprovider
+
+echo "== memory-governor oracle sweep (tiny budget: every join/agg spills, bit-identical) =="
+python -m tools.memory_sweep
 
 echo "== doctor smoke (flight recorder on: bundle validates, clean run diagnoses clean) =="
 python - <<'EOF'
