@@ -50,6 +50,8 @@ HELP = """\
               ranked pathology findings with evidence + config remedies
 \\watch [JOB]  live view of JOB (default: the last job): journal events
               as they happen + a progress bar with rows/s and ETA
+\cancel [JOB] cancel JOB (default: the last job) fleet-wide; running
+              tasks stop at their next cooperative checkpoint
 anything else is executed as SQL.
 """
 
@@ -122,6 +124,11 @@ def run_command(ctx, line: str, timing: bool) -> bool:
     if cmd == "\\watch" or cmd.startswith("\\watch "):
         job_id = cmd[len("\\watch"):].strip() or None
         _watch_command(ctx, job_id)
+        return timing
+    if cmd == "\\cancel" or cmd.startswith("\\cancel "):
+        job_id = cmd[len("\\cancel"):].strip() or None
+        ctx.cancel(job_id)
+        print(f"cancel requested for {job_id or 'the last job'}")
         return timing
     t0 = time.perf_counter()
     df = ctx.sql(cmd)

@@ -421,6 +421,30 @@ class BallistaContext:
 
         return diagnose(self.forensics(job_id))
 
+    def cancel(self, job_id: Optional[str] = None) -> None:
+        """Cancel ``job_id`` (default: the last job this session ran)
+        fleet-wide.  The scheduler pulls a still-queued job out of the
+        admission queue; for a running job it fans a cancel out to every
+        executor holding its tasks — cooperative cancellation checkpoints
+        between operator batches and fused-kernel invocations land the
+        kill in seconds, and heartbeat zombie reconciliation re-issues any
+        fanout the network lost.  All job state (admission permits, slot
+        reservations, speculation bookkeeping) is released with the
+        terminal status.  Idempotent: cancelling a finished or already
+        cancelled job is a no-op."""
+        if self._remote is not None:
+            if not job_id:
+                raise PlanningError("remote cancel needs an explicit job id")
+            self._remote.cancel_job(job_id)
+            return
+        if self._standalone is None:
+            raise PlanningError(
+                "cancel requires a standalone or remote session")
+        job_id = job_id or self._standalone.last_job_id
+        if not job_id:
+            raise PlanningError("no job has run in this session yet")
+        self._standalone.scheduler.cancel_job(job_id)
+
     def watch(self, job_id: Optional[str] = None,
               timeout: Optional[float] = None):
         """Live watch stream for ``job_id`` (default: the last job this

@@ -278,6 +278,15 @@ class RemoteCluster:
                 batches.extend(self._fetch(loc, schema))
         return batches
 
+    # --- lifecycle control -----------------------------------------------
+    def cancel_job(self, job_id: str) -> None:
+        """Ask the scheduler to cancel ``job_id`` fleet-wide: running tasks
+        get a cancel fanout (cooperative checkpoints land it in seconds), a
+        still-queued job is pulled from the admission queue, and every
+        leaked remnant — slot reservations, admission permits, speculation
+        state — is released with the terminal status."""
+        self._call("cancel_job", {"job_id": job_id})
+
     # --- live watch ------------------------------------------------------
     def watch(self, job_id: str, timeout: Optional[float] = None):
         """Generator of live watch frames for ``job_id`` — dicts tagged

@@ -15,7 +15,7 @@ import time
 import traceback
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 from .. import faults
 from ..memory import MemoryGovernor
@@ -87,6 +87,12 @@ class Executor:
         self._max_cancelled = 1024
         self._lock = threading.Lock()
         self._active = 0
+        # in-flight registry: (job, stage, partition, attempt) -> the
+        # attempt's cooperative CancelToken.  Feeds the heartbeat's
+        # running-task set (zombie reconciliation) and lets cancel fanout
+        # flip tokens so a cancel lands at the next batch boundary even in
+        # contexts without a wired probe
+        self._inflight: Dict[tuple, object] = {}
         # prometheus-style process counters (served by ExecutorServer's
         # /metrics listener; always collected — they are a few ints)
         from .metrics import ExecutorMetrics
@@ -168,6 +174,14 @@ class Executor:
                              executor_id=self.metadata.executor_id,
                              speculative=tid.speculative)
             status = self._run_task_inner(task, launch_ms, recorder)
+            if (status.state == "killed"
+                    and tid.job_id in self._cancelled_jobs):
+                # a task that slipped past its cancel checkpoints (e.g. a
+                # single-batch partition) can write shuffle files AFTER
+                # the scheduler's cleanup fanout already ran — the last
+                # dying task of a cancelled job sweeps the job's data so
+                # the workspace never leaks what nothing registered
+                remove_job_data(self.work_dir, tid.job_id)
         if dev_acc is not None:
             status.device_stats = dev_acc.snapshot()
         if jbuf:
@@ -190,9 +204,20 @@ class Executor:
 
     def _run_task_inner(self, task: TaskDescription, launch_ms: int,
                         recorder) -> TaskStatus:
+        from ..ops.physical import CancelToken, install_cancel_token
+
         tid = task.task
+        key = (tid.job_id, tid.stage_id, tid.partition, tid.task_attempt)
+        token = CancelToken()
         with self._lock:
             self._active += 1
+            self._inflight[key] = token
+        # thread-local install: TaskContext.check_cancelled (and the free
+        # checkpoint()) consult the token between batch iterations and
+        # fused-kernel invocations, so cancel/deadline lands in seconds
+        install_cancel_token(token)
+        if self._is_cancelled(tid):
+            token.cancel()  # cancel arrived before launch
         try:
             if self._is_cancelled(tid):
                 return TaskStatus(tid, self.metadata.executor_id, "killed")
@@ -272,8 +297,10 @@ class Executor:
                               failure=FailedReason(EXECUTION_ERROR,
                                                    f"{type(e).__name__}: {e}"))
         finally:
+            install_cancel_token(None)
             with self._lock:
                 self._active -= 1
+                self._inflight.pop(key, None)
 
     def submit_task(self, task: TaskDescription,
                     on_done: Callable[[TaskStatus], None]) -> None:
@@ -292,6 +319,12 @@ class Executor:
         self._cancelled_jobs[job_id] = None
         while len(self._cancelled_jobs) > self._max_cancelled:
             self._cancelled_jobs.popitem(last=False)
+        # flip the in-flight tokens too: the thread-local checkpoint fires
+        # at the next batch boundary even where no probe was wired
+        with self._lock:
+            for key, token in self._inflight.items():
+                if key[0] == job_id:
+                    token.cancel()
 
     def cancel_task(self, task_id) -> None:
         """Cancel ONE attempt (a speculative race's loser): the flag is
@@ -302,10 +335,27 @@ class Executor:
         self._cancelled_tasks[key] = None
         while len(self._cancelled_tasks) > self._max_cancelled:
             self._cancelled_tasks.popitem(last=False)
+        with self._lock:
+            token = self._inflight.get(key)
+        if token is not None:
+            token.cancel()
 
     def active_tasks(self) -> int:
         with self._lock:
             return self._active
+
+    def running_task_ids(self) -> List[tuple]:
+        """(job, stage, partition, attempt) of in-flight tasks — the
+        heartbeat's running-task set (zombie reconciliation).  Empty for
+        an idle executor, so the heartbeat wire shape is unchanged."""
+        with self._lock:
+            return sorted(self._inflight)
+
+    def active_job_ids(self) -> Set[str]:
+        """Jobs with at least one in-flight task here (the shuffle
+        janitor's live-job guard)."""
+        with self._lock:
+            return {key[0] for key in self._inflight}
 
     def shutdown(self) -> None:
         self.pool.shutdown(wait=True)

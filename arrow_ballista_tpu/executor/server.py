@@ -111,7 +111,8 @@ class SchedulerClient:
 
     def heartbeat(self, executor_id: str, status: str = "active",
                   meta: Optional[ExecutorMetadata] = None,
-                  pressure: float = 0.0) -> None:
+                  pressure: float = 0.0,
+                  running: Optional[List[tuple]] = None) -> None:
         if faults.dropped("executor.heartbeat.send", executor_id=executor_id,
                           status=status):
             raise ConnectionError(
@@ -123,6 +124,10 @@ class SchedulerClient:
         # wire format is unchanged for unconstrained fleets
         if pressure:
             payload["memory_pressure"] = pressure
+        # in-flight (job, stage, partition, attempt) set for zombie-task
+        # reconciliation; idle executors omit the key (wire-silent)
+        if running:
+            payload["running"] = [list(t) for t in running]
         self._call("heartbeat", payload)
 
     def update_task_status(self, executor_id: str,
@@ -359,8 +364,15 @@ class ExecutorServer:
         while not self._stop.wait(self.janitor_interval_s):
             try:
                 now = time.time()
+                live = self.executor.active_job_ids()
                 for entry in os.scandir(self.work_dir):
                     if not entry.is_dir():
+                        continue
+                    if entry.name in live:
+                        # a job with a task RUNNING here is alive whatever
+                        # its files' mtimes say — a long-running producer
+                        # that wrote stage 1 output hours ago must not
+                        # lose it mid-query to the TTL scan
                         continue
                     newest = entry.stat().st_mtime
                     for root, _dirs, files in os.walk(entry.path):
@@ -543,12 +555,16 @@ class ExecutorServer:
             # degrades this executor's offer ordering with it, and the
             # fleet-wide floor feeds admission shed
             pressure = self.executor.governor.pressure()
+            # in-flight task set: the scheduler diffs it against job truth
+            # and re-issues kills for zombies (lost cancel fanouts)
+            running = self.executor.running_task_ids()
             try:
                 # metadata rides along so a restarted scheduler re-registers
                 # us (reference heart_beat_from_executor, grpc.rs:174-241)
                 self.scheduler.heartbeat(self.metadata.executor_id,
                                          meta=self.metadata,
-                                         pressure=pressure)
+                                         pressure=pressure,
+                                         running=running)
                 self._mark_scheduler_up()
             except Exception:  # noqa: BLE001 — retried next interval
                 self._mark_scheduler_down("heartbeat")
@@ -562,7 +578,8 @@ class ExecutorServer:
                 try:
                     client.heartbeat(self.metadata.executor_id,
                                      meta=self.metadata,
-                                     pressure=pressure)
+                                     pressure=pressure,
+                                     running=running)
                 except Exception:  # noqa: BLE001 — that shard may be dead
                     self._log_throttle.warning(
                         f"heartbeat-{ep[0]}:{ep[1]}",

@@ -75,6 +75,15 @@ KNOWN_SITES = frozenset({
                                     # (raise => spill I/O failure;
                                     # corrupt => flip bytes on disk so the
                                     # read-back CRC must catch it)
+    "scheduler.cancel.fanout",      # scheduler/netservice.py cancel RPCs
+                                    # (drop => simulate the lost cancel
+                                    # that leaves zombie tasks; heartbeat
+                                    # reconciliation must reap them)
+    "executor.task.cancel.checkpoint",  # ops/physical.py cooperative
+                                        # cancellation checkpoint, fires
+                                        # only when a cancel has landed
+                                        # (delay => widen the cancel-vs-
+                                        # completion race window)
 })
 
 ACTIONS = frozenset({"raise", "delay", "drop", "corrupt", "kill"})

@@ -383,6 +383,35 @@ def _global_findings(bundle: Dict) -> List[Dict]:
                       "retriable ResourceExhausted and should back off "
                       "and resubmit",
         })
+    # -- poison-suspect ------------------------------------------------------
+    for ev in bundle.get("journal") or []:
+        if ev.get("kind") != "job.poisoned":
+            continue
+        attrs = ev.get("attrs") or {}
+        evidence = attrs.get("evidence") or {}
+        executors = sorted({eid for per in evidence.values()
+                            for eid in per}) \
+            if isinstance(evidence, dict) else []
+        partitions = sorted(evidence) if isinstance(evidence, dict) else []
+        out.append({
+            "rule": "poison-suspect",
+            "severity": round(float(len(executors) or 1), 3),
+            "summary": "job classified poison: the same partition failed "
+                       f"with equivalent errors on {len(executors)} "
+                       "distinct executor(s) "
+                       f"({', '.join(executors) or 'unknown'}) — the "
+                       "query, not the fleet, is the culprit",
+            "evidence": {"distinct_executors": len(executors),
+                         "executors": executors,
+                         "partitions": partitions,
+                         "per_executor_errors": evidence},
+            "remedy": "inspect the per-executor error signatures above "
+                      "(bad input split, overflow, pathological plan); "
+                      "fix the query/data before resubmitting — retries "
+                      "were abandoned on purpose and no executor was "
+                      "quarantined",
+        })
+        break  # one containment verdict per job
     # -- control-plane churn -----------------------------------------------
     samples = (bundle.get("cluster_history") or {}).get("samples") or []
     lags = [float(s.get("event_loop_lag_s", 0.0) or 0.0) for s in samples]
@@ -426,7 +455,7 @@ def diagnose(bundle: Dict) -> Dict:
         "rules_evaluated": ["partition-skew", "straggler", "retrace-storm",
                             "fusion-missed", "memory-pressure",
                             "shuffle-hotspot", "cache-miss-churn",
-                            "control-plane-churn"],
+                            "control-plane-churn", "poison-suspect"],
     }
     out["text"] = render_diagnosis(out)
     return out

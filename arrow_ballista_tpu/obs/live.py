@@ -26,6 +26,9 @@ evaluation times):
 - ``journal-drops``: standing global alert (``job_id=""``) while
   ``journal_events_dropped_total > 0`` — backpressure must be seen, not
   discovered in ``/api/metrics`` after the fact.
+- ``deadline-burn``: a deadlined job consumed 80% of its
+  ``ballista.query.deadline.seconds`` budget with unresolved stages —
+  the deadline reaper will cancel it; act before it does.
 
 Cost discipline: the scan thread only exists when
 ``ballista.live.enabled`` is on with a positive interval; each scan is
@@ -48,7 +51,8 @@ from .stats import nearest_rank_quantile, stage_summary
 #: rules the live scanner evaluates (the budget cap: the full catalog's
 #: retrace/fusion/cache rules stay post-hoc)
 LIVE_RULES = ("straggler", "partition-skew", "shuffle-hotspot",
-              "memory-pressure", "control-plane-churn", "journal-drops")
+              "memory-pressure", "control-plane-churn", "journal-drops",
+              "deadline-burn")
 #: consecutive tripping scans before an alert raises
 RAISE_AFTER = 1
 #: consecutive clean scans before a standing alert clears
@@ -86,6 +90,46 @@ def _live_straggler(graph, now: float) -> List[Dict]:
                       "executor's journal events",
         })
     return out
+
+
+#: fraction of the deadline budget consumed before deadline-burn raises
+_DEADLINE_BURN_FRACTION = 0.8
+
+
+def _live_deadline_burn(graph) -> List[Dict]:
+    """A deadlined job past 80% of its budget with unresolved stages: the
+    server-side deadline reaper WILL cancel it — surface the burn while an
+    operator can still act (kill it cleanly, raise the deadline, add
+    capacity).  Wall clock on purpose: ``deadline_ts`` is absolute and
+    survives failover, so the alert is correct on an adopting shard too."""
+    deadline_ts = getattr(graph, "deadline_ts", 0.0)
+    deadline_s = getattr(graph, "deadline_s", 0.0)
+    if not deadline_ts or deadline_s <= 0:
+        return []
+    wall = time.time()
+    consumed = deadline_s - (deadline_ts - wall)
+    if consumed < _DEADLINE_BURN_FRACTION * deadline_s:
+        return []
+    unresolved = [sid for sid in sorted(graph.stages)
+                  if graph.stages[sid].state != "successful"]
+    if not unresolved:
+        return []  # all stages done: only result capture remains
+    remaining = max(0.0, deadline_ts - wall)
+    return [{
+        "rule": "deadline-burn",
+        "severity": round(consumed / deadline_s, 3),
+        "summary": f"{consumed:.1f}s of the {deadline_s:.1f}s deadline "
+                   f"consumed ({remaining:.1f}s left) with "
+                   f"{len(unresolved)} unresolved stage(s)",
+        "evidence": {"deadline_s": round(deadline_s, 3),
+                     "consumed_s": round(consumed, 3),
+                     "remaining_s": round(remaining, 3),
+                     "unresolved_stages": unresolved},
+        "remedy": "raise ballista.query.deadline.seconds (session or "
+                  "per-submit), add executor capacity, or cancel the job "
+                  "now to stop burning slots on a query that will be "
+                  "deadline-cancelled anyway",
+    }]
 
 
 class LiveDoctor:
@@ -139,6 +183,7 @@ class LiveDoctor:
         findings = [f for f in _stage_findings(bundle) + _global_findings(bundle)
                     if f["rule"] in LIVE_RULES]
         findings.extend(_live_straggler(graph, now))
+        findings.extend(_live_deadline_burn(graph))
         return findings
 
     def _fold(self, job_id: str, findings: List[Dict]) -> None:

@@ -216,6 +216,61 @@ def _span_and_device(span_cm, op):
         yield
 
 
+# --------------------------------------------------------------------------
+# cooperative cancellation token (query lifecycle guardrails)
+# --------------------------------------------------------------------------
+#
+# The executor's task wrapper installs a CancelToken in thread-local
+# storage around each task run; cancel/deadline fanout flips the token.
+# ``TaskContext.check_cancelled`` (and the free function ``checkpoint()``
+# for code paths with no ctx handle, e.g. between fused-kernel
+# invocations) consult it in addition to the wired probe, so a cancel
+# lands at the next batch boundary even in contexts constructed without a
+# probe.  Cost when unset: one thread-local attribute read.
+
+class CancelToken:
+    """One task attempt's cancel flag.  Plain bool write/read — flips are
+    idempotent and the reader tolerates staleness by one batch."""
+
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+_CANCEL_TLS = threading.local()
+
+
+def install_cancel_token(token: Optional[CancelToken]) -> None:
+    """Bind ``token`` to the calling thread (None uninstalls).  Called by
+    the executor's task wrapper around each task run."""
+    _CANCEL_TLS.token = token
+
+
+def current_cancel_token() -> Optional[CancelToken]:
+    return getattr(_CANCEL_TLS, "token", None)
+
+
+def checkpoint(job_id: str = "") -> None:
+    """Module-level cancellation checkpoint: raises CancelledError when
+    the calling thread's installed token has been cancelled.  A no-op
+    (one thread-local read) when no token is installed — library code may
+    call it unconditionally."""
+    token = getattr(_CANCEL_TLS, "token", None)
+    if token is not None and token.cancelled:
+        from .. import faults
+        from ..utils.errors import CancelledError
+
+        # delay failpoint: widen the window between the flag flip and the
+        # raise so chaos tests can race cancellation against completion
+        faults.inject("executor.task.cancel.checkpoint", job_id=job_id)
+        raise CancelledError(f"job {job_id} cancelled" if job_id
+                             else "task cancelled")
+
+
 @dataclasses.dataclass
 class TaskContext:
     config: BallistaConfig = dataclasses.field(default_factory=BallistaConfig)
@@ -243,9 +298,23 @@ class TaskContext:
     governor: Optional[object] = None
 
     def check_cancelled(self) -> None:
-        if self.cancelled is not None and self.cancelled():
+        # thread-local token first: it covers contexts constructed without
+        # a wired probe (subplan execution, fused-kernel interiors) and is
+        # one attribute read when no token is installed
+        token = getattr(_CANCEL_TLS, "token", None)
+        if token is not None and token.cancelled:
+            from .. import faults
             from ..utils.errors import CancelledError
 
+            faults.inject("executor.task.cancel.checkpoint",
+                          job_id=self.job_id, stage_id=self.stage_id)
+            raise CancelledError(f"job {self.job_id} cancelled")
+        if self.cancelled is not None and self.cancelled():
+            from .. import faults
+            from ..utils.errors import CancelledError
+
+            faults.inject("executor.task.cancel.checkpoint",
+                          job_id=self.job_id, stage_id=self.stage_id)
             raise CancelledError(f"job {self.job_id} cancelled")
 
     def op_span(self, op):

@@ -104,6 +104,12 @@ class ExecutionStage:
         self.task_attempts: List[int] = [0] * self.partitions
         # partition -> in-flight speculative duplicate of a straggling task
         self.speculative_tasks: Dict[int, TaskInfo] = {}
+        # partition -> executors where it failed retryably (this stage
+        # attempt): retry anti-affinity steers the next attempt to a FRESH
+        # executor when one is alive, so a task-level fault either clears
+        # (executor was sick) or accumulates the distinct-executor evidence
+        # poison containment needs (query is sick)
+        self.failed_on: Dict[int, set] = {}
         # completed-attempt durations (s), the speculation-policy baseline
         self.durations: List[float] = []
         # append-only per-attempt history for /api/job/<id> (survives
@@ -311,6 +317,7 @@ class ExecutionStage:
             self._orig_partitions = None
         self.task_infos = [None] * self.partitions
         self.speculative_tasks.clear()
+        self.failed_on.clear()
         self.outputs.clear()
         self.stage_attempt += 1
         if count_failure:
@@ -364,6 +371,12 @@ class ExecutionGraph:
         self.status = "running"
         self.error = ""
         self.scalars: Dict[str, object] = {}
+        # server-side deadline (ballista.query.deadline.seconds): absolute
+        # wall-clock expiry + the configured budget, stamped at planning
+        # from the submitter's clock and checkpointed so an adopting shard
+        # keeps enforcing the original deadline.  0.0 = no deadline.
+        self.deadline_ts = 0.0
+        self.deadline_s = 0.0
         # trace propagation context handed to every task of this job
         # ({"trace_id", "span_id"}; empty when tracing is off)
         self.trace: Dict[str, str] = {}
@@ -466,18 +479,28 @@ class ExecutionGraph:
             return 0
         return sum(len(s.pending_partitions()) for s in self.stages.values())
 
-    def pop_next_task(self, executor_id: str) -> Optional[TaskDescription]:
-        """Hand out one pending task (reference execution_graph.rs:834-935)."""
+    def pop_next_task(self, executor_id: str,
+                      alive: Optional[set] = None) -> Optional[TaskDescription]:
+        """Hand out one pending task (reference execution_graph.rs:834-935).
+
+        ``alive``: the scheduler's current alive+healthy executor set,
+        enabling retry anti-affinity — a partition that already failed
+        retryably on ``executor_id`` is skipped HERE as long as some other
+        alive executor could still take it (no deadlock: when every alive
+        executor has failed it, anyone may retry it and the failure budget
+        decides).  ``alive=None`` (tests, direct drivers) disables the
+        steering."""
         if self.status != "running":
             return None
         for stage in sorted(self.stages.values(), key=lambda s: s.stage_id):
-            pending = stage.pending_partitions()
-            if not pending:
-                continue
-            p = pending[0]
-            info = stage.new_attempt(p, executor_id)
-            stage.task_infos[p] = info
-            return self._describe(stage, info)
+            for p in stage.pending_partitions():
+                failed_on = stage.failed_on.get(p)
+                if (failed_on and executor_id in failed_on
+                        and alive is not None and (alive - failed_on)):
+                    continue  # steer this retry toward a fresh executor
+                info = stage.new_attempt(p, executor_id)
+                stage.task_infos[p] = info
+                return self._describe(stage, info)
         return None
 
     def _describe(self, stage: ExecutionStage, info: TaskInfo) -> TaskDescription:
@@ -668,6 +691,11 @@ class ExecutionGraph:
                 f"task {st.task.job_id}/{stage.stage_id}/{p} failed "
                 f"{TASK_MAX_FAILURES} times: {reason.message}", events)
             return
+        # remember WHERE it failed so the retry steers to a fresh executor
+        # (and poison containment can count distinct witnesses)
+        eid = st.executor_id or (info.executor_id if info is not None else "")
+        if eid:
+            stage.failed_on.setdefault(p, set()).add(eid)
         if spec is not None:
             # the original died but a speculative duplicate is in flight:
             # promote it to primary instead of launching a third attempt

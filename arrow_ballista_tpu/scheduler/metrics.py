@@ -79,6 +79,11 @@ class SchedulerMetricsCollector:
     # in-flight alerts and per-window SLO burn-rate gauges
     def set_alerts_active(self, value: int) -> None: ...
     def set_slo_burn_rate(self, window: str, value: float) -> None: ...
+    # query lifecycle guardrails (server-side deadlines, poison-query
+    # containment, zombie-task reconciliation)
+    def record_deadline_exceeded(self, job_id: str) -> None: ...
+    def record_poisoned(self, job_id: str) -> None: ...
+    def record_zombies_reaped(self, n: int) -> None: ...
     def gather(self) -> str:
         return ""
 
@@ -125,6 +130,12 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.alerts_active = 0
         # burn window name ("fast"/"slow") -> most recent burn rate
         self.slo_burn_rate: Dict[str, float] = {}
+        # query lifecycle guardrails: both deadline/poison verdicts ALSO
+        # count in `failed` (they publish a failed terminal status); these
+        # break the failure total down by cause
+        self.deadline_exceeded = 0
+        self.poisoned = 0
+        self.zombies_reaped = 0
         # fleet-wide device-observatory fold (TaskStatus.device_stats
         # intake): counters sum across every task the fleet absorbed,
         # watermarks keep the max any single task reported
@@ -266,6 +277,18 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.slo_burn_rate[str(window)] = float(value)
 
+    def record_deadline_exceeded(self, job_id):
+        with self._lock:
+            self.deadline_exceeded += 1
+
+    def record_poisoned(self, job_id):
+        with self._lock:
+            self.poisoned += 1
+
+    def record_zombies_reaped(self, n):
+        with self._lock:
+            self.zombies_reaped += n
+
     def counters_snapshot(self) -> Dict[str, float]:
         """Plain-dict view of the scalar counters/gauges (the forensics
         bundle embeds this so the doctor's cache/churn rules read metric
@@ -297,6 +320,9 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                 "journal_events": self.journal_events,
                 "journal_dropped": self.journal_dropped,
                 "alerts_active": self.alerts_active,
+                "jobs_deadline_exceeded_total": self.deadline_exceeded,
+                "jobs_poisoned_total": self.poisoned,
+                "zombie_tasks_reaped_total": self.zombies_reaped,
                 **{f"slo_burn_rate_{w}": v
                    for w, v in sorted(self.slo_burn_rate.items())},
             }
@@ -365,6 +391,19 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             counter("journal_events_dropped_total", self.journal_dropped,
                     "flight-recorder events evicted from the bounded "
                     "journal ring or a per-job timeline at capacity")
+            counter("jobs_deadline_exceeded_total", self.deadline_exceeded,
+                    "jobs cancelled fleet-wide because they exceeded their "
+                    "server-side ballista.query.deadline.seconds budget "
+                    "(also counted in job_failed_total)")
+            counter("jobs_poisoned_total", self.poisoned,
+                    "jobs failed fast by poison-query containment: the "
+                    "same partition failed with equivalent errors on "
+                    "ballista.poison.distinct_executors distinct executors "
+                    "(also counted in job_failed_total)")
+            counter("zombie_tasks_reaped_total", self.zombies_reaped,
+                    "running tasks reported on executor heartbeats whose "
+                    "job was already terminal or unknown — the scheduler "
+                    "re-issued the kill the original cancel fanout lost")
             counter("fleet_device_jit_compiles_total",
                     self.device_jit_compiles,
                     "first-time XLA compilations reported by completed "

@@ -153,14 +153,24 @@ class NetTaskLauncher(TaskLauncher):
                             policy=self.policy)
 
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
+        if faults.dropped("scheduler.cancel.fanout",
+                          executor_id=executor_id, job_id=job_id):
+            # chaos: simulate the lost cancel RPC this method otherwise
+            # swallows below — heartbeat zombie reconciliation must reap
+            return
         try:
             host, port = self._addr(executor_id)
             call_with_retry(host, port, "cancel_tasks", {"job_id": job_id},
                             policy=self.policy)
-        except Exception:  # noqa: BLE001 — best effort
+        except Exception:  # noqa: BLE001 — best effort: delivery failures
+            # are logged and swallowed; the executor's heartbeat `running`
+            # set lets the scheduler re-issue the kill (zombie reaping)
             log.warning("cancel_tasks on %s failed", executor_id, exc_info=True)
 
     def cancel_task(self, executor_id: str, task) -> None:
+        if faults.dropped("scheduler.cancel.fanout",
+                          executor_id=executor_id, job_id=task.job_id):
+            return
         try:
             host, port = self._addr(executor_id)
             call_with_retry(host, port, "cancel_task",
@@ -215,8 +225,10 @@ class SchedulerNetService:
                 FLEET_REGISTRY_STALE_S,
                 LIVE_DOCTOR_INTERVAL_S,
                 LIVE_ENABLED,
+                POISON_DISTINCT_EXECUTORS,
                 QUARANTINE_FAILURES,
                 QUARANTINE_PROBATION_S,
+                QUERY_DEADLINE_S,
                 SLO_P99_TARGET_MS,
                 SLO_WINDOW_S,
                 SPECULATION_ENABLED,
@@ -258,7 +270,10 @@ class SchedulerNetService:
                 live_doctor_interval_s=float(
                     self.config.get(LIVE_DOCTOR_INTERVAL_S)),
                 slo_p99_target_ms=float(self.config.get(SLO_P99_TARGET_MS)),
-                slo_window_s=float(self.config.get(SLO_WINDOW_S)))
+                slo_window_s=float(self.config.get(SLO_WINDOW_S)),
+                query_deadline_s=float(self.config.get(QUERY_DEADLINE_S)),
+                poison_distinct_executors=int(
+                    self.config.get(POISON_DISTINCT_EXECUTORS)))
         self.catalog = SchemaCatalog()
         launcher = NetTaskLauncher(RetryPolicy.from_config(self.config))
         job_backend = None
@@ -654,7 +669,8 @@ class SchedulerNetService:
         self.server.heartbeat(ExecutorHeartbeat(
             payload["executor_id"], status=payload.get("status", "active"),
             metadata=serde.executor_metadata_from_obj(meta) if meta else None,
-            memory_pressure=float(payload.get("memory_pressure", 0.0))))
+            memory_pressure=float(payload.get("memory_pressure", 0.0)),
+            running=[tuple(t) for t in payload.get("running", [])]))
         return {}, b""
 
     def _update_task_status(self, payload: dict, _bin: bytes):

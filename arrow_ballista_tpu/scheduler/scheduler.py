@@ -37,7 +37,9 @@ from .execution_graph import ExecutionGraph
 from .quarantine import ExecutorQuarantine
 from .speculation import SpeculationPolicy, find_candidates
 from .types import (
+    DEADLINE_EXCEEDED,
     FETCH_PARTITION_ERROR,
+    POISON_QUERY,
     RESOURCE_EXHAUSTED,
     ExecutorHeartbeat,
     ExecutorMetadata,
@@ -116,6 +118,15 @@ class JobCancel:
 
 
 @dataclasses.dataclass
+class JobDeadline:
+    """Posted by the deadline scan thread when a job's wall clock expired;
+    the handler re-checks on the event loop (scan and completion race) and
+    fails the job with the DeadlineExceeded terminal status."""
+
+    job_id: str
+
+
+@dataclasses.dataclass
 class Offer:
     """Try to hand out tasks (reference ReservationOffering)."""
 
@@ -164,7 +175,10 @@ class SchedulerConfig:
                  live_doctor_interval_s: Optional[float] = None,
                  slo_p99_target_ms: Optional[float] = None,
                  slo_window_s: Optional[float] = None,
-                 memory_shed_threshold: Optional[float] = None):
+                 memory_shed_threshold: Optional[float] = None,
+                 query_deadline_s: Optional[float] = None,
+                 poison_distinct_executors: Optional[int] = None,
+                 deadline_scan_interval_s: float = 1.0):
         from ..utils.config import (BallistaConfig,
                                     CLUSTER_EXECUTOR_TIMEOUT_S,
                                     FLEET_ADOPT_INTERVAL_S,
@@ -174,8 +188,10 @@ class SchedulerConfig:
                                     LIVE_DOCTOR_INTERVAL_S,
                                     LIVE_ENABLED,
                                     MEM_PRESSURE_SHED,
+                                    POISON_DISTINCT_EXECUTORS,
                                     QUARANTINE_FAILURES,
                                     QUARANTINE_PROBATION_S,
+                                    QUERY_DEADLINE_S,
                                     SLO_P99_TARGET_MS,
                                     SLO_WINDOW_S,
                                     SPECULATION_ENABLED,
@@ -279,6 +295,17 @@ class SchedulerConfig:
         self.memory_shed_threshold = float(
             memory_shed_threshold if memory_shed_threshold is not None
             else defaults.get(MEM_PRESSURE_SHED))
+        # query lifecycle guardrails (ballista.query.* / ballista.poison.*):
+        # scheduler-wide deadline default (a job's session/per-submit config
+        # overrides it), the distinct-executor threshold for poison
+        # classification, and the deadline scan cadence
+        self.query_deadline_s = float(
+            query_deadline_s if query_deadline_s is not None
+            else defaults.get(QUERY_DEADLINE_S))
+        self.poison_distinct_executors = int(
+            poison_distinct_executors if poison_distinct_executors is not None
+            else defaults.get(POISON_DISTINCT_EXECUTORS))
+        self.deadline_scan_interval_s = float(deadline_scan_interval_s)
 
 
 class SchedulerServer:
@@ -381,6 +408,7 @@ class SchedulerServer:
         self._lease_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
         self._adopt_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
         self._live_doctor_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
+        self._deadline_thread: Optional[threading.Thread] = None  # ballista: guarded-by=none
         # live observability plane: in-flight doctor state machine (scan
         # thread starts in init() only when ballista.live.enabled) and the
         # latency-SLO tracker (null object when no target is configured)
@@ -406,6 +434,12 @@ class SchedulerServer:
         self.quarantine = ExecutorQuarantine(
             threshold=self.config.quarantine_failures,
             probation_s=self.config.quarantine_probation_s)
+        # poison-query containment: (job, stage, partition) -> per-executor
+        # failure signatures, plus jobs whose classification completed this
+        # intake round.  Event-loop-only state (written by
+        # _record_quarantine_signals, drained by _absorb_statuses)
+        self._poison_evidence: Dict[Tuple[str, int, int], Dict[str, str]] = {}
+        self._poison_suspects: set = set()
         # admission gate between submit_job and JobQueued planning; with no
         # ballista.admission.* limits configured this is pass-through
         self.admission = AdmissionController(
@@ -427,6 +461,13 @@ class SchedulerServer:
             self._reaper = threading.Thread(target=self._reap_loop,
                                             name="executor-reaper", daemon=True)
             self._reaper.start()
+            if self.config.deadline_scan_interval_s > 0:
+                # finer-grained than the executor reaper: a deadline must
+                # land within seconds of expiry, not a reaper interval
+                self._deadline_thread = threading.Thread(
+                    target=self._deadline_loop, name="deadline-reaper",
+                    daemon=True)
+                self._deadline_thread.start()
         if self.config.speculation.enabled:
             self._spec_monitor = threading.Thread(
                 target=self._speculation_loop, name="speculation-monitor",
@@ -477,6 +518,8 @@ class SchedulerServer:
             self._adopt_thread.join(timeout=5.0)
         if self._live_doctor_thread is not None:
             self._live_doctor_thread.join(timeout=5.0)
+        if self._deadline_thread is not None:
+            self._deadline_thread.join(timeout=5.0)
         # clean shutdown deliberately does NOT release job leases: a
         # shard stopping mid-job should look exactly like a crash so a
         # sibling adopts its jobs after one TTL.  Only the registry entry
@@ -529,6 +572,54 @@ class SchedulerServer:
                 self.cluster.save_heartbeat(hb)
             else:
                 log.info("heartbeat from unknown executor %s", hb.executor_id)
+        if hb.running:
+            # zombie-task reconciliation: the executor's in-flight set is
+            # ground truth for "still burning cycles"; diff it against the
+            # scheduler's job states and re-issue kills for tasks whose job
+            # is terminal or unknown (closes the lost-cancel-RPC leak —
+            # NetTaskLauncher.cancel_tasks logs and swallows delivery
+            # failures, so without this a dropped fanout leaks the task
+            # until it finishes on its own)
+            self._reconcile_running(hb.executor_id, hb.running)
+
+    def _reconcile_running(self, executor_id: str,
+                           running: List[tuple]) -> None:
+        by_job: Dict[str, int] = {}
+        for entry in running:
+            job_id = entry[0]
+            by_job[job_id] = by_job.get(job_id, 0) + 1
+        reaped = 0
+        for job_id, count in sorted(by_job.items()):
+            if not self._job_is_zombie(job_id):
+                continue
+            reaped += count
+            log.warning("reaping %d zombie task(s) of job %s on %s",
+                        count, job_id, executor_id)
+            if journal.enabled():
+                journal.emit_job("zombie.reaped", job_id,
+                                 executor_id=executor_id, tasks=str(count))
+            self._submit_work(self.launcher.cancel_tasks, executor_id, job_id)
+        if reaped:
+            self.metrics.record_zombies_reaped(reaped)
+
+    def _job_is_zombie(self, job_id: str) -> bool:
+        """A running task is a zombie when its job can no longer use the
+        result: the job is terminal here, or nobody in the fleet knows it."""
+        st = self.jobs.get_status(job_id)
+        if st is not None:
+            return st.state in ("successful", "failed", "cancelled")
+        # unknown locally: in a fleet another shard may own the job, so
+        # consult the shared backend before declaring it dead
+        if self.job_backend is not None:
+            try:
+                obj = self.job_backend.load_job(job_id)
+            except Exception:  # noqa: BLE001 — backend hiccup
+                log.warning("zombie check: job backend load failed for %s"
+                            " — sparing the task", job_id, exc_info=True)
+                return False  # don't kill on bad data
+            if obj is not None:
+                return False  # some shard still tracks it
+        return True
 
     def executor_stopped(self, executor_id: str, reason: str = "") -> None:
         self._event_loop.post(ExecutorLost(executor_id, reason))
@@ -686,6 +777,8 @@ class SchedulerServer:
             self._on_executor_lost(event)
         elif isinstance(event, JobCancel):
             self._on_job_cancel(event)
+        elif isinstance(event, JobDeadline):
+            self._on_job_deadline(event)
         elif isinstance(event, Offer):
             self._offer()
         elif isinstance(event, SpeculationTick):
@@ -731,6 +824,23 @@ class SchedulerServer:
                 fuse_resolved_stages(graph)
                 graph.scalars = scalars
                 graph.addr_resolver = self._resolve_addr
+                # server-side deadline: a positive session/per-submit
+                # ballista.query.deadline.seconds overrides the scheduler
+                # default; the clock runs from SUBMISSION (queued time
+                # counts), and the absolute expiry rides the checkpoint
+                deadline_s = self.config.query_deadline_s
+                if cfg is not None:
+                    from ..utils.config import QUERY_DEADLINE_S
+
+                    v = float(cfg.get(QUERY_DEADLINE_S))
+                    if v > 0:
+                        deadline_s = v
+                if deadline_s > 0:
+                    with self._meta_lock:
+                        queued_at = self._queued_at_ms.get(ev.job_id, 0)
+                    start = queued_at / 1000.0 if queued_at else time.time()
+                    graph.deadline_s = deadline_s
+                    graph.deadline_ts = start + deadline_s
                 if serving is not None and serving.subplan:
                     self._preload_subplans(graph, serving)
                 self._event_loop.post(JobPlanned(ev.job_id, graph))
@@ -1142,6 +1252,7 @@ class SchedulerServer:
         self.metrics.record_cancelled(ev.job_id)
         with self._meta_lock:
             self._queued_at_ms.pop(ev.job_id, None)
+        self._drop_poison_evidence(ev.job_id)
         self._cancel_running(graph)
         self._schedule_job_data_cleanup(graph)
 
@@ -1154,8 +1265,16 @@ class SchedulerServer:
         delay = self.config.job_data_cleanup_delay_s
         if delay < 0 or self._stopped.is_set():
             return
-        executors = sorted({eid for stage in graph.stages.values()
-                            for (eid, _w) in stage.outputs.values()})
+        executors = {eid for stage in graph.stages.values()
+                     for (eid, _w) in stage.outputs.values()}
+        status = self.jobs.get_status(graph.job_id)
+        if status is None or status.state != "successful":
+            # a cancelled/expired/poisoned job can have stalled tasks that
+            # wake AFTER the terminal verdict and write shuffle files no
+            # stage ever registered — fan the remove to the whole fleet,
+            # not just the executors with recorded outputs
+            executors |= {m.executor_id for m in self.cluster.executors()}
+        executors = sorted(executors)
         if not executors:
             return
         job_id = graph.job_id
@@ -1221,6 +1340,9 @@ class SchedulerServer:
             if self.quarantine.is_quarantined(ev.executor_id):
                 return  # reply with no tasks (finally still runs)
             graphs = self.jobs.active_graphs()
+            # retry anti-affinity context (see pop_next_task)
+            alive = set(self.quarantine.filter(
+                self.cluster.alive_executors(self.config.executor_timeout_s)))
             gate = self.admission.slot_gate(
                 lambda: {g.job_id: len(g.running_tasks()) for g in graphs})
             while len(tasks) < ev.num_free_slots:
@@ -1228,7 +1350,7 @@ class SchedulerServer:
                 for graph in graphs:
                     if gate is not None and not gate.allows(graph.job_id):
                         continue
-                    task = graph.pop_next_task(ev.executor_id)
+                    task = graph.pop_next_task(ev.executor_id, alive=alive)
                     if task is not None:
                         if gate is not None:
                             gate.took(graph.job_id)
@@ -1269,6 +1391,17 @@ class SchedulerServer:
         for job_id, sts in by_job.items():
             graph = self.jobs.get_graph(job_id)
             if graph is None:
+                continue
+            if job_id in self._poison_suspects:
+                # containment beats retry: fail the job NOW, before the
+                # graph's retry bookkeeping re-launches the poison
+                # partition and burns another executor's slot
+                self._poison_suspects.discard(job_id)
+                try:
+                    self._fail_poisoned(job_id, graph)
+                except Exception:  # noqa: BLE001 — scope the blast radius
+                    log.exception("poison containment crashed for job %s",
+                                  job_id)
                 continue
             try:
                 self._absorb_job_statuses(job_id, graph, sts)
@@ -1343,6 +1476,12 @@ class SchedulerServer:
                                      reason="corrupt shuffle data")
             elif (st.state == "failed" and st.failure is not None
                   and st.failure.retryable):
+                if self._note_poison_evidence(eid, st):
+                    # a DIFFERENT executor already failed this exact
+                    # partition the same way: the evidence points at the
+                    # query, not this host — corroborating failures carry
+                    # no quarantine strike
+                    continue
                 if self.quarantine.record_failure(eid):
                     log.warning(
                         "executor %s quarantined after %d consecutive "
@@ -1356,6 +1495,98 @@ class SchedulerServer:
                                      executor_id=eid,
                                      reason="consecutive retryable failures")
         self.metrics.set_quarantined_executors(self.quarantine.count())
+
+    # --- poison-query containment ----------------------------------------
+    def _note_poison_evidence(self, eid: str, st: TaskStatus) -> bool:
+        """Record one retryable failure as poison evidence.  Returns True
+        when the quarantine strike should be SUPPRESSED because another
+        executor already failed the same partition with an equivalent
+        error (the query is the prime suspect, not this host).  Once the
+        same signature lands on ``poison_distinct_executors`` distinct
+        non-quarantined executors, the job is queued for containment.
+        Event-loop only (push TaskUpdating and pull PollWork both absorb
+        on the loop)."""
+        k = self.config.poison_distinct_executors
+        if k <= 0 or st.failure is None:
+            return False
+        key = (st.task.job_id, st.task.stage_id, st.task.partition)
+        sig = f"{st.failure.kind}: {st.failure.message[:160]}"
+        ev = self._poison_evidence.setdefault(key, {})
+        corroborated = any(e != eid and s == sig for e, (s, _w) in ev.items())
+        # a witness counts if it was healthy when it FIRST testified —
+        # judged at record time, because the poison query's own strikes
+        # may quarantine an executor before the Kth failure lands, and a
+        # host the query itself knocked out is still a valid witness
+        if eid in ev:
+            ev[eid] = (sig, ev[eid][1])
+        else:
+            ev[eid] = (sig, not self.quarantine.is_quarantined(eid))
+        distinct = {e for e, (s, w) in ev.items() if s == sig and w}
+        if len(distinct) >= k:
+            self._poison_suspects.add(st.task.job_id)
+        return corroborated
+
+    def _drop_poison_evidence(self, job_id: str) -> None:
+        """Forget a terminal job's poison bookkeeping (event-loop only)."""
+        self._poison_suspects.discard(job_id)
+        for key in [k for k in self._poison_evidence if k[0] == job_id]:
+            del self._poison_evidence[key]
+
+    def _fail_poisoned(self, job_id: str, graph) -> None:
+        """Containment: the same partition failed with equivalent errors on
+        K distinct executors — the query is the culprit.  Fail it
+        immediately (skipping the per-task retry budget), refund every
+        implicated executor's quarantine streak, and attach a forensics
+        bundle so the failure is diagnosable post-mortem."""
+        if graph.status != "running":
+            self._drop_poison_evidence(job_id)
+            return
+        k = self.config.poison_distinct_executors
+        evidence: Dict[str, Dict[str, str]] = {}
+        implicated = set()
+        for (jid, sid, p), ev in self._poison_evidence.items():
+            if jid != job_id:
+                continue
+            evidence[f"{sid}/{p}"] = {e: s for e, (s, _w) in ev.items()}
+            implicated.update(ev)
+        # zero quarantine strikes: the poison query burned healthy hosts,
+        # so wipe the streaks it charged them (forced poison queries must
+        # end with an empty quarantine set)
+        for eid in sorted(implicated):
+            self.quarantine.record_success(eid)
+        self.metrics.set_quarantined_executors(self.quarantine.count())
+        message = (f"{POISON_QUERY}: same partition failed with equivalent "
+                   f"errors on {k}+ distinct executors — job classified "
+                   f"poison, retries abandoned")
+        if journal.enabled():
+            # before the checkpoint, so the terminal event (with its
+            # per-executor evidence) rides the persisted timeline
+            journal.emit_job("job.poisoned", job_id,
+                             distinct_executors=str(k),
+                             evidence=evidence)
+        graph.status = "failed"
+        graph.error = message
+        with self._meta_lock:
+            queued_at = self._queued_at_ms.pop(job_id, None)
+        self._drop_poison_evidence(job_id)
+        if not self._checkpoint(graph):
+            return  # lease lost: the adopter owns this job now
+        self.jobs.set_status(JobStatus(job_id, "failed", error=message,
+                                       retriable=False))
+        self.metrics.record_failed(job_id)
+        self.metrics.record_poisoned(job_id)
+        self.slo.record(
+            int(time.time() * 1000) - queued_at if queued_at else 0.0,
+            ok=False)
+        log.warning("job %s classified poison: %s", job_id, message)
+        self._cancel_running(graph)
+        self._schedule_job_data_cleanup(graph)
+        try:
+            from ..obs.doctor import assemble_forensics
+            graph.forensics = assemble_forensics(self, job_id)
+        except Exception:  # noqa: BLE001 — forensics are best-effort
+            log.warning("forensics assembly failed for %s", job_id,
+                        exc_info=True)
 
     def _absorb_job_statuses(self, job_id: str, graph,
                              sts: List[TaskStatus]) -> None:
@@ -1408,6 +1639,7 @@ class SchedulerServer:
                     # SLO sample: queue-to-done wall time, the latency a
                     # waiting client observed (no-op on the null tracker)
                     self.slo.record(done_ms - queued_at, ok=True)
+                self._drop_poison_evidence(job_id)
                 self._schedule_job_data_cleanup(graph)
             elif kind == "job_failed":
                 if journal.enabled():
@@ -1425,6 +1657,7 @@ class SchedulerServer:
                 self.slo.record(
                     int(time.time() * 1000) - queued_at if queued_at else 0.0,
                     ok=False)
+                self._drop_poison_evidence(job_id)
                 self._cancel_running(graph)
                 self._schedule_job_data_cleanup(graph)
         self._drain_aqe_events(graph)
@@ -1489,22 +1722,43 @@ class SchedulerServer:
             graphs = [g for g in graphs if g.job_id in owned]
         gate = self.admission.slot_gate(
             lambda: {g.job_id: len(g.running_tasks()) for g in graphs})
-        for r in reservations:
-            task = None
-            for graph in graphs:
-                if gate is not None and not gate.allows(graph.job_id):
-                    continue
-                task = graph.pop_next_task(r.executor_id)
-                if task is not None:
-                    if gate is not None:
-                        gate.took(graph.job_id)
-                    break
-            if task is None:
-                unused.append(r)
-            else:
-                assignments.setdefault(r.executor_id, []).append(task)
+
+        def fill(rs: List[ExecutorReservation]) -> List[ExecutorReservation]:
+            leftovers: List[ExecutorReservation] = []
+            for r in rs:
+                task = None
+                for graph in graphs:
+                    if gate is not None and not gate.allows(graph.job_id):
+                        continue
+                    task = graph.pop_next_task(r.executor_id, alive=alive)
+                    if task is not None:
+                        if gate is not None:
+                            gate.took(graph.job_id)
+                        break
+                if task is None:
+                    leftovers.append(r)
+                else:
+                    assignments.setdefault(r.executor_id, []).append(task)
+            return leftovers
+
+        unused = fill(reservations)
         if unused:
+            # Retry anti-affinity can veto every reserved executor while a
+            # DIFFERENT alive executor could legally run the pending task
+            # (a retried partition is steered away from executors that
+            # already failed it).  Once an idle fleet's offer round comes
+            # up empty no further event re-triggers it, so convert the
+            # veto into a steer with one bounded second pass over the
+            # executors the first reservation round never tried.
+            vetoed = {r.executor_id for r in unused}
             self.cluster.cancel_reservations(unused)
+            unused = []
+            retry_pool = sorted(alive - vetoed)
+            if retry_pool:
+                unused = fill(self.cluster.reserve_slots(
+                    len(vetoed), retry_pool))
+                if unused:
+                    self.cluster.cancel_reservations(unused)
         for executor_id, tasks in assignments.items():
             self._submit_work(self._launch, executor_id, tasks)
 
@@ -1776,3 +2030,51 @@ class SchedulerServer:
         while not self._stopped.wait(self.config.reaper_interval_s):
             for eid in self.cluster.expired_executors(self.config.executor_timeout_s):
                 self._event_loop.post(ExecutorLost(eid, "heartbeat timeout"))
+
+    # --- server-side deadlines -------------------------------------------
+    def _deadline_loop(self) -> None:
+        """Deadline scan: posts JobDeadline for any active job whose
+        absolute expiry passed.  Read-only off the loop — the handler
+        re-checks graph state ON the loop before acting, so a job that
+        finished between scan and dispatch is untouched."""
+        while not self._stopped.wait(self.config.deadline_scan_interval_s):
+            now = time.time()
+            for graph in self.jobs.active_graphs():
+                ts = getattr(graph, "deadline_ts", 0.0)
+                if ts and now >= ts:
+                    self._event_loop.post(JobDeadline(graph.job_id))
+
+    def _on_job_deadline(self, ev: JobDeadline) -> None:
+        graph = self.jobs.get_graph(ev.job_id)
+        if (graph is None or graph.status != "running"
+                or not getattr(graph, "deadline_ts", 0.0)
+                or time.time() < graph.deadline_ts):
+            return  # finished/cancelled in flight, or a stale scan
+        budget = getattr(graph, "deadline_s", 0.0)
+        message = (f"{DEADLINE_EXCEEDED}: job exceeded its "
+                   f"{budget:.1f}s deadline")
+        if journal.enabled():
+            # before the checkpoint, so the terminal event is IN the
+            # persisted timeline
+            journal.emit_job("job.deadline_exceeded", ev.job_id,
+                             deadline_s=f"{budget:.3f}", retriable="false")
+        graph.status = "failed"
+        graph.error = message
+        with self._meta_lock:
+            queued_at = self._queued_at_ms.pop(ev.job_id, None)
+        self._drop_poison_evidence(ev.job_id)
+        # durable before visible: a restarted/adopting scheduler must see
+        # the deadline verdict, never resurrect the job past its budget
+        if not self._checkpoint(graph):
+            return  # lease lost: the adopter owns this job now
+        self.jobs.set_status(JobStatus(ev.job_id, "failed", error=message,
+                                       retriable=False))
+        self.metrics.record_failed(ev.job_id)
+        self.metrics.record_deadline_exceeded(ev.job_id)
+        # a deadline miss always burns SLO budget, whatever its wall time
+        self.slo.record(
+            int(time.time() * 1000) - queued_at if queued_at else 0.0,
+            ok=False)
+        log.warning("job %s cancelled fleet-wide: %s", ev.job_id, message)
+        self._cancel_running(graph)
+        self._schedule_job_data_cleanup(graph)

@@ -45,9 +45,22 @@ class InProcessTaskLauncher(TaskLauncher):
                 lambda st: self.scheduler.update_task_status(executor_id, [st]))
 
     def cancel_tasks(self, executor_id: str, job_id: str) -> None:
+        from .. import faults
+
+        # same lost-cancel failpoint as NetTaskLauncher: the fanout is the
+        # scheduler's to lose whatever the transport — heartbeat zombie
+        # reconciliation must reap whatever this drop leaks
+        if faults.dropped("scheduler.cancel.fanout",
+                          executor_id=executor_id, job_id=job_id):
+            return
         self.executors[executor_id].cancel_job_tasks(job_id)
 
     def cancel_task(self, executor_id: str, task) -> None:
+        from .. import faults
+
+        if faults.dropped("scheduler.cancel.fanout",
+                          executor_id=executor_id, job_id=task.job_id):
+            return
         ex = self.executors.get(executor_id)
         if ex is not None:
             ex.cancel_task(task)
@@ -86,6 +99,8 @@ class StandaloneCluster:
             # via SchedulerNetService)
             from ..utils.config import (LIVE_DOCTOR_INTERVAL_S,
                                         LIVE_ENABLED,
+                                        POISON_DISTINCT_EXECUTORS,
+                                        QUERY_DEADLINE_S,
                                         SLO_P99_TARGET_MS,
                                         SLO_WINDOW_S,
                                         SPECULATION_ENABLED,
@@ -109,7 +124,10 @@ class StandaloneCluster:
                 live_doctor_interval_s=float(
                     self.config.get(LIVE_DOCTOR_INTERVAL_S)),
                 slo_p99_target_ms=float(self.config.get(SLO_P99_TARGET_MS)),
-                slo_window_s=float(self.config.get(SLO_WINDOW_S)))
+                slo_window_s=float(self.config.get(SLO_WINDOW_S)),
+                query_deadline_s=float(self.config.get(QUERY_DEADLINE_S)),
+                poison_distinct_executors=int(
+                    self.config.get(POISON_DISTINCT_EXECUTORS)))
         self.scheduler = SchedulerServer(
             self.launcher, scheduler_config,
             observability=JobObservability.from_config(self.config))
@@ -137,7 +155,8 @@ class StandaloneCluster:
             for ex in self.executors:
                 self.scheduler.heartbeat(ExecutorHeartbeat(
                     ex.metadata.executor_id,
-                    memory_pressure=ex.governor.pressure()))
+                    memory_pressure=ex.governor.pressure(),
+                    running=ex.running_task_ids()))
 
     # --- query execution -------------------------------------------------
     def execute_sql(self, sql_text: str, catalog,

@@ -27,6 +27,11 @@ TASK_KILLED = "TaskKilled"              # cancellation
 # quarantine strike — an executor protecting itself from OOM is healthy
 RESOURCE_EXHAUSTED = "ResourceExhausted"
 
+# distinct terminal error markers (JobStatus.error prefix; the state stays
+# 'failed' so every terminal-tuple consumer keeps working unchanged)
+DEADLINE_EXCEEDED = "DeadlineExceeded"   # server-side deadline enforcement
+POISON_QUERY = "PoisonQuery"             # poison-task containment
+
 
 @dataclasses.dataclass
 class TaskId:
@@ -139,6 +144,11 @@ class ExecutorHeartbeat:
     # past ballista.memory.pressure.shed.threshold, feeds admission shed.
     # 0.0 (the unbudgeted default) is omitted on the wire.
     memory_pressure: float = 0.0
+    # in-flight (job_id, stage_id, partition, task_attempt) tuples on this
+    # executor: the scheduler diffs them against graph truth and re-issues
+    # kills for zombies whose cancel RPC was lost.  Empty (the idle
+    # default) is omitted on the wire.
+    running: List[tuple] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

@@ -475,6 +475,11 @@ def graph_to_obj(graph) -> dict:
             "aqe_rewrites": [dict(r) for r in getattr(s, "aqe_rewrites", [])],
             "fusion_rewrites": [dict(r) for r in
                                 getattr(s, "fusion_rewrites", [])],
+            # retry anti-affinity memory (wire-silent: omitted while empty
+            # so statuses for unaffected jobs stay byte-identical)
+            **({"failed_on": {str(p): sorted(eids)
+                              for p, eids in s.failed_on.items()}}
+               if getattr(s, "failed_on", None) else {}),
             "successes": {
                 str(p): {"executor_id": ex,
                          "writes": [vars(w) for w in writes]}
@@ -501,6 +506,13 @@ def graph_to_obj(graph) -> dict:
     journal = getattr(graph, "journal", None)
     if journal:
         out["journal"] = [dict(e) for e in journal]
+    # server-side deadline: the ABSOLUTE wall-clock expiry rides the
+    # checkpoint so an adopting shard enforces the submitter's original
+    # clock, not a restarted one.  Keys present only when a deadline is
+    # set (deadline-off checkpoints stay byte-identical to older ones)
+    if getattr(graph, "deadline_ts", 0.0):
+        out["deadline_ts"] = graph.deadline_ts
+        out["deadline_s"] = getattr(graph, "deadline_s", 0.0)
     return out
 
 
@@ -538,12 +550,16 @@ def graph_from_obj(o: dict):
     graph.compile_log = [dict(r) for r in o.get("compile_log", [])]
     graph.trace = dict(o.get("trace", {}))
     graph.journal = [dict(e) for e in o.get("journal", [])]
+    graph.deadline_ts = float(o.get("deadline_ts", 0.0))
+    graph.deadline_s = float(o.get("deadline_s", 0.0))
     for sid, (st, plan_resolved) in meta.items():
         stage = graph.stages[sid]
         stage.state = st["state"]
         stage.stage_attempt = st["stage_attempt"]
         stage.failures = st.get("failures", 0)
         stage.task_failures = list(st["task_failures"])
+        stage.failed_on = {int(p): set(eids) for p, eids in
+                           st.get("failed_on", {}).items()}
         if plan_resolved is not None and stage.state in (RUNNING, SUCCESSFUL):
             stage.resolved_plan = plan_resolved
         # AQE rewrites mutate the live partition count; resume with the
@@ -674,6 +690,10 @@ def executor_heartbeat_to_obj(h: ExecutorHeartbeat) -> dict:
     # peers and idle fleets pay nothing
     if h.memory_pressure:
         out["memory_pressure"] = h.memory_pressure
+    # running-task set (zombie reconciliation): an idle executor omits the
+    # key, keeping the quiescent heartbeat byte-identical to the old wire
+    if h.running:
+        out["running"] = [list(t) for t in h.running]
     return out
 
 
@@ -682,7 +702,8 @@ def executor_heartbeat_from_obj(o: dict) -> ExecutorHeartbeat:
     return ExecutorHeartbeat(
         o["executor_id"], o.get("timestamp", 0.0), o.get("status", "active"),
         executor_metadata_from_obj(meta) if meta else None,
-        memory_pressure=float(o.get("memory_pressure", 0.0)))
+        memory_pressure=float(o.get("memory_pressure", 0.0)),
+        running=[tuple(t) for t in o.get("running", [])])
 
 
 def executor_reservation_to_obj(r: ExecutorReservation) -> dict:

@@ -145,6 +145,10 @@ LIVE_WATCH_POLL_S = "ballista.live.watch.poll.seconds"
 # jobs, multi-window burn rates behind /api/slo and the autoscale signal
 SLO_P99_TARGET_MS = "ballista.slo.latency.p99.target.ms"
 SLO_WINDOW_S = "ballista.slo.window.seconds"
+# query lifecycle guardrails: server-side deadline enforcement and
+# poison-query containment (scheduler/scheduler.py)
+QUERY_DEADLINE_S = "ballista.query.deadline.seconds"
+POISON_DISTINCT_EXECUTORS = "ballista.poison.distinct_executors"
 
 
 @dataclasses.dataclass
@@ -626,6 +630,23 @@ _ENTRIES: Dict[str, ConfigEntry] = {
                     "seconds; the fast window is 1/12 of it (the 1h/5m "
                     "SRE ratio); served at /api/slo and summed into "
                     "/api/autoscale"),
+        ConfigEntry(QUERY_DEADLINE_S, 0.0, float,
+                    "server-side query deadline in seconds, measured from "
+                    "submission: the scheduler fails a job that runs past "
+                    "it with a DeadlineExceeded terminal status and "
+                    "cancels its tasks fleet-wide.  Session-level or "
+                    "per-submit (the per-request config override wins); "
+                    "the absolute expiry rides the job checkpoint, so an "
+                    "adopting shard keeps enforcing the original clock.  "
+                    "0 disables"),
+        ConfigEntry(POISON_DISTINCT_EXECUTORS, 2, int,
+                    "poison-query containment: when the SAME partition "
+                    "fails with equivalent errors on this many distinct "
+                    "non-quarantined executors, the job is classified "
+                    "poison and failed immediately — zero quarantine "
+                    "strikes are charged and the remaining retry budget "
+                    "is skipped, so one bad query can never blacklist "
+                    "the fleet.  0 disables classification"),
     ]
 }
 

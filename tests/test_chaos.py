@@ -1077,3 +1077,199 @@ def test_memory_shed_surfaces_retriable_and_recovers(tmp_path):
         c.shutdown()
     finally:
         _teardown(sched, executors)
+
+
+# --------------------------------------------------------------------------
+# query lifecycle guardrails: deadline expiry, lost cancel -> zombie reap,
+# poison-query containment
+# --------------------------------------------------------------------------
+
+def _lifecycle_residuals(sched, executors):
+    out = []
+    if any(ex.active_tasks() for ex in executors):
+        out.append("in-flight tasks")
+    if any(ex.running_task_ids() for ex in executors):
+        out.append("cancel tokens")
+    if sched.cluster.total_available() != sched.cluster.total_slots():
+        out.append("slot reservations")
+    if sched.pending_task_count() != 0:
+        out.append("pending tasks")
+    if sched.jobs.active_graphs():
+        out.append("active graphs")
+    snap = sched.admission.snapshot()
+    if snap["queued"] or snap["running"]:
+        out.append("admission permits")
+    return out
+
+
+def _assert_lifecycle_leak_free(ctx, timeout=15.0):
+    sched = ctx._standalone.scheduler
+    executors = ctx._standalone.executors
+    deadline = time.monotonic() + timeout
+    while _lifecycle_residuals(sched, executors) \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not _lifecycle_residuals(sched, executors), \
+        f"residual state: {_lifecycle_residuals(sched, executors)}"
+
+
+def test_deadline_expiry_mid_stage_leaves_no_leaks():
+    """Scenario: a job blows its server-side deadline with stage-2 tasks
+    mid-flight.  The reaper must cancel it fleet-wide — terminal
+    DeadlineExceeded, every slot/permit/token released, nothing keeps
+    running."""
+    from arrow_ballista_tpu.utils.errors import ExecutionError
+
+    ctx = _standalone_ctx({"ballista.query.deadline.seconds": "2.0",
+                           "ballista.journal.enabled": "true"})
+    try:
+        baseline = ctx.sql(SQL).to_pandas()  # well under the deadline
+
+        plan = faults.FaultPlan.from_obj({"seed": 31, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": 6000, "times": -1, "match": {"stage_id": 2}}]})
+        t0 = time.monotonic()
+        with faults.use_plan(plan):
+            with pytest.raises(ExecutionError, match="DeadlineExceeded"):
+                ctx.sql(SQL).to_pandas()
+        assert time.monotonic() - t0 < 10.0, \
+            "deadline must land on the reaper cadence, not the stall"
+        assert plan.events, "the stall failpoint must actually have fired"
+
+        sched = ctx._standalone.scheduler
+        job_id = ctx._standalone.last_job_id
+        status = sched.jobs.get_status(job_id)
+        assert status.state == "failed" and not status.retriable
+        assert sched.metrics.counters_snapshot()[
+            "jobs_deadline_exceeded_total"] == 1
+        from arrow_ballista_tpu.obs import journal
+
+        kinds = [e["kind"] for e in journal.job_timeline(job_id)]
+        assert "job.deadline_exceeded" in kinds
+        _assert_lifecycle_leak_free(ctx)
+        # the session survives: the same query without the stall succeeds
+        _frames_equal(ctx.sql(SQL).to_pandas(), baseline)
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_lost_cancel_fanout_reaped_by_heartbeat():
+    """Scenario: the cancel RPC fanout is dropped by the network.  The
+    job goes terminal anyway; the executors keep running zombie tasks
+    until their heartbeats advertise the running set and the scheduler
+    re-issues the kill — within two heartbeat rounds."""
+    from arrow_ballista_tpu.scheduler.types import ExecutorHeartbeat
+    from arrow_ballista_tpu.utils.errors import ExecutionError
+
+    ctx = _standalone_ctx({"ballista.journal.enabled": "true"})
+    try:
+        sched = ctx._standalone.scheduler
+        executors = ctx._standalone.executors
+        result = {}
+
+        def run():
+            try:
+                ctx.sql(SQL).to_pandas()
+                result["out"] = "completed"
+            except ExecutionError as e:
+                result["out"] = str(e)
+
+        plan = faults.FaultPlan.from_obj({"seed": 37, "rules": [
+            {"site": "executor.task.slow", "action": "delay",
+             "delay_ms": 4000, "times": -1, "match": {"stage_id": 1}},
+            # one lost fanout per executor, then the network heals
+            {"site": "scheduler.cancel.fanout", "action": "drop",
+             "times": 2},
+        ]})
+        with faults.use_plan(plan):
+            th = threading.Thread(target=run)
+            th.start()
+            deadline = time.monotonic() + 10.0
+            while not any(ex.active_tasks() for ex in executors) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert any(ex.active_tasks() for ex in executors)
+            job_id = ctx._standalone.last_job_id
+            ctx.cancel(job_id)
+            # the job is terminal for clients immediately ...
+            deadline = time.monotonic() + 10.0
+            while sched.jobs.get_status(job_id).state != "cancelled" \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sched.jobs.get_status(job_id).state == "cancelled"
+            # ... but the dropped fanout left zombie tasks behind
+            assert sum(len(ex.running_task_ids()) for ex in executors) > 0
+            dropped = [e for e in plan.events
+                       if e["site"] == "scheduler.cancel.fanout"]
+            assert dropped, "the fanout drop must actually have fired"
+
+            # two heartbeat rounds close the leak
+            for _round in range(2):
+                for ex in executors:
+                    sched.heartbeat(ExecutorHeartbeat(
+                        ex.metadata.executor_id,
+                        running=ex.running_task_ids()))
+                time.sleep(0.2)
+            deadline = time.monotonic() + 10.0
+            while any(ex.running_task_ids() for ex in executors) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert not any(ex.running_task_ids() for ex in executors), \
+                "zombie tasks survived two heartbeat rounds"
+            th.join(timeout=15.0)
+            assert not th.is_alive()
+
+        counters = sched.metrics.counters_snapshot()
+        assert counters["zombie_tasks_reaped_total"] >= 1
+        from arrow_ballista_tpu.obs import journal
+
+        kinds = [e["kind"] for e in journal.job_timeline(job_id)]
+        assert "zombie.reaped" in kinds
+        _assert_lifecycle_leak_free(ctx)
+        assert len(ctx.sql(SQL).to_pandas()) == 7
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_poison_query_contained_without_quarantining_fleet():
+    """Scenario: a query whose split deterministically fails every
+    executor it touches.  Containment must fail it fast (no retry-budget
+    burn-down) with the quarantine list EMPTY — one bad query must never
+    bench healthy hosts."""
+    from arrow_ballista_tpu.utils.errors import ExecutionError
+
+    ctx = _standalone_ctx({"ballista.journal.enabled": "true"})
+    try:
+        sched = ctx._standalone.scheduler
+        baseline = ctx.sql(SQL).to_pandas()
+
+        plan = faults.FaultPlan.from_obj({"seed": 41, "rules": [{
+            "site": "executor.task.before_run", "action": "raise",
+            "error": "io", "message": "poison split: unreadable block",
+            "times": -1, "match": {"stage_id": 1, "partition": 0}}]})
+        t0 = time.monotonic()
+        with faults.use_plan(plan):
+            with pytest.raises(ExecutionError, match="PoisonQuery"):
+                ctx.sql(SQL).to_pandas()
+        assert time.monotonic() - t0 < 10.0, "containment must be fast"
+
+        job_id = ctx._standalone.last_job_id
+        status = sched.jobs.get_status(job_id)
+        assert status.state == "failed" and not status.retriable
+        counters = sched.metrics.counters_snapshot()
+        assert counters["jobs_poisoned_total"] == 1
+        snap = sched.quarantine.snapshot()
+        assert not snap["quarantined"] and snap["total_quarantined"] == 0, \
+            "poison containment must refund every quarantine strike"
+        from arrow_ballista_tpu.obs import journal
+
+        pois = [e for e in journal.job_timeline(job_id)
+                if e["kind"] == "job.poisoned"]
+        assert pois
+        (witnesses,) = pois[0]["attrs"]["evidence"].values()
+        assert len(witnesses) >= 2
+        _assert_lifecycle_leak_free(ctx)
+        # fleet intact: the healthy query runs at full strength
+        _frames_equal(ctx.sql(SQL).to_pandas(), baseline)
+    finally:
+        ctx._standalone.shutdown()

@@ -1,0 +1,527 @@
+"""Query lifecycle guardrails: server-side deadlines, cooperative
+cancellation, poison-query containment, zombie-task reconciliation.
+
+The invariants under test:
+
+- a deadline is armed at submission, enforced fleet-wide by the
+  scheduler's reaper, and rides the checkpoint as an ABSOLUTE expiry;
+- the public cancel surface releases every piece of job state (slots,
+  admission permits, in-flight tokens) — cancellation leaks nothing;
+- the same partition failing with equivalent errors on K distinct
+  executors classifies the QUERY as poison: fail fast, refund every
+  implicated executor's quarantine streak, skip the retry budget;
+- an executor heartbeating tasks for a job the scheduler already closed
+  gets the kill re-issued (the lost-cancel-RPC leak), and the disk
+  janitor never deletes a live job's workspace;
+- retried partitions are steered away from executors that already failed
+  them whenever a different alive executor exists (anti-affinity), so
+  poison evidence can accumulate — without ever deadlocking a
+  single-executor cluster.
+"""
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from arrow_ballista_tpu import faults, serde
+from arrow_ballista_tpu.client.context import BallistaContext
+from arrow_ballista_tpu.ops.physical import (
+    CancelToken,
+    TaskContext,
+    checkpoint,
+    current_cancel_token,
+    install_cancel_token,
+)
+from arrow_ballista_tpu.scheduler.execution_graph import ExecutionGraph
+from arrow_ballista_tpu.scheduler.types import ExecutorHeartbeat
+from arrow_ballista_tpu.utils.config import BallistaConfig
+from arrow_ballista_tpu.utils.errors import (
+    CancelledError,
+    ExecutionError,
+    PlanningError,
+)
+
+from .test_scheduler import fake_success, physical_plan, scheduler_test
+
+SQL = "select g, sum(v) as s, count(*) as n from t group by g order by g"
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _ctx(conf_extra=None, num_executors=2):
+    conf = {"ballista.shuffle.partitions": "4",
+            "ballista.journal.enabled": "true"}
+    conf.update(conf_extra or {})
+    ctx = BallistaContext.standalone(BallistaConfig(conf),
+                                     concurrent_tasks=2,
+                                     num_executors=num_executors)
+    rng = np.random.default_rng(23)
+    ctx.register_table("t", pa.table({
+        "g": pa.array(rng.integers(0, 7, 4000).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, 4000).astype(np.int64)),
+    }))
+    return ctx
+
+
+def _stall_plan(delay_ms=5000, stage_id=1):
+    """Every stage-``stage_id`` task sleeps long enough to outlive the
+    test's deadline/cancel window, short enough that the woken task hits
+    its cancel checkpoint (and unwinds) well inside the leak sweep."""
+    return faults.FaultPlan.from_obj({"seed": 11, "rules": [{
+        "site": "executor.task.slow", "action": "delay",
+        "delay_ms": delay_ms, "times": -1,
+        "match": {"stage_id": stage_id}}]})
+
+
+def _assert_no_leaks(sched, executors, timeout=15.0):
+    """Post-terminal sweep: every reservation, permit and in-flight token
+    must be released."""
+    deadline = time.monotonic() + timeout
+    def residuals():
+        out = []
+        if any(ex.active_tasks() for ex in executors):
+            out.append("in-flight tasks")
+        if any(ex.running_task_ids() for ex in executors):
+            out.append("cancel tokens")
+        if sched.cluster.total_available() != sched.cluster.total_slots():
+            out.append("slot reservations")
+        if sched.pending_task_count() != 0:
+            out.append("pending tasks")
+        if sched.jobs.active_graphs():
+            out.append("active graphs")
+        snap = sched.admission.snapshot()
+        if snap["queued"] or snap["running"]:
+            out.append("admission permits")
+        return out
+    while residuals() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not residuals(), f"leaked after terminal status: {residuals()}"
+
+
+# --------------------------------------------------------------------------
+# cooperative cancellation token
+# --------------------------------------------------------------------------
+
+def test_cancel_token_checkpoint_units():
+    assert current_cancel_token() is None
+    checkpoint()                      # no token installed: no-op
+    TaskContext().check_cancelled()   # no probe, no token: no-op
+    token = CancelToken()
+    install_cancel_token(token)
+    try:
+        assert current_cancel_token() is token
+        checkpoint("jobx")            # installed but not cancelled: no-op
+        TaskContext(job_id="jobx").check_cancelled()
+        token.cancel()
+        with pytest.raises(CancelledError, match="jobx"):
+            checkpoint("jobx")
+        with pytest.raises(CancelledError, match="jobx"):
+            TaskContext(job_id="jobx").check_cancelled()
+    finally:
+        install_cancel_token(None)
+    checkpoint("jobx")  # uninstalled: cancelled token no longer observed
+
+
+def test_cancel_token_is_thread_local():
+    token = CancelToken()
+    token.cancel()
+    install_cancel_token(token)
+    try:
+        seen = {}
+
+        def other():
+            seen["token"] = current_cancel_token()
+            checkpoint()  # must not raise: this thread has no token
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join(5)
+        assert seen["token"] is None
+    finally:
+        install_cancel_token(None)
+
+
+# --------------------------------------------------------------------------
+# wire shapes: heartbeat running set, graph deadline, stage failed_on
+# --------------------------------------------------------------------------
+
+def test_heartbeat_running_set_is_wire_silent_when_idle():
+    idle = serde.executor_heartbeat_to_obj(
+        ExecutorHeartbeat("e1", timestamp=1.0))
+    assert "running" not in idle, "idle heartbeat must not grow a key"
+    busy = ExecutorHeartbeat("e1", timestamp=1.0,
+                             running=[("job1", 1, 0, 0), ("job1", 1, 2, 1)])
+    back = serde.executor_heartbeat_from_obj(
+        serde.executor_heartbeat_to_obj(busy))
+    assert back.running == [("job1", 1, 0, 0), ("job1", 1, 2, 1)]
+
+
+def test_graph_serde_deadline_and_failed_on_roundtrip():
+    graph = ExecutionGraph.build("jobd", physical_plan(partitions=3))
+    obj = serde.graph_to_obj(graph)
+    assert "deadline_ts" not in obj and "deadline_s" not in obj, \
+        "deadline-off checkpoints must stay byte-identical to older ones"
+    assert all("failed_on" not in st for st in obj["stages"])
+
+    graph.deadline_ts = 1999999999.5
+    graph.deadline_s = 42.0
+    graph.stages[1].failed_on = {0: {"exec-A", "exec-B"}, 2: {"exec-A"}}
+    back = serde.graph_from_obj(serde.graph_to_obj(graph))
+    assert back.deadline_ts == 1999999999.5 and back.deadline_s == 42.0
+    assert back.stages[1].failed_on == {0: {"exec-A", "exec-B"},
+                                        2: {"exec-A"}}
+    assert back.stages[2].failed_on == {}
+
+
+# --------------------------------------------------------------------------
+# retry anti-affinity
+# --------------------------------------------------------------------------
+
+def test_pop_next_task_steers_retry_off_failing_executor():
+    graph = ExecutionGraph.build("joba", physical_plan(partitions=3))
+    graph.stages[1].failed_on = {0: {"exec-A"}}
+    alive = {"exec-A", "exec-B"}
+    taken = []
+    while True:
+        t = graph.pop_next_task("exec-A", alive=alive)
+        if t is None:
+            break
+        taken.append(t.task.partition)
+    assert 0 not in taken, "exec-A already failed partition 0"
+    assert sorted(taken) == [1, 2]
+    t = graph.pop_next_task("exec-B", alive=alive)
+    assert t is not None and t.task.partition == 0
+
+
+def test_pop_next_task_escape_hatch_single_executor():
+    """When the failed-on set covers the alive fleet the steer degrades
+    to a plain retry — a one-executor cluster must never deadlock."""
+    graph = ExecutionGraph.build("jobb", physical_plan(partitions=3))
+    graph.stages[1].failed_on = {0: {"exec-A"}}
+    t = graph.pop_next_task("exec-A", alive={"exec-A"})
+    assert t is not None and t.task.partition == 0
+    # no alive context at all (legacy callers): no veto either
+    graph2 = ExecutionGraph.build("jobc", physical_plan(partitions=3))
+    graph2.stages[1].failed_on = {0: {"exec-A"}}
+    t2 = graph2.pop_next_task("exec-A")
+    assert t2 is not None and t2.task.partition == 0
+
+
+def test_rollback_clears_anti_affinity():
+    graph = ExecutionGraph.build("jobr", physical_plan(partitions=3))
+    stage = graph.stages[1]
+    stage.failed_on = {0: {"exec-A"}}
+    while True:
+        t = graph.pop_next_task("exec-B", alive={"exec-A", "exec-B"})
+        if t is None:
+            break
+        graph.update_task_status([fake_success(t, "exec-B")])
+    stage.rollback()
+    assert stage.failed_on == {}
+
+
+# --------------------------------------------------------------------------
+# server-side deadlines
+# --------------------------------------------------------------------------
+
+def test_deadline_stamped_from_session_config():
+    ctx = _ctx({"ballista.query.deadline.seconds": "120"})
+    try:
+        before = time.time()
+        ctx.sql(SQL).to_pandas()
+        sched = ctx._standalone.scheduler
+        graph = sched.jobs.get_graph(ctx._standalone.last_job_id)
+        assert graph.deadline_s == 120.0
+        assert graph.deadline_ts == pytest.approx(before + 120.0, abs=30.0)
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_deadline_override_per_submit():
+    ctx = _ctx()  # session default: no deadline
+    try:
+        ctx.sql(SQL).to_pandas()
+        sched = ctx._standalone.scheduler
+        assert sched.jobs.get_graph(
+            ctx._standalone.last_job_id).deadline_s == 0.0
+        # per-submit config override wins over the session default
+        override = BallistaConfig({"ballista.shuffle.partitions": "4",
+                                   "ballista.query.deadline.seconds": "90"})
+        ctx._standalone.execute_sql(
+            "select g, min(v) as lo from t group by g order by g",
+            ctx.catalog, config=override)
+        assert sched.jobs.get_graph(
+            ctx._standalone.last_job_id).deadline_s == 90.0
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_deadline_expires_stalled_job_fleet_wide():
+    ctx = _ctx({"ballista.query.deadline.seconds": "2.0"})
+    try:
+        sched = ctx._standalone.scheduler
+        t0 = time.monotonic()
+        with faults.use_plan(_stall_plan()):
+            with pytest.raises(ExecutionError, match="DeadlineExceeded"):
+                ctx.sql(SQL).to_pandas()
+        # budget 2 s + reaper cadence 1 s, with generous slack
+        assert time.monotonic() - t0 < 10.0
+        job_id = ctx._standalone.last_job_id
+        status = sched.jobs.get_status(job_id)
+        assert status.state == "failed" and not status.retriable, \
+            "DeadlineExceeded is terminal: clients must not blind-resubmit"
+        assert sched.metrics.counters_snapshot()[
+            "jobs_deadline_exceeded_total"] == 1
+        from arrow_ballista_tpu.obs import journal
+
+        kinds = [e["kind"] for e in journal.job_timeline(job_id)]
+        assert "job.deadline_exceeded" in kinds
+        _assert_no_leaks(sched, ctx._standalone.executors)
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_generous_deadline_is_invisible():
+    """A deadline the query never hits must not change results."""
+    plain = _ctx()
+    armed = _ctx({"ballista.query.deadline.seconds": "300"})
+    try:
+        expected = plain.sql(SQL).to_pandas()
+        got = armed.sql(SQL).to_pandas()
+        assert got.equals(expected)
+        assert armed._standalone.scheduler.metrics.counters_snapshot()[
+            "jobs_deadline_exceeded_total"] == 0
+    finally:
+        plain._standalone.shutdown()
+        armed._standalone.shutdown()
+
+
+# --------------------------------------------------------------------------
+# public cancel surface
+# --------------------------------------------------------------------------
+
+def test_cancel_surface_releases_everything():
+    ctx = _ctx()
+    try:
+        sched = ctx._standalone.scheduler
+        result = {}
+
+        def run():
+            try:
+                ctx.sql(SQL).to_pandas()
+                result["out"] = "completed"
+            except ExecutionError as e:
+                result["out"] = str(e)
+
+        with faults.use_plan(_stall_plan(delay_ms=3000)):
+            th = threading.Thread(target=run)
+            th.start()
+            deadline = time.monotonic() + 10.0
+            while (ctx._standalone.last_job_id is None
+                   or not any(ex.active_tasks()
+                              for ex in ctx._standalone.executors)) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert any(ex.active_tasks() for ex in ctx._standalone.executors)
+            t0 = time.monotonic()
+            ctx.cancel()  # defaults to the last submitted job
+            th.join(timeout=20.0)
+        assert not th.is_alive(), "cancel did not unblock the caller"
+        assert time.monotonic() - t0 < 15.0
+        assert "cancelled" in result["out"]
+        status = sched.jobs.get_status(ctx._standalone.last_job_id)
+        assert status.state == "cancelled"
+        ctx.cancel()  # idempotent: cancelling a finished job is a no-op
+        _assert_no_leaks(sched, ctx._standalone.executors)
+        # the session still works after a cancel
+        assert len(ctx.sql(SQL).to_pandas()) == 7
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_cancel_without_job_raises():
+    ctx = _ctx()
+    try:
+        with pytest.raises(PlanningError, match="no job"):
+            ctx.cancel()
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_cli_cancel_command(capsys):
+    from arrow_ballista_tpu.cli import run_command
+
+    ctx = _ctx()
+    try:
+        ctx.sql(SQL).to_pandas()
+        run_command(ctx, r"\cancel", False)
+        assert "cancel requested" in capsys.readouterr().out
+    finally:
+        ctx._standalone.shutdown()
+
+
+# --------------------------------------------------------------------------
+# poison-query containment
+# --------------------------------------------------------------------------
+
+def _poison_plan():
+    return faults.FaultPlan.from_obj({"seed": 3, "rules": [{
+        "site": "executor.task.before_run", "action": "raise", "error": "io",
+        "message": "poison split: unreadable block", "times": -1,
+        "match": {"stage_id": 1, "partition": 0}}]})
+
+
+def test_poison_query_fails_fast_and_refunds_quarantine():
+    ctx = _ctx()
+    try:
+        sched = ctx._standalone.scheduler
+        with faults.use_plan(_poison_plan()):
+            with pytest.raises(ExecutionError, match="PoisonQuery"):
+                ctx.sql(SQL).to_pandas()
+        job_id = ctx._standalone.last_job_id
+        status = sched.jobs.get_status(job_id)
+        assert status.state == "failed" and not status.retriable
+        assert sched.metrics.counters_snapshot()["jobs_poisoned_total"] == 1
+        # the whole point: the query's crime charges NO executor
+        snap = sched.quarantine.snapshot()
+        assert not snap["quarantined"] and snap["total_quarantined"] == 0
+        from arrow_ballista_tpu.obs import journal
+
+        pois = [e for e in journal.job_timeline(job_id)
+                if e["kind"] == "job.poisoned"]
+        assert pois, "classification must land in the flight record"
+        evidence = pois[0]["attrs"]["evidence"]
+        (witnesses,) = evidence.values()
+        assert len(witnesses) >= 2, \
+            "poison needs testimony from K distinct executors"
+        # the fleet is intact: the next (healthy) query just runs
+        assert len(ctx.sql(SQL).to_pandas()) == 7
+        _assert_no_leaks(sched, ctx._standalone.executors)
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_poison_classification_disabled_by_zero():
+    ctx = _ctx({"ballista.poison.distinct_executors": "0"})
+    try:
+        with faults.use_plan(_poison_plan()):
+            with pytest.raises(ExecutionError) as exc:
+                ctx.sql(SQL).to_pandas()
+        # classification off: the plain retry budget decides the failure
+        assert "PoisonQuery" not in str(exc.value)
+        assert "failed 4 times" in str(exc.value)
+    finally:
+        ctx._standalone.shutdown()
+
+
+def test_poison_attaches_forensics_with_doctor_finding():
+    ctx = _ctx()
+    try:
+        sched = ctx._standalone.scheduler
+        with faults.use_plan(_poison_plan()):
+            with pytest.raises(ExecutionError, match="PoisonQuery"):
+                ctx.sql(SQL).to_pandas()
+        graph = sched.jobs.get_graph(ctx._standalone.last_job_id)
+        deadline = time.monotonic() + 10.0
+        while getattr(graph, "forensics", None) is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)  # forensics attach is post-terminal
+        assert graph.forensics is not None
+        from arrow_ballista_tpu.obs.doctor import diagnose
+
+        findings = diagnose(graph.forensics)["findings"]
+        ps = [f for f in findings if f["rule"] == "poison-suspect"]
+        assert ps and ps[0]["evidence"]["distinct_executors"] >= 2
+    finally:
+        ctx._standalone.shutdown()
+
+
+# --------------------------------------------------------------------------
+# zombie-task reconciliation
+# --------------------------------------------------------------------------
+
+def test_heartbeat_reaps_tasks_of_closed_jobs():
+    server, launcher = scheduler_test()
+    try:
+        from .test_scheduler import run_job
+
+        status = run_job(server, physical_plan())
+        assert status.state == "successful"
+        # the executor claims it still runs tasks for the finished job —
+        # exactly what a lost cancel/cleanup RPC leaves behind
+        server.heartbeat(ExecutorHeartbeat(
+            "exec-0", running=[("job1", 2, 0, 0), ("job1", 2, 1, 0)]))
+        deadline = time.monotonic() + 10.0
+        while not launcher.cancelled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ("exec-0", "job1") in launcher.cancelled
+        assert server.metrics.counters_snapshot()[
+            "zombie_tasks_reaped_total"] == 2
+    finally:
+        server.shutdown()
+
+
+def test_heartbeat_running_live_job_not_reaped():
+    server, launcher = scheduler_test()
+    try:
+        from .test_scheduler import run_job
+
+        run_job(server, physical_plan())
+        # unknown-but-checkpointable jobs and live jobs are NOT zombies;
+        # tasks of a job this scheduler never heard of ARE (restart case)
+        server.heartbeat(ExecutorHeartbeat(
+            "exec-1", running=[("never-seen", 1, 0, 0)]))
+        deadline = time.monotonic() + 10.0
+        while not launcher.cancelled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ("exec-1", "never-seen") in launcher.cancelled
+    finally:
+        server.shutdown()
+
+
+def test_janitor_spares_live_job_dirs(tmp_path):
+    """The shrunk-TTL regression: a workspace with RUNNING tasks must
+    survive the janitor however stale its file mtimes look."""
+    import os
+
+    from arrow_ballista_tpu.executor.server import ExecutorServer
+    from arrow_ballista_tpu.scheduler.netservice import SchedulerNetService
+
+    sched = SchedulerNetService(
+        "127.0.0.1", 0, config=BallistaConfig({}))
+    sched.start()
+    ex = None
+    try:
+        ex = ExecutorServer("127.0.0.1", sched.port, "127.0.0.1", 0,
+                            work_dir=str(tmp_path), concurrent_tasks=1,
+                            executor_id="janitor-ex",
+                            job_data_ttl_s=0.1, janitor_interval_s=0.1)
+        ex.start()
+        live_dir = tmp_path / "livejob"
+        live_dir.mkdir()
+        (live_dir / "data-0.arrow").write_bytes(b"x")
+        old = time.time() - 3600
+        os.utime(live_dir / "data-0.arrow", (old, old))
+        os.utime(live_dir, (old, old))
+        # registering an in-flight token marks the job live on this host
+        ex.executor._inflight[("livejob", 1, 0, 0)] = CancelToken()
+        time.sleep(0.8)  # several janitor sweeps past the 0.1 s TTL
+        assert live_dir.exists(), \
+            "janitor deleted a job with running tasks"
+        del ex.executor._inflight[("livejob", 1, 0, 0)]
+        deadline = time.monotonic() + 10.0
+        while live_dir.exists() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not live_dir.exists(), \
+            "janitor must reclaim the dir once the job has no live tasks"
+    finally:
+        if ex is not None:
+            ex.stop(notify=False)
+        sched.stop()

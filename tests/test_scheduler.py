@@ -277,7 +277,12 @@ def test_task_retries_exhausted_fails_job():
                               failure=FailedReason(IO_ERROR, "always broken"))
         return None
 
+    # poison classification off: this test is about the plain retry
+    # budget (the 2-distinct-executor classifier would otherwise fail the
+    # job as PoisonQuery on the second attempt — tests/test_lifecycle.py
+    # covers that path)
     server, _ = scheduler_test(outcome_fn=outcome)
+    server.config.poison_distinct_executors = 0
     status = run_job(server, physical_plan())
     assert status.state == "failed"
     assert "4 times" in status.error

@@ -1,0 +1,302 @@
+"""Query-lifecycle oracle + leak sweep.
+
+Two legs, both against the full distributed (standalone) machinery:
+
+- **oracle**: the TPC-H suite twice — once with no deadline, once under a
+  generous server-side deadline no query can hit — every query
+  **bit-identical** between the legs and ``jobs_deadline_exceeded_total``
+  still zero afterwards.  The guardrail plane promises to be invisible
+  until it fires; this sweep is the oracle for that promise.
+- **leak**: ``LIFECYCLE_CYCLES`` (default 100) mixed
+  cancel / deadline-expiry / poison cycles against ONE standalone
+  context, then a residual audit: zero in-flight tasks, zero live cancel
+  tokens, every slot reservation returned, no pending tasks, no active
+  graphs, no queued or running admission permits, and an empty shuffle
+  work-dir tree once the post-terminal cleanup fanout drains.  A
+  lifecycle path that leaks one permit per cancel kills a serving fleet
+  in an afternoon; 100 cycles makes even a rare leak loud.
+
+    python -m tools.lifecycle_sweep         # writes LIFECYCLE_SWEEP.json
+
+Env knobs: ``BENCH_DATA`` (default ``.bench_data/tpch-sf1``; when the
+directory is missing the oracle leg generates SF ``LIFESWEEP_SCALE``
+tables in-process instead), ``SWEEP_QUERIES``, ``LIFESWEEP_OUT``,
+``LIFESWEEP_SCALE`` (default 0.01), ``LIFECYCLE_CYCLES`` (default 100),
+``LIFESWEEP_DEADLINE_S`` (default 600: the generous budget).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DATA_DIR = os.environ.get(
+    "BENCH_DATA", os.path.join(REPO, ".bench_data", "tpch-sf1"))
+OUT = os.environ.get(
+    "LIFESWEEP_OUT", os.path.join(REPO, "LIFECYCLE_SWEEP.json"))
+SCALE = float(os.environ.get("LIFESWEEP_SCALE", "0.01"))
+CYCLES = int(os.environ.get("LIFECYCLE_CYCLES", "100"))
+DEADLINE_S = float(os.environ.get("LIFESWEEP_DEADLINE_S", "600"))
+
+
+def _register(ctx):
+    from benchmarks.tpch import register_tables
+
+    if os.path.exists(os.path.join(DATA_DIR, "lineitem.parquet")):
+        register_tables(ctx, DATA_DIR)
+        return DATA_DIR
+    from benchmarks.datagen import generate_tables
+
+    for name, table in generate_tables(SCALE, seed=1).items():
+        ctx.register_table(name, table)
+    return f"generated sf{SCALE}"
+
+
+def _standalone(overrides: dict):
+    from arrow_ballista_tpu.client.context import BallistaContext
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    conf = {"ballista.batch.size": str(1 << 20),
+            "ballista.shuffle.partitions": "4", **overrides}
+    return BallistaContext.standalone(BallistaConfig(conf),
+                                      concurrent_tasks=2, num_executors=2)
+
+
+# --- oracle leg -----------------------------------------------------------
+
+def _run_oracle_leg(leg: str, overrides: dict, queries, artifact: dict):
+    from benchmarks.queries import QUERIES
+
+    ctx = _standalone(overrides)
+    frames = {}
+    try:
+        artifact["data"] = _register(ctx)
+        for q in queries:
+            t0 = time.time()
+            frames[q] = ctx.sql(QUERIES[q]).to_pandas()
+            artifact.setdefault(f"q{q}", {})[f"{leg}_s"] = round(
+                time.time() - t0, 1)
+            print(f"[lifesweep] {leg} q{q}: {time.time()-t0:.1f}s "
+                  f"({len(frames[q])} rows)", flush=True)
+        counters = ctx._standalone.scheduler.metrics.counters_snapshot()
+        artifact[f"{leg}_deadline_exceeded"] = counters.get(
+            "jobs_deadline_exceeded_total", 0)
+    finally:
+        ctx.shutdown()
+    return frames
+
+
+def oracle_sweep(artifact: dict) -> None:
+    import pandas as pd
+
+    from benchmarks.queries import QUERIES
+
+    queries = sorted(
+        int(x) for x in os.environ.get(
+            "SWEEP_QUERIES", ",".join(map(str, sorted(QUERIES)))).split(",")
+        if x.strip())
+    baseline = _run_oracle_leg("plain", {}, queries, artifact)
+    armed = _run_oracle_leg(
+        "deadline",
+        {"ballista.query.deadline.seconds": str(DEADLINE_S)},
+        queries, artifact)
+    assert artifact["deadline_deadline_exceeded"] == 0, \
+        "a generous deadline fired — the reaper is trigger-happy"
+
+    ok, mismatches = 0, []
+    for q in queries:
+        entry = artifact.setdefault(f"q{q}", {})
+        try:
+            # bit-identical: exact dtypes, exact values, exact order
+            pd.testing.assert_frame_equal(
+                baseline[q].reset_index(drop=True),
+                armed[q].reset_index(drop=True), check_exact=True)
+            entry["identical"] = True
+            ok += 1
+        except Exception as e:  # noqa: BLE001 — record and continue
+            entry["identical"] = False
+            entry["error"] = str(e)[:500]
+            mismatches.append(q)
+    artifact["identical"] = ok
+    artifact["total"] = len(queries)
+    print(f"[lifesweep] oracle: {ok}/{len(queries)} bit-identical under a "
+          f"{DEADLINE_S:.0f}s deadline", flush=True)
+    if mismatches:
+        raise SystemExit(
+            f"deadline-armed leg changed results on queries: {mismatches}")
+
+
+# --- leak leg -------------------------------------------------------------
+
+LEAK_SQL = "select g, sum(v) as s, count(*) as n from t group by g order by g"
+
+
+def _residuals(sched, executors, work_dir=None):
+    out = []
+    if any(ex.active_tasks() for ex in executors):
+        out.append("in-flight tasks")
+    if any(ex.running_task_ids() for ex in executors):
+        out.append("cancel tokens")
+    if sched.cluster.total_available() != sched.cluster.total_slots():
+        out.append("slot reservations")
+    if sched.pending_task_count() != 0:
+        out.append("pending tasks")
+    if sched.jobs.active_graphs():
+        out.append("active graphs")
+    snap = sched.admission.snapshot()
+    if snap["queued"] or snap["running"]:
+        out.append("admission permits")
+    if work_dir is not None and os.listdir(work_dir):
+        out.append(f"work-dir entries: {sorted(os.listdir(work_dir))[:4]}")
+    return out
+
+
+def leak_sweep(artifact: dict) -> None:
+    import numpy as np
+    import pyarrow as pa
+
+    from arrow_ballista_tpu import faults
+    from arrow_ballista_tpu.utils.errors import ExecutionError
+
+    def stall_plan(delay_ms):
+        return faults.FaultPlan.from_obj({"seed": 11, "rules": [{
+            "site": "executor.task.slow", "action": "delay",
+            "delay_ms": delay_ms, "times": -1,
+            "match": {"stage_id": 1}}]})
+
+    def poison_plan():
+        return faults.FaultPlan.from_obj({"seed": 3, "rules": [{
+            "site": "executor.task.before_run", "action": "raise",
+            "error": "io", "message": "poison split: unreadable block",
+            "times": -1, "match": {"stage_id": 1, "partition": 0}}]})
+
+    ctx = _standalone({})
+    sched = ctx._standalone.scheduler
+    executors = ctx._standalone.executors
+    work_dir = ctx._standalone.work_dir
+    # shrink the post-terminal shuffle-data fanout delay (default 30 s)
+    # so the work-dir audit below observes a drained tree, not a queue
+    sched.config.job_data_cleanup_delay_s = 0.2
+    rng = np.random.default_rng(23)
+    ctx.register_table("t", pa.table({
+        "g": pa.array(rng.integers(0, 7, 4000).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 100, 4000).astype(np.int64)),
+    }))
+    from arrow_ballista_tpu.utils.config import BallistaConfig
+
+    deadline_conf = BallistaConfig({
+        "ballista.shuffle.partitions": "4",
+        "ballista.query.deadline.seconds": "0.3"})
+    counts = {"cancel": 0, "deadline": 0, "poison": 0}
+    t_all = time.time()
+
+    def drain(timeout=15.0):
+        # injected executor.task.slow sleeps are uninterruptible: a
+        # cancelled cycle's tasks outlive their job by up to the delay.
+        # Wait them out so the next cycle's "is my task running yet?"
+        # probe cannot latch onto a predecessor's stragglers.
+        stop = time.monotonic() + timeout
+        while any(ex.active_tasks() for ex in executors) \
+                and time.monotonic() < stop:
+            time.sleep(0.02)
+
+    try:
+        for i in range(CYCLES):
+            if i % 10 == 9:
+                kind = "deadline"
+            elif i % 2 == 0:
+                kind = "cancel"
+            else:
+                kind = "poison"
+            counts[kind] += 1
+            if kind == "cancel":
+                drain()
+                prev_job = ctx._standalone.last_job_id
+                err = {}
+
+                def run():
+                    try:
+                        ctx.sql(LEAK_SQL).to_pandas()
+                        err["out"] = "completed"
+                    except ExecutionError as e:
+                        err["out"] = str(e)
+
+                with faults.use_plan(stall_plan(1000)):
+                    th = threading.Thread(target=run)
+                    th.start()
+                    stop = time.monotonic() + 10.0
+                    while (ctx._standalone.last_job_id == prev_job
+                           or not any(ex.active_tasks()
+                                      for ex in executors)) \
+                            and time.monotonic() < stop:
+                        time.sleep(0.01)
+                    ctx.cancel()
+                    th.join(timeout=20.0)
+                assert not th.is_alive(), f"cycle {i}: cancel hung"
+                assert "cancelled" in err.get("out", ""), (i, err)
+            elif kind == "deadline":
+                with faults.use_plan(stall_plan(800)):
+                    try:
+                        ctx._standalone.execute_sql(
+                            LEAK_SQL, ctx.catalog, config=deadline_conf)
+                        raise AssertionError(
+                            f"cycle {i}: stalled job beat a 0.3s deadline")
+                    except ExecutionError as e:
+                        assert "DeadlineExceeded" in str(e), (i, e)
+            else:
+                with faults.use_plan(poison_plan()):
+                    try:
+                        ctx.sql(LEAK_SQL).to_pandas()
+                        raise AssertionError(
+                            f"cycle {i}: poison query succeeded")
+                    except ExecutionError as e:
+                        assert "PoisonQuery" in str(e), (i, e)
+            if (i + 1) % 20 == 0:
+                print(f"[lifesweep] leak: {i+1}/{CYCLES} cycles "
+                      f"({time.time()-t_all:.0f}s)", flush=True)
+        # poison cycles must never have charged an executor
+        q = sched.quarantine.snapshot()
+        assert not q["quarantined"] and q["total_quarantined"] == 0, q
+        # the fleet still serves: one healthy query, correct answer
+        assert len(ctx.sql(LEAK_SQL).to_pandas()) == 7
+        # the residual audit: poll out the post-terminal unwind, then
+        # demand the fleet is exactly as empty as a fresh boot
+        stop = time.monotonic() + 20.0
+        while _residuals(sched, executors, work_dir) \
+                and time.monotonic() < stop:
+            time.sleep(0.05)
+        leaks = _residuals(sched, executors, work_dir)
+        assert not leaks, f"leaked after {CYCLES} cycles: {leaks}"
+        counters = sched.metrics.counters_snapshot()
+        artifact["leak_cycles"] = dict(counts)
+        artifact["leak_counters"] = {
+            k: counters.get(k, 0)
+            for k in ("jobs_deadline_exceeded_total", "jobs_poisoned_total",
+                      "job_cancelled_total", "zombie_tasks_reaped_total")}
+        artifact["leak_wall_s"] = round(time.time() - t_all, 1)
+        print(f"[lifesweep] leak: {CYCLES} cycles {counts} in "
+              f"{artifact['leak_wall_s']}s, zero residuals", flush=True)
+    finally:
+        faults.clear()
+        ctx.shutdown()
+
+
+def main() -> None:
+    t_all = time.time()
+    artifact: dict = {"cycles": CYCLES, "deadline_s": DEADLINE_S}
+    oracle_sweep(artifact)
+    leak_sweep(artifact)
+    artifact["wall_s"] = round(time.time() - t_all, 1)
+    with open(OUT, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"[lifesweep] {artifact['identical']}/{artifact['total']} "
+          f"bit-identical, {CYCLES} leak cycles clean -> {OUT}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,10 +14,16 @@
 #      compiler: chain detection, allowlist verdicts, fused-vs-interpreted
 #      equality, fusion serde + rollback/speculation/chaos interplay,
 #      live observability: watch-stream ordering/gap semantics, the
-#      progress/ETA estimator, in-flight doctor alerts, SLO burn rates),
+#      progress/ETA estimator, in-flight doctor alerts, SLO burn rates,
+#      query-lifecycle guardrails: server-side deadlines, cooperative
+#      cancel tokens + the public cancel surface, poison-query
+#      containment with quarantine refund, retry anti-affinity,
+#      zombie-task reconciliation, the janitor live-job guard),
 #   4. the chaos recovery suite (deterministic fault injection: seeded
 #      failpoint plans, kill/fetch-failure/drop/restart scenarios,
-#      quarantine, straggler speculation, corrupt-shuffle checksums) plus
+#      quarantine, straggler speculation, corrupt-shuffle checksums,
+#      lifecycle guardrails under chaos: deadline expiry mid-stage,
+#      lost cancel fanout reaped by heartbeat, poison containment) plus
 #      the scheduler-fleet HA suite (tests/test_fleet.py: shard killed
 #      mid-job and adopted by a sibling, lease fencing under partition,
 #      adoption/completion races, real-process SIGKILL failover) —
@@ -31,6 +37,13 @@
 #      the governor denies every join-build and aggregation-state
 #      reservation — every query bit-identical between the legs, spills
 #      proven to have happened, zero reservation leaks,
+#   5b. the query-lifecycle sweep (tools/lifecycle_sweep.py): the TPC-H
+#      suite with a generous server-side deadline vs none — bit-identical
+#      and the deadline reaper never fires — then 100 mixed
+#      cancel/deadline-expiry/poison cycles against one standalone
+#      context with a residual audit at the end: zero in-flight tasks,
+#      cancel tokens, slot reservations, pending tasks, active graphs,
+#      or admission permits, and no executor quarantined by poison,
 #   6. the doctor smoke: one standalone query with the flight recorder
 #      on — the forensics bundle must validate against the
 #      ballista.forensics/v1 schema, carry a complete journal timeline,
@@ -69,11 +82,12 @@ python -m arrow_ballista_tpu.analysis --sarif > analysis.sarif || true
 echo "== generated docs up to date =="
 python docs/gen_configs.py --check
 
-echo "== analysis + concurrency + serde + speculation + observability + aqe + compile + live-obs test files =="
+echo "== analysis + concurrency + serde + speculation + observability + aqe + compile + live-obs + lifecycle test files =="
 python -m pytest tests/test_static_analysis.py tests/test_concurrency.py \
     tests/test_serde_wire.py tests/test_speculation.py \
     tests/test_observatory.py tests/test_device_obs.py tests/test_aqe.py \
     tests/test_doctor.py tests/test_compile.py tests/test_live_obs.py \
+    tests/test_lifecycle.py tests/test_cancellation.py \
     -q -p no:cacheprovider -m 'not chaos'
 
 echo "== chaos recovery + fleet HA suites (-m chaos, runtime lock-order validation on) =="
@@ -84,6 +98,9 @@ BALLISTA_LOCK_ORDER_RUNTIME=1 \
 
 echo "== memory-governor oracle sweep (tiny budget: every join/agg spills, bit-identical) =="
 python -m tools.memory_sweep
+
+echo "== query-lifecycle sweep (deadline oracle bit-identical + 100-cycle leak audit) =="
+python -m tools.lifecycle_sweep
 
 echo "== doctor smoke (flight recorder on: bundle validates, clean run diagnoses clean) =="
 python - <<'EOF'
